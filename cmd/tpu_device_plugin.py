@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""GKE TPU device plugin — main binary.
+
+TPU-native equivalent of the reference's device-plugin main
+(ref: cmd/nvidia_gpu/nvidia_gpu.go:42-147): parse flags + node config,
+wait for the installer to deliver device nodes, start the manager,
+optionally start metrics + health monitoring, then run the serve loop
+(blocks forever).
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.deviceplugin.api import DEVICE_PLUGIN_PATH
+from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
+from container_engine_accelerators_tpu.health import TpuHealthChecker
+from container_engine_accelerators_tpu.tpulib import open_lib
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+from container_engine_accelerators_tpu.utils.device import Mount
+
+log = logging.getLogger("tpu-device-plugin")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="GKE TPU device plugin")
+    p.add_argument(
+        "--host-path",
+        default="/home/kubernetes/bin/tpu",
+        help="Path on the host where TPU libraries (libtpu) are installed",
+    )
+    p.add_argument(
+        "--container-path",
+        default="/usr/local/tpu",
+        help="Path where the TPU libraries are mounted into containers",
+    )
+    p.add_argument(
+        "--plugin-directory",
+        default=DEVICE_PLUGIN_PATH,
+        help="Directory holding the kubelet and plugin sockets",
+    )
+    p.add_argument("--dev-directory", default="/dev")
+    p.add_argument(
+        "--sysfs-root",
+        default="/",
+        help="Root for the sysfs contract (tests point this at a fixture)",
+    )
+    p.add_argument(
+        "--tpu-config",
+        default="/etc/tpu/tpu_config.json",
+        help="Node TPU config JSON (partitioning/sharing/health codes)",
+    )
+    p.add_argument("--enable-container-tpu-metrics", action="store_true")
+    p.add_argument("--enable-health-monitoring", action="store_true")
+    p.add_argument("--tpu-metrics-port", type=int, default=2112)
+    p.add_argument(
+        "--tpu-metrics-collection-interval",
+        type=float,
+        default=30.0,
+        help="Seconds between metric samples",
+    )
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    args = parse_args(argv)
+
+    config = TPUConfig.from_file(args.tpu_config)
+    config.add_defaults_and_validate()
+    config.add_health_critical_codes()
+    log.info("TPU config: %s", config)
+
+    mounts = [
+        Mount(
+            host_path=args.host_path,
+            container_path=args.container_path,
+            read_only=True,
+        )
+    ]
+    lib = open_lib(args.sysfs_root)
+    manager = TpuManager(args.dev_directory, mounts, config, lib=lib)
+
+    # Installer handshake: wait for device nodes (nvidia_gpu.go:99-109).
+    while not manager.check_device_paths():
+        log.info("TPU device nodes not yet present in %s; waiting", args.dev_directory)
+        time.sleep(5)
+
+    while True:
+        try:
+            manager.start()
+            break
+        except Exception as e:  # retry like the reference's Start loop
+            log.error("failed to start TPU manager: %s; retrying in 5s", e)
+            time.sleep(5)
+
+    if args.enable_container_tpu_metrics:
+        from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+
+        log.info("starting metrics server on port %d", args.tpu_metrics_port)
+        MetricServer(
+            lib=lib,
+            manager=manager,
+            port=args.tpu_metrics_port,
+            collection_interval_s=args.tpu_metrics_collection_interval,
+        ).start()
+
+    if args.enable_health_monitoring:
+        TpuHealthChecker(
+            manager, lib, critical_codes=manager.list_health_critical_codes()
+        ).start()
+
+    manager.serve(args.plugin_directory)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""container_engine_accelerators_tpu — a TPU-native re-design of GKE's
+node-level accelerator infrastructure stack.
+
+The reference (crankshaw-google/container-engine-accelerators) is the glue
+that makes NVIDIA GPUs consumable by Kubernetes pods: a kubelet device
+plugin, driver installers, NCCL/GPUDirect comms stacks, a topology-aware
+scheduler, MIG partitioning, GPU sharing, health monitoring and metrics.
+
+This package provides the TPU-native equivalent of every component:
+
+- ``deviceplugin``  — kubelet DevicePlugin v1beta1 gRPC server advertising
+  ``google.com/tpu`` for ``/dev/accel*`` (ref: pkg/gpu/nvidia/).
+- ``tpulib``        — NVML-analog bindings over the C++ ``tpushim`` native
+  library: chip enumeration, topology, HBM stats, error-event stream
+  (ref: NVML via go-nvml; pkg/gpu/nvidia/metrics/util.go:17-73).
+- ``sharing``       — time-sharing / core-sharing virtual devices
+  (ref: pkg/gpu/nvidia/gpusharing/).
+- ``partition``     — TPU sub-slice partitioning, the MIG analog
+  (ref: partition_gpu/, pkg/gpu/nvidia/mig/).
+- ``health``        — error-event → Unhealthy device flow
+  (ref: pkg/gpu/nvidia/health_check/).
+- ``metrics``       — Prometheus duty-cycle/HBM gauges + kubelet
+  PodResources join (ref: pkg/gpu/nvidia/metrics/).
+- ``scheduler``     — ICI/DCN topology-aware gated-pod scheduler
+  (ref: gpudirect-tcpxo/topology-scheduler/).
+- ``collectives``   — XLA collectives bandwidth rig over ICI/DCN, the
+  nccl-tests analog (ref: gpudirect-tcpx/nccl-test.yaml).
+- ``models`` / ``ops`` / ``parallel`` — JAX/Flax workload layer (ResNet-50
+  demo, pallas kernels, mesh/sharding helpers; ref: demo/).
+"""
+
+__version__ = "0.1.0"
+
+TPU_RESOURCE_NAME = "google.com/tpu"

@@ -1,0 +1,3 @@
+from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
+
+__all__ = ["TpuManager"]

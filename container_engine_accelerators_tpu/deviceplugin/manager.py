@@ -1,0 +1,321 @@
+"""TPU device manager: discovery, specs, env contract, serve state machine.
+
+TPU-native re-design of the reference's nvidiaGPUManager
+(ref: pkg/gpu/nvidia/manager.go:136-499):
+
+- discovery walks devDirectory for ``accel[0-9]+`` (the reference walks for
+  ``nvidia[0-9]+``, manager.go:231-247);
+- there is no /dev/nvidiactl analog — libtpu opens the chips directly — so
+  default devices are just ``/dev/vfio/vfio`` when present (vfio-tpu nodes);
+- sharing expands physical chips/sub-slices into vtpu virtual devices;
+- core-sharing (the MPS analog) computes the co-tenancy env contract:
+  TPU_CORE_PERCENTAGE + TPU_HBM_LIMIT_BYTES per container, from per-chip
+  HBM totals via tpulib (the reference computes
+  CUDA_MPS_ACTIVE_THREAD_PERCENTAGE / PINNED_DEVICE_MEM_LIMIT via NVML,
+  manager.go:312-325);
+- Serve runs the availability state machine faithfully: listen on a
+  timestamped socket under the kubelet plugin dir, register, then poll —
+  1s for socket deletion (kubelet restart → re-register), 10s for hotplug
+  (new chips → rediscover + restart) (manager.go:410-499).
+"""
+
+import concurrent.futures
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import grpc
+
+from container_engine_accelerators_tpu.deviceplugin import api
+from container_engine_accelerators_tpu.partition.subslice import (
+    SubsliceDeviceManager,
+)
+from container_engine_accelerators_tpu.sharing import (
+    SharingStrategy,
+    virtual_device_ids,
+    virtual_to_physical_device_id,
+)
+from container_engine_accelerators_tpu.tpulib.types import TpuLib
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+from container_engine_accelerators_tpu.utils.device import (
+    HEALTHY,
+    Device,
+    DeviceSpec,
+    Mount,
+)
+
+from container_engine_accelerators_tpu.utils.devname import DEVICE_RE as TPU_DEVICE_RE
+
+log = logging.getLogger(__name__)
+
+VFIO_CONTROL_DEVICE = "vfio/vfio"
+
+DEVICE_CHECK_INTERVAL_S = 10.0  # hotplug poll (gpuCheckInterval)
+SOCKET_CHECK_INTERVAL_S = 1.0  # kubelet-restart poll (pluginSocketCheckInterval)
+
+CORE_PERCENTAGE_ENV = "TPU_CORE_PERCENTAGE"
+HBM_LIMIT_ENV = "TPU_HBM_LIMIT_BYTES"
+MEM_FRACTION_ENV = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+
+
+class TpuManager:
+    def __init__(
+        self,
+        dev_directory: str,
+        mount_paths: List[Mount],
+        config: TPUConfig,
+        lib: Optional[TpuLib] = None,
+        resource_name: str = "google.com/tpu",
+        device_check_interval_s: float = DEVICE_CHECK_INTERVAL_S,
+        socket_check_interval_s: float = SOCKET_CHECK_INTERVAL_S,
+    ):
+        self.dev_directory = dev_directory
+        self.mount_paths = list(mount_paths)
+        self.config = config
+        self.lib = lib
+        self.resource_name = resource_name
+        self.devices: Dict[str, Device] = {}
+        self.devices_mutex = threading.Lock()
+        self.default_devices: List[str] = []
+        self.health_events: "queue.Queue[Device]" = queue.Queue()
+        self.subslice_manager = (
+            SubsliceDeviceManager(lib, dev_directory) if lib is not None else None
+        )
+        self.total_hbm_per_chip = 0
+        self.grpc_server: Optional[grpc.Server] = None
+        self.socket: str = ""
+        self.device_check_interval_s = device_check_interval_s
+        self.socket_check_interval_s = socket_check_interval_s
+        self._stop = threading.Event()
+
+    # ---- discovery ---------------------------------------------------------
+
+    def check_device_paths(self) -> bool:
+        """Installer handshake: at least one TPU device node must exist
+        (the reference waits on /dev/nvidiactl + nvidia-uvm,
+        nvidia_gpu.go:99-109)."""
+        return self._discover_num_chips() > 0
+
+    def _discover_num_chips(self) -> int:
+        try:
+            entries = os.listdir(self.dev_directory)
+        except OSError as e:
+            log.error("cannot read %s: %s", self.dev_directory, e)
+            return 0
+        return sum(1 for f in entries if TPU_DEVICE_RE.match(f))
+
+    def discover_chips(self) -> None:
+        for f in sorted(os.listdir(self.dev_directory)):
+            if TPU_DEVICE_RE.match(f):
+                log.debug("Found TPU chip %r", f)
+                self.set_device_health(f, HEALTHY)
+
+    def has_additional_chips_installed(self) -> bool:
+        with self.devices_mutex:
+            original = len(self.devices)
+        return self._discover_num_chips() > original
+
+    def start(self) -> None:
+        """Discover devices and set up the node environment
+        (ref: manager.go:354-388)."""
+        self.default_devices = []
+        vfio_ctl = os.path.join(self.dev_directory, VFIO_CONTROL_DEVICE)
+        if os.path.exists(vfio_ctl):
+            self.default_devices.append(vfio_ctl)
+
+        self.discover_chips()
+
+        if self.config.partition_size:
+            if self.subslice_manager is None:
+                raise RuntimeError(
+                    "partitioning requires a tpulib backend for topology"
+                )
+            self.subslice_manager.start(self.config.partition_size)
+
+        if self.config.sharing.strategy == SharingStrategy.CORE_SHARING:
+            if self.lib is None or self.lib.chip_count() <= 0:
+                raise RuntimeError("core-sharing requires TPU chips on the node")
+            first_chip = self.lib.chips()[0].name
+            self.total_hbm_per_chip = self.lib.hbm_info(first_chip).total_bytes
+            if self.total_hbm_per_chip <= 0:
+                # Without a known HBM size the co-tenancy env contract would
+                # silently become "no limits"; refuse to start instead.
+                raise RuntimeError(
+                    f"core-sharing requires a valid hbm_total_bytes for "
+                    f"{first_chip}; node sysfs contract is incomplete"
+                )
+
+    # ---- device views ------------------------------------------------------
+
+    def list_physical_devices(self) -> Dict[str, Device]:
+        """Snapshot of physical devices (copy: gRPC worker threads iterate
+        this concurrently with hotplug rediscovery on the serve thread)."""
+        with self.devices_mutex:
+            if not self.config.partition_size:
+                return dict(self.devices)
+            return dict(self.subslice_manager.list_partition_devices())
+
+    def list_devices(self) -> Dict[str, Device]:
+        physical = self.list_physical_devices()
+        max_clients = self.config.sharing.max_shared_clients_per_tpu
+        if max_clients > 0:
+            virtual: Dict[str, Device] = {}
+            for dev in physical.values():
+                # Virtual devices inherit health from their physical device.
+                for vid in virtual_device_ids(dev.id, max_clients):
+                    virtual[vid] = Device(id=vid, health=dev.health)
+            return virtual
+        return physical
+
+    def list_health_critical_codes(self) -> List[int]:
+        return self.config.health_critical_codes
+
+    def set_device_health(self, name: str, health: str) -> None:
+        with self.devices_mutex:
+            if TPU_DEVICE_RE.match(name):
+                self.devices[name] = Device(id=name, health=health)
+                # A chip fault takes down the sub-slice that owns the chip.
+                if self.config.partition_size and self.subslice_manager:
+                    slice_id = self.subslice_manager.slice_for_chip(name)
+                    if slice_id is not None and health != HEALTHY:
+                        self.subslice_manager.set_device_health(slice_id, health)
+            elif self.subslice_manager is not None:
+                self.subslice_manager.set_device_health(name, health)
+
+    # ---- allocate path -----------------------------------------------------
+
+    def device_spec(self, device_id: str) -> List[DeviceSpec]:
+        """Map one requested device ID to its device nodes
+        (ref: manager.go:201-228)."""
+        if self.config.sharing.max_shared_clients_per_tpu > 0:
+            device_id = virtual_to_physical_device_id(device_id)
+        if self.config.partition_size:
+            with self.devices_mutex:
+                return self.subslice_manager.device_spec(device_id)
+        with self.devices_mutex:
+            dev = self.devices.get(device_id)
+        if dev is None:
+            raise ValueError(
+                f"invalid allocation request with non-existing device {device_id}"
+            )
+        if dev.health != HEALTHY:
+            raise ValueError(
+                f"invalid allocation request with unhealthy device {device_id}"
+            )
+        node = os.path.join(self.dev_directory, device_id)
+        return [DeviceSpec(host_path=node, container_path=node, permissions="mrw")]
+
+    def envs(self, request_device_ids: List[str]) -> Dict[str, str]:
+        """Env contract for a container allocation.
+
+        core-sharing: TensorCore fraction + HBM limit, the MPS-env analog
+        (ref: manager.go:312-325).  Partitioned: sub-slice topology env so
+        libtpu/JAX sees the right chip set and mesh bounds.
+        """
+        envs: Dict[str, str] = {}
+        n = len(request_device_ids)
+        if (
+            self.config.sharing.strategy == SharingStrategy.CORE_SHARING
+            and self.total_hbm_per_chip > 0
+        ):
+            max_clients = self.config.sharing.max_shared_clients_per_tpu
+            core_pct = n * 100 // max_clients
+            hbm_limit = n * self.total_hbm_per_chip // max_clients
+            envs[CORE_PERCENTAGE_ENV] = str(core_pct)
+            envs[HBM_LIMIT_ENV] = str(hbm_limit)
+            envs[MEM_FRACTION_ENV] = f"{n / max_clients:.4f}"
+        if self.config.partition_size and request_device_ids:
+            phys = request_device_ids[0]
+            if self.config.sharing.max_shared_clients_per_tpu > 0:
+                phys = virtual_to_physical_device_id(phys)
+            envs.update(self.subslice_manager.envs(phys))
+        return envs
+
+    # ---- serve state machine ----------------------------------------------
+
+    def serve(
+        self,
+        plugin_mount_path: str,
+        kubelet_endpoint: str = api.KUBELET_SOCKET,
+        plugin_endpoint: Optional[str] = None,
+    ) -> None:
+        """Availability state machine (ref: manager.go:410-499): (re)create
+        the plugin socket, serve gRPC, register with the kubelet, then watch
+        for socket deletion (1s) and chip hotplug (10s); either tears the
+        server down and restarts the loop."""
+        from container_engine_accelerators_tpu.deviceplugin.service import (
+            DevicePluginService,
+        )
+
+        register_with_kubelet = os.path.exists(
+            os.path.join(plugin_mount_path, kubelet_endpoint)
+        )
+        log.info(
+            "kubelet socket %s; registration %s",
+            os.path.join(plugin_mount_path, kubelet_endpoint),
+            "enabled" if register_with_kubelet else "disabled",
+        )
+
+        while not self._stop.is_set():
+            endpoint = plugin_endpoint or f"tpu-{int(time.time())}.sock"
+            endpoint_path = os.path.join(plugin_mount_path, endpoint)
+            if os.path.exists(endpoint_path):
+                os.unlink(endpoint_path)
+            log.info("starting device-plugin server at: %s", endpoint_path)
+
+            server = grpc.server(
+                concurrent.futures.ThreadPoolExecutor(max_workers=4)
+            )
+            api.add_device_plugin_servicer(server, DevicePluginService(self))
+            server.add_insecure_port(f"unix:{endpoint_path}")
+            server.start()
+            self.grpc_server = server
+            self.socket = endpoint_path
+
+            try:
+                if register_with_kubelet:
+                    api.register_with_v1beta1_kubelet(
+                        os.path.join(plugin_mount_path, kubelet_endpoint),
+                        endpoint,
+                        self.resource_name,
+                    )
+                    log.info("device-plugin registered with the kubelet")
+
+                self._status_check(endpoint_path)
+            finally:
+                server.stop(grace=1).wait()
+                self.grpc_server = None
+
+    def _status_check(self, endpoint_path: str) -> None:
+        last_device_check = time.monotonic()
+        while not self._stop.is_set():
+            if self._stop.wait(self.socket_check_interval_s):
+                return
+            # Socket vanished ⇒ kubelet restarted and wiped the plugin dir;
+            # tear down and re-register (manager.go:475-481).
+            if not os.path.lexists(endpoint_path):
+                log.info("plugin socket %s deleted; restarting", endpoint_path)
+                return
+            if time.monotonic() - last_device_check >= self.device_check_interval_s:
+                last_device_check = time.monotonic()
+                if self.has_additional_chips_installed():
+                    log.info("new TPU chips found; rediscovering + restarting")
+                    # Full re-start: rediscovers chips AND recomputes
+                    # sub-slice partitions / default devices / HBM totals —
+                    # discover_chips() alone would leave a stale partition
+                    # table advertised to the kubelet.
+                    try:
+                        self.start()
+                    except Exception as e:
+                        log.error("rediscovery failed: %s; will retry", e)
+                    return
+
+    def stop(self) -> None:
+        if self.socket and os.path.exists(self.socket):
+            os.unlink(self.socket)
+        self._stop.set()
+        if self.grpc_server is not None:
+            self.grpc_server.stop(grace=1)

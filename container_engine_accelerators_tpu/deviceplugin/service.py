@@ -1,0 +1,115 @@
+"""DevicePlugin v1beta1 gRPC service implementation.
+
+TPU-native port of the reference's pluginServiceV1Beta1
+(ref: pkg/gpu/nvidia/beta_plugin.go:35-103): ListAndWatch streams the
+device list and re-sends it on every health transition; Allocate validates
+sharing, maps device IDs to device nodes, and attaches default devices,
+library mounts, and the env contract.  PreStartContainer and
+GetPreferredAllocation are intentionally no-ops (beta_plugin.go:95-103).
+"""
+
+import logging
+import queue
+
+import grpc
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    deviceplugin_v1beta1_pb2 as pb,
+)
+from container_engine_accelerators_tpu.sharing import validate_request
+
+log = logging.getLogger(__name__)
+
+_HEALTH_POLL_S = 0.5
+
+
+class DevicePluginService:
+    def __init__(self, manager):
+        self.manager = manager
+
+    # -- small RPCs ----------------------------------------------------------
+
+    def GetDevicePluginOptions(self, request, context):
+        return pb.DevicePluginOptions()
+
+    def PreStartContainer(self, request, context):
+        log.error(
+            "device-plugin: PreStart should NOT be called for the GKE TPU "
+            "device plugin"
+        )
+        return pb.PreStartContainerResponse()
+
+    def GetPreferredAllocation(self, request, context):
+        log.error(
+            "device-plugin: GetPreferredAllocation should NOT be called for "
+            "the GKE TPU device plugin"
+        )
+        return pb.PreferredAllocationResponse()
+
+    # -- ListAndWatch --------------------------------------------------------
+
+    def _device_list_response(self) -> pb.ListAndWatchResponse:
+        resp = pb.ListAndWatchResponse()
+        for dev in self.manager.list_devices().values():
+            resp.devices.append(pb.Device(ID=dev.id, health=dev.health))
+        return resp
+
+    def ListAndWatch(self, request, context):
+        log.info("device-plugin: ListAndWatch start")
+        yield self._device_list_response()
+        while context.is_active():
+            try:
+                d = self.manager.health_events.get(timeout=_HEALTH_POLL_S)
+            except queue.Empty:
+                continue
+            log.info("device-plugin: %s device marked as %s", d.id, d.health)
+            self.manager.set_device_health(d.id, d.health)
+            yield self._device_list_response()
+
+    # -- Allocate ------------------------------------------------------------
+
+    def Allocate(self, request, context):
+        resps = pb.AllocateResponse()
+        for rqt in request.container_requests:
+            try:
+                validate_request(
+                    list(rqt.devicesIDs),
+                    len(self.manager.list_physical_devices()),
+                    self.manager.config.sharing.strategy,
+                )
+                resp = pb.ContainerAllocateResponse()
+                seen_nodes = set()
+                for device_id in rqt.devicesIDs:
+                    for spec in self.manager.device_spec(device_id):
+                        # Multiple vtpus / sub-slices can map to the same
+                        # node; inject each node once.
+                        if spec.host_path in seen_nodes:
+                            continue
+                        seen_nodes.add(spec.host_path)
+                        resp.devices.append(
+                            pb.DeviceSpec(
+                                host_path=spec.host_path,
+                                container_path=spec.container_path,
+                                permissions=spec.permissions,
+                            )
+                        )
+                for d in self.manager.default_devices:
+                    resp.devices.append(
+                        pb.DeviceSpec(
+                            host_path=d, container_path=d, permissions="mrw"
+                        )
+                    )
+                for m in self.manager.mount_paths:
+                    resp.mounts.append(
+                        pb.Mount(
+                            host_path=m.host_path,
+                            container_path=m.container_path,
+                            read_only=m.read_only,
+                        )
+                    )
+                for k, v in self.manager.envs(list(rqt.devicesIDs)).items():
+                    resp.envs[k] = v
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            resps.container_responses.append(resp)
+        return resps

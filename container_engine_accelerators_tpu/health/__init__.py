@@ -1,0 +1,6 @@
+from container_engine_accelerators_tpu.health.health_checker import (
+    TpuHealthChecker,
+    DEFAULT_CRITICAL_CODES,
+)
+
+__all__ = ["TpuHealthChecker", "DEFAULT_CRITICAL_CODES"]

@@ -1,0 +1,113 @@
+"""TPU health checker: error-event stream → Unhealthy devices.
+
+TPU-native port of the reference's NVML Xid health checker
+(ref: pkg/gpu/nvidia/health_check/health_checker.go:31-245).  The event
+source is tpulib's error-event stream (driver/runtime fault queue) instead
+of NVML's Xid events; the state machine is the same:
+
+- only *critical* codes flip a device to Unhealthy; the default set plus
+  any codes from node config / TPU_ERR_CONFIG (health_checker.go:40-62);
+- an event with no device attribution marks ALL devices Unhealthy
+  (health_checker.go:192-201);
+- transitions are pushed into the manager's health queue, which
+  ListAndWatch drains and re-announces to the kubelet
+  (beta_plugin.go:39-54).
+
+TPU error code registry (ours; the Xid-number analog):
+  48  HBM uncorrectable ECC error          (critical by default, like Xid 48)
+  63  ICI link fatal error
+  72  TensorCore hang / watchdog timeout
+  31  invalid HBM memory access            (the Xid-31 fault-injection demo)
+  13  program abort (user error)           (non-critical by default)
+"""
+
+import logging
+import threading
+from typing import Iterable, Optional, Set
+
+from container_engine_accelerators_tpu.tpulib.types import TpuErrorEvent, TpuLib
+from container_engine_accelerators_tpu.utils.device import UNHEALTHY, Device
+
+log = logging.getLogger(__name__)
+
+DEFAULT_CRITICAL_CODES = frozenset({48})
+EVENT_WAIT_TIMEOUT_S = 5.0  # nvml.WaitForEvent(5000) analog
+
+
+class TpuHealthChecker:
+    def __init__(
+        self,
+        manager,
+        lib: TpuLib,
+        critical_codes: Optional[Iterable[int]] = None,
+    ):
+        self.manager = manager
+        self.lib = lib
+        self.critical_codes: Set[int] = set(DEFAULT_CRITICAL_CODES)
+        self.critical_codes.update(critical_codes or [])
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        log.info(
+            "starting TPU health checker; critical codes: %s",
+            sorted(self.critical_codes),
+        )
+        self._thread = threading.Thread(
+            target=self._listen_to_events, name="tpu-health", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * EVENT_WAIT_TIMEOUT_S)
+
+    # -- event loop ----------------------------------------------------------
+
+    def _listen_to_events(self) -> None:
+        while not self._stop.is_set():
+            event = self.lib.wait_for_event(EVENT_WAIT_TIMEOUT_S)
+            if event is None:
+                continue
+            self.catch_error(event)
+
+    def catch_error(self, event: TpuErrorEvent) -> None:
+        """Decide which devices an event takes down
+        (ref: health_checker.go:179-226).  Public so tests can feed
+        synthetic events, like the reference's catchError tests."""
+        if event.code not in self.critical_codes:
+            log.info(
+                "TPU error code %d is not critical; skipping (device=%s, %s)",
+                event.code,
+                event.device,
+                event.message,
+            )
+            return
+        if event.device is None:
+            log.error(
+                "critical TPU error %d with no device attribution: marking "
+                "ALL devices unhealthy (%s)",
+                event.code,
+                event.message,
+            )
+            for name in list(self.manager.devices):
+                self._mark_unhealthy(name)
+            return
+        if event.device not in self.manager.devices:
+            log.warning(
+                "critical TPU error %d for unknown device %r; ignoring",
+                event.code,
+                event.device,
+            )
+            return
+        log.error(
+            "critical TPU error %d on %s: %s",
+            event.code,
+            event.device,
+            event.message,
+        )
+        self._mark_unhealthy(event.device)
+
+    def _mark_unhealthy(self, name: str) -> None:
+        self.manager.health_events.put(Device(id=name, health=UNHEALTHY))

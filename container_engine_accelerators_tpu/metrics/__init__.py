@@ -1,0 +1,3 @@
+from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+
+__all__ = ["MetricServer"]

@@ -1,0 +1,77 @@
+"""Container → TPU device assignment via the kubelet PodResources API.
+
+Port of the reference's devices.go (pkg/gpu/nvidia/metrics/devices.go:33-100):
+dial the kubelet's pod-resources unix socket, List all pods, and collect the
+``google.com/tpu`` device IDs each container was allocated.  Virtual
+(shared) device IDs are skipped, like the reference skips vgpu IDs
+(devices.go:86-92) — per-container accounting is meaningless when the chip
+is shared.
+"""
+
+import dataclasses
+import logging
+from typing import Dict, List
+
+import grpc
+
+from container_engine_accelerators_tpu.metrics import podresources_v1_pb2 as pb
+from container_engine_accelerators_tpu.sharing import is_virtual_device_id
+
+log = logging.getLogger(__name__)
+
+POD_RESOURCES_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
+TPU_RESOURCE_NAME = "google.com/tpu"
+
+
+@dataclasses.dataclass(frozen=True)
+class ContainerID:
+    namespace: str
+    pod: str
+    container: str
+
+
+class PodResourcesClient:
+    """Thin client over the PodResourcesLister service."""
+
+    def __init__(self, socket_path: str = POD_RESOURCES_SOCKET):
+        self.socket_path = socket_path
+
+    def list_pods(self) -> pb.ListPodResourcesResponse:
+        with grpc.insecure_channel(f"unix:{self.socket_path}") as channel:
+            lister = channel.unary_unary(
+                "/v1.PodResourcesLister/List",
+                request_serializer=pb.ListPodResourcesRequest.SerializeToString,
+                response_deserializer=pb.ListPodResourcesResponse.FromString,
+            )
+            return lister(pb.ListPodResourcesRequest(), timeout=10)
+
+    def get_devices_for_all_containers(
+        self, resource_name: str = TPU_RESOURCE_NAME
+    ) -> Dict[ContainerID, List[str]]:
+        """Map each container to its allocated physical TPU device IDs
+        (ref: devices.go:51-100)."""
+        out: Dict[ContainerID, List[str]] = {}
+        resp = self.list_pods()
+        for pod in resp.pod_resources:
+            for container in pod.containers:
+                device_ids: List[str] = []
+                for dev in container.devices:
+                    if dev.resource_name != resource_name:
+                        continue
+                    for device_id in dev.device_ids:
+                        if is_virtual_device_id(device_id):
+                            log.debug(
+                                "skipping virtual device %s for metrics",
+                                device_id,
+                            )
+                            continue
+                        device_ids.append(device_id)
+                if device_ids:
+                    out[
+                        ContainerID(
+                            namespace=pod.namespace,
+                            pod=pod.name,
+                            container=container.name,
+                        )
+                    ] = device_ids
+        return out

@@ -1,0 +1,220 @@
+"""Prometheus metrics server for TPU nodes.
+
+Port of the reference's metrics server (pkg/gpu/nvidia/metrics/metrics.go):
+the same load-bearing gauge set — the serving demo's HPA scales on
+``duty_cycle`` (demo/serving/tensorflow-serving.yaml:63-79) — with TPU
+sources: TensorCore duty cycle and HBM occupancy come from tpulib counters
+instead of NVML sampling (metrics.go:59-115, util.go:37-94).
+
+Per-container gauges join device assignments through the kubelet
+PodResources API; per-node gauges cover every chip.  The registry is fully
+reset periodically so pods that vanish stop being reported
+(metrics.go:241-253).
+
+Exported gauges (container): duty_cycle, memory_total, memory_used, request
+           (node):           duty_cycle_tpu_node, memory_total_tpu_node,
+                             memory_used_tpu_node
+"""
+
+import logging
+import threading
+import time
+from typing import Optional, Tuple
+
+from prometheus_client import CollectorRegistry, Gauge, start_http_server
+
+from container_engine_accelerators_tpu.metrics.devices import (
+    POD_RESOURCES_SOCKET,
+    PodResourcesClient,
+    TPU_RESOURCE_NAME,
+)
+from container_engine_accelerators_tpu.tpulib.types import HbmInfo, TpuLib
+
+log = logging.getLogger(__name__)
+
+MAKE = "google"
+RESET_INTERVAL_S = 60.0  # metricsResetInterval analog
+
+_CONTAINER_LABELS = [
+    "namespace",
+    "pod",
+    "container",
+    "make",
+    "accelerator_id",
+    "model",
+]
+_NODE_LABELS = ["make", "accelerator_id", "model"]
+
+
+class TpuMetricsCollector:
+    """Sampling seam (the reference's metricsCollector interface,
+    metrics.go:29-35): tests substitute a mock."""
+
+    def __init__(self, lib: TpuLib):
+        self.lib = lib
+
+    def collect_tpu_device(self, device_name: str) -> Tuple[int, HbmInfo]:
+        return (
+            self.lib.duty_cycle(device_name),
+            self.lib.hbm_info(device_name),
+        )
+
+    def devices(self):
+        return [c.name for c in self.lib.chips()]
+
+    def model(self, device_name: str) -> str:
+        lib = self.lib
+        attr = getattr(lib, "_attr", None)
+        if attr is not None:
+            try:
+                return attr(device_name, "model", default="tpu")
+            except Exception:
+                return "tpu"
+        return "tpu"
+
+
+class MetricServer:
+    def __init__(
+        self,
+        lib: Optional[TpuLib] = None,
+        manager=None,
+        port: int = 2112,
+        collection_interval_s: float = 30.0,
+        pod_resources_socket: str = POD_RESOURCES_SOCKET,
+        collector: Optional[TpuMetricsCollector] = None,
+        registry: Optional[CollectorRegistry] = None,
+    ):
+        self.collector = collector or TpuMetricsCollector(lib)
+        self.manager = manager
+        self.port = port
+        self.collection_interval_s = collection_interval_s
+        self.pod_resources = PodResourcesClient(pod_resources_socket)
+        self.registry = registry or CollectorRegistry()
+        self._stop = threading.Event()
+        self._last_reset = time.monotonic()
+
+        g = lambda name, doc, labels: Gauge(  # noqa: E731
+            name, doc, labels, registry=self.registry
+        )
+        self.duty_cycle = g(
+            "duty_cycle",
+            "Percent of time over the past sample period during which the "
+            "accelerator was actively processing",
+            _CONTAINER_LABELS,
+        )
+        self.memory_total = g(
+            "memory_total", "Total accelerator memory (bytes)", _CONTAINER_LABELS
+        )
+        self.memory_used = g(
+            "memory_used", "Allocated accelerator memory (bytes)", _CONTAINER_LABELS
+        )
+        self.request = g(
+            "request",
+            "Number of accelerator devices requested by the container",
+            ["namespace", "pod", "container", "resource_name"],
+        )
+        self.duty_cycle_node = g(
+            "duty_cycle_tpu_node",
+            "Node-level TPU duty cycle",
+            _NODE_LABELS,
+        )
+        self.memory_total_node = g(
+            "memory_total_tpu_node", "Node-level total HBM (bytes)", _NODE_LABELS
+        )
+        self.memory_used_node = g(
+            "memory_used_tpu_node", "Node-level used HBM (bytes)", _NODE_LABELS
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        start_http_server(self.port, registry=self.registry)
+        t = threading.Thread(
+            target=self._collect_loop, name="tpu-metrics", daemon=True
+        )
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _collect_loop(self) -> None:
+        while not self._stop.wait(self.collection_interval_s):
+            try:
+                self.collect_once()
+            except Exception as e:
+                log.error("metrics collection failed: %s", e)
+
+    # -- collection ----------------------------------------------------------
+
+    def _reset(self) -> None:
+        for gauge in (
+            self.duty_cycle,
+            self.memory_total,
+            self.memory_used,
+            self.request,
+            self.duty_cycle_node,
+            self.memory_total_node,
+            self.memory_used_node,
+        ):
+            gauge.clear()
+
+    def _chips_for(self, device_id: str):
+        """A physical device ID is a chip (accelN) or a sub-slice (sliceM);
+        expand to member chips for per-chip sampling."""
+        if device_id.startswith("slice") and self.manager is not None:
+            sm = self.manager.subslice_manager
+            if sm is not None and device_id in sm._members:
+                return [c.name for c in sm._members[device_id]]
+            return []
+        return [device_id]
+
+    def collect_once(self) -> None:
+        now = time.monotonic()
+        if now - self._last_reset >= RESET_INTERVAL_S:
+            self._reset()
+            self._last_reset = now
+
+        try:
+            container_devices = self.pod_resources.get_devices_for_all_containers()
+        except Exception as e:
+            log.warning("pod-resources query failed: %s", e)
+            container_devices = {}
+
+        for cid, device_ids in container_devices.items():
+            self.request.labels(
+                namespace=cid.namespace,
+                pod=cid.pod,
+                container=cid.container,
+                resource_name=TPU_RESOURCE_NAME,
+            ).set(len(device_ids))
+            for device_id in device_ids:
+                for chip in self._chips_for(device_id):
+                    try:
+                        duty, hbm = self.collector.collect_tpu_device(chip)
+                    except Exception as e:
+                        log.warning("sampling %s failed: %s", chip, e)
+                        continue
+                    labels = dict(
+                        namespace=cid.namespace,
+                        pod=cid.pod,
+                        container=cid.container,
+                        make=MAKE,
+                        accelerator_id=chip,
+                        model=self.collector.model(chip),
+                    )
+                    self.duty_cycle.labels(**labels).set(duty)
+                    self.memory_total.labels(**labels).set(hbm.total_bytes)
+                    self.memory_used.labels(**labels).set(hbm.used_bytes)
+
+        for chip in self.collector.devices():
+            try:
+                duty, hbm = self.collector.collect_tpu_device(chip)
+            except Exception as e:
+                log.warning("sampling %s failed: %s", chip, e)
+                continue
+            labels = dict(
+                make=MAKE, accelerator_id=chip, model=self.collector.model(chip)
+            )
+            self.duty_cycle_node.labels(**labels).set(duty)
+            self.memory_total_node.labels(**labels).set(hbm.total_bytes)
+            self.memory_used_node.labels(**labels).set(hbm.used_bytes)

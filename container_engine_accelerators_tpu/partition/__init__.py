@@ -1,0 +1,6 @@
+from container_engine_accelerators_tpu.partition.subslice import (
+    SubsliceDeviceManager,
+    compute_subslices,
+)
+
+__all__ = ["SubsliceDeviceManager", "compute_subslices"]

@@ -1,0 +1,162 @@
+"""TPU sub-slice partitioning — the MIG analog, TPU-native.
+
+The reference slices one physical A100/H100 into MIG partitions and maps
+each ``nvidiaN/giM`` to three device nodes
+(ref: pkg/gpu/nvidia/mig/mig.go:33-46,73-80,83-212).  A TPU chip is not
+hardware-sliceable; the TPU-native unit of partitioning is the **host ICI
+mesh**: a node with topology ``2x2x1`` (4 chips) can be carved into
+``1x1`` sub-slices (4 single-chip partitions), ``2x1`` (2 partitions), or
+``2x2`` (1 partition).  Each partition:
+
+- is advertised as ONE schedulable ``google.com/tpu`` device ``sliceM``;
+- maps to ALL member ``/dev/accelN`` nodes on Allocate (the MIG
+  one-device→many-nodes shape);
+- carries the env contract that tells libtpu/JAX which chips it owns and
+  their mesh bounds: ``TPU_VISIBLE_DEVICES``,
+  ``TPU_CHIPS_PER_PROCESS_BOUNDS``, ``TPU_PROCESS_BOUNDS``.
+
+Partitions are contiguous axis-aligned boxes tiling the host mesh, so ICI
+links inside a partition are always physically present.
+"""
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from container_engine_accelerators_tpu.tpulib.types import ChipInfo, TpuLib
+from container_engine_accelerators_tpu.utils.device import (
+    HEALTHY,
+    Device,
+    DeviceSpec,
+)
+
+
+def _parse_size(size: str) -> Tuple[int, int, int]:
+    parts = [int(p) for p in size.split("x")]
+    if not parts or any(p <= 0 for p in parts) or len(parts) > 3:
+        raise ValueError(f"invalid partition size {size!r}")
+    while len(parts) < 3:
+        parts.append(1)
+    return tuple(parts)
+
+
+def compute_subslices(
+    chips: List[ChipInfo], partition_size: str
+) -> List[List[ChipInfo]]:
+    """Tile the host mesh with partition-sized boxes; returns chip groups in
+    deterministic slice order.  Partition dims must divide the host bounds
+    (the analog of the MIG partition-size table, mig.go:33-46)."""
+    if not chips:
+        return []
+    bounds = chips[0].topology
+    psize = _parse_size(partition_size)
+    for axis in range(3):
+        if bounds[axis] % psize[axis] != 0:
+            raise ValueError(
+                f"partition size {partition_size!r} does not tile host "
+                f"topology {'x'.join(map(str, bounds))}"
+            )
+    by_coord = {c.coords: c for c in chips}
+    if len(by_coord) != len(chips):
+        raise ValueError("duplicate chip ICI coordinates")
+
+    tiles = []
+    for z0 in range(0, bounds[2], psize[2]):
+        for y0 in range(0, bounds[1], psize[1]):
+            for x0 in range(0, bounds[0], psize[0]):
+                members = []
+                for dz in range(psize[2]):
+                    for dy in range(psize[1]):
+                        for dx in range(psize[0]):
+                            coord = (x0 + dx, y0 + dy, z0 + dz)
+                            chip = by_coord.get(coord)
+                            if chip is None:
+                                raise ValueError(
+                                    f"no chip at ICI coordinate {coord}; "
+                                    f"host reports topology "
+                                    f"{'x'.join(map(str, bounds))}"
+                                )
+                            members.append(chip)
+                tiles.append(members)
+    return tiles
+
+
+class SubsliceDeviceManager:
+    """Discovers sub-slice partitions and serves their device specs/envs.
+
+    Mirrors the two-sided design of the reference's MIG DeviceManager
+    (mig.go:48-80): the partitioner tool programs the layout; this manager
+    discovers it and answers the device plugin's queries.
+    """
+
+    def __init__(self, lib: TpuLib, dev_directory: str):
+        self.lib = lib
+        self.dev_directory = dev_directory
+        self.partition_size = ""
+        self.devices: Dict[str, Device] = {}
+        self._members: Dict[str, List[ChipInfo]] = {}
+
+    def start(self, partition_size: str) -> None:
+        devices: Dict[str, Device] = {}
+        members_map: Dict[str, List[ChipInfo]] = {}
+        if partition_size:
+            tiles = compute_subslices(self.lib.chips(), partition_size)
+            for m, members in enumerate(tiles):
+                slice_id = f"slice{m}"
+                for chip in members:
+                    node = os.path.join(self.dev_directory, chip.name)
+                    if not os.path.exists(node):
+                        raise FileNotFoundError(
+                            f"partition {slice_id} member device node {node} "
+                            f"missing"
+                        )
+                devices[slice_id] = Device(id=slice_id, health=HEALTHY)
+                members_map[slice_id] = members
+        # Swap in fully-built tables so concurrent readers never observe a
+        # half-populated partition map during hotplug re-starts.
+        self.partition_size = partition_size
+        self.devices = devices
+        self._members = members_map
+
+    def list_partition_devices(self) -> Dict[str, Device]:
+        return self.devices
+
+    def set_device_health(self, device_id: str, health: str) -> None:
+        if device_id in self.devices:
+            self.devices[device_id].health = health
+
+    def slice_for_chip(self, chip_name: str) -> Optional[str]:
+        """Which partition owns chip ``accelN`` (for health-event routing)."""
+        for slice_id, members in self._members.items():
+            if any(c.name == chip_name for c in members):
+                return slice_id
+        return None
+
+    def device_spec(self, device_id: str) -> List[DeviceSpec]:
+        dev = self.devices.get(device_id)
+        if dev is None:
+            raise ValueError(
+                f"invalid allocation request with non-existing device {device_id}"
+            )
+        if dev.health != HEALTHY:
+            raise ValueError(
+                f"invalid allocation request with unhealthy device {device_id}"
+            )
+        specs = []
+        for chip in self._members[device_id]:
+            node = os.path.join(self.dev_directory, chip.name)
+            specs.append(
+                DeviceSpec(host_path=node, container_path=node, permissions="mrw")
+            )
+        return specs
+
+    def envs(self, device_id: str) -> Dict[str, str]:
+        """libtpu/JAX topology env for a partition's chips."""
+        members = self._members.get(device_id)
+        if not members:
+            return {}
+        psize = _parse_size(self.partition_size)
+        return {
+            "TPU_VISIBLE_DEVICES": ",".join(str(c.index) for c in members),
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": ",".join(str(p) for p in psize),
+            "TPU_PROCESS_BOUNDS": "1,1,1",
+        }

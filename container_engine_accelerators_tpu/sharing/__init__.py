@@ -1,0 +1,15 @@
+from container_engine_accelerators_tpu.sharing.sharing import (
+    SharingStrategy,
+    is_virtual_device_id,
+    validate_request,
+    virtual_to_physical_device_id,
+    virtual_device_ids,
+)
+
+__all__ = [
+    "SharingStrategy",
+    "is_virtual_device_id",
+    "validate_request",
+    "virtual_to_physical_device_id",
+    "virtual_device_ids",
+]

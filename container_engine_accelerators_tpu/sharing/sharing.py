@@ -1,0 +1,94 @@
+"""TPU sharing: virtual-device ID scheme and request validation.
+
+TPU analog of the reference's GPU-sharing layer
+(ref: pkg/gpu/nvidia/gpusharing/gpusharing.go:23-84).
+
+Two strategies:
+
+- ``time-sharing`` — N virtual devices time-multiplexed onto one chip; a
+  container may claim at most one virtual device (there is no isolation, so
+  claiming several buys nothing).
+- ``core-sharing`` — the MPS analog (SURVEY.md §2.3): co-tenant processes
+  share a chip, each given a TensorCore fraction and an HBM limit through
+  the env contract (TPU_CORE_PERCENTAGE / TPU_HBM_LIMIT_BYTES, computed in
+  the manager).  Multiple virtual devices per request are allowed only on
+  single-chip nodes, mirroring the reference's MPS rule
+  (gpusharing.go:40-50).
+
+Virtual IDs:
+
+- plain chip:  ``accel0/vtpu1``  → physical ``accel0``
+- sub-slice:   ``slice0/vtpu1``  → physical ``slice0``
+  (a sub-slice partition — a contiguous chip group on the host ICI mesh —
+  is treated as one physical device, like a MIG partition in the
+  reference).
+"""
+
+import enum
+import re
+from typing import List, Optional
+
+
+class SharingStrategy(str, enum.Enum):
+    UNDEFINED = ""
+    TIME_SHARING = "time-sharing"
+    CORE_SHARING = "core-sharing"
+
+    @classmethod
+    def parse(cls, value: str) -> "SharingStrategy":
+        # Accept the reference's "mps" spelling as an alias for migrators.
+        if value == "mps":
+            return cls.CORE_SHARING
+        try:
+            return cls(value)
+        except ValueError:
+            raise ValueError(
+                f"invalid TPU sharing strategy: {value!r}, should be one of "
+                f"time-sharing or core-sharing"
+            )
+
+
+_CHIP_VIRTUAL_RE = re.compile(r"^accel([0-9]+)/vtpu([0-9]+)$")
+_SLICE_VIRTUAL_RE = re.compile(r"^slice([0-9]+)/vtpu([0-9]+)$")
+_VTPU_SUFFIX_RE = re.compile(r"/vtpu([0-9]+)$")
+
+
+def is_virtual_device_id(device_id: str) -> bool:
+    return bool(
+        _CHIP_VIRTUAL_RE.match(device_id) or _SLICE_VIRTUAL_RE.match(device_id)
+    )
+
+
+def virtual_to_physical_device_id(virtual_device_id: str) -> str:
+    """``accel0/vtpu1`` → ``accel0``; ``slice2/vtpu1`` → ``slice2``."""
+    if not is_virtual_device_id(virtual_device_id):
+        raise ValueError(f"virtual device ID ({virtual_device_id}) is not valid")
+    return _VTPU_SUFFIX_RE.split(virtual_device_id)[0]
+
+
+def virtual_device_ids(physical_device_id: str, max_clients: int) -> List[str]:
+    """Expand one physical device into its virtual device IDs."""
+    return [f"{physical_device_id}/vtpu{i}" for i in range(max_clients)]
+
+
+def validate_request(
+    request_device_ids: List[str],
+    device_count: int,
+    strategy: Optional[SharingStrategy],
+) -> None:
+    """Reject invalid sharing requests (ref: gpusharing.go:40-50).
+
+    time-sharing: at most one virtual device per request.
+    core-sharing: multiple virtual devices only on single-chip nodes.
+    """
+    if len(request_device_ids) > 1 and is_virtual_device_id(request_device_ids[0]):
+        if strategy == SharingStrategy.TIME_SHARING:
+            raise ValueError(
+                "invalid request for sharing TPU (time-sharing), at most 1 "
+                "google.com/tpu can be requested on TPU-sharing nodes"
+            )
+        if strategy == SharingStrategy.CORE_SHARING and device_count > 1:
+            raise ValueError(
+                "invalid request for sharing TPU (core-sharing), at most 1 "
+                "google.com/tpu can be requested on multi-chip nodes"
+            )

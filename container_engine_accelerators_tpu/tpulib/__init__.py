@@ -1,0 +1,63 @@
+"""tpulib — the NVML-analog device library for TPU nodes.
+
+The reference talks to GPUs through NVML (two Go bindings, go.mod:6-7):
+device enumeration, memory/utilization sampling, and the Xid error-event
+stream.  TPU chips have no NVML; the kernel driver exposes everything the
+node stack needs as a filesystem contract:
+
+    <root>/dev/accelN                            char device per chip
+    <root>/sys/class/accel/accelN/device/
+        chip_id           int
+        pci_addr          "0000:00:05.0"
+        coords            "x,y,z" ICI mesh coordinates of this chip
+        topology          "XxYxZ" host-local mesh bounds (same on all chips)
+        hbm_total_bytes   int
+        hbm_used_bytes    int
+        duty_cycle_pct    int   (0-100 TensorCore busy fraction)
+        health            "ok" | "error:<code>"
+    <root>/var/run/tpu/events/                   error-event queue
+        <seq>.json   {"code": int, "device": "accelN"|null, "message": str}
+
+Two interchangeable backends implement it:
+
+- :class:`~container_engine_accelerators_tpu.tpulib.sysfs.SysfsTpuLib` —
+  pure Python, used by tests and as fallback.
+- :class:`~container_engine_accelerators_tpu.tpulib.native.NativeTpuLib` —
+  ctypes binding over the C++ ``libtpushim.so`` (native/tpushim/), which
+  owns the inotify event loop; the role NVML's C library plays in the
+  reference (pkg/gpu/nvidia/metrics/util.go:17-73).
+
+Tests fabricate the sysfs tree in a tempdir exactly like the reference
+fabricates ``/proc/driver/nvidia/capabilities`` (beta_plugin_test.go:385-439).
+"""
+
+from container_engine_accelerators_tpu.tpulib.types import (
+    ChipInfo,
+    HbmInfo,
+    TpuErrorEvent,
+    TpuLib,
+)
+from container_engine_accelerators_tpu.tpulib.sysfs import SysfsTpuLib, write_fixture
+
+
+def open_lib(root: str = "/", prefer_native: bool = True) -> TpuLib:
+    """Open the best available tpulib backend rooted at ``root``."""
+    if prefer_native:
+        try:
+            from container_engine_accelerators_tpu.tpulib.native import NativeTpuLib
+
+            return NativeTpuLib(root)
+        except (ImportError, OSError):
+            pass
+    return SysfsTpuLib(root)
+
+
+__all__ = [
+    "ChipInfo",
+    "HbmInfo",
+    "TpuErrorEvent",
+    "TpuLib",
+    "SysfsTpuLib",
+    "write_fixture",
+    "open_lib",
+]

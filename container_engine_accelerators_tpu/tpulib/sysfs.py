@@ -1,0 +1,182 @@
+"""Pure-Python tpulib backend over the sysfs contract.
+
+Reads the filesystem layout documented in tpulib/__init__.py.  Event
+consumption is a polling tail of ``<root>/var/run/tpu/events`` (the native
+C++ backend uses inotify instead); consumed events are removed so the
+directory acts as a queue.
+"""
+
+import json
+import logging
+import os
+import time
+from typing import List, Optional, Tuple
+
+from container_engine_accelerators_tpu.tpulib.types import (
+    ChipInfo,
+    HbmInfo,
+    TpuErrorEvent,
+    TpuLib,
+)
+from container_engine_accelerators_tpu.utils.devname import DEVICE_RE as ACCEL_RE
+
+log = logging.getLogger(__name__)
+EVENT_POLL_INTERVAL_S = 0.05
+
+
+def _parse_triple(raw: str, sep: str) -> Tuple[int, int, int]:
+    parts = [int(p) for p in raw.strip().split(sep)]
+    while len(parts) < 3:
+        parts.append(1)
+    return tuple(parts[:3])
+
+
+class SysfsTpuLib(TpuLib):
+    def __init__(self, root: str = "/"):
+        self.root = root
+        self.sys_dir = os.path.join(root, "sys/class/accel")
+        self.events_dir = os.path.join(root, "var/run/tpu/events")
+
+    # -- enumeration --------------------------------------------------------
+
+    def _names(self) -> List[str]:
+        if not os.path.isdir(self.sys_dir):
+            return []
+        names = [n for n in os.listdir(self.sys_dir) if ACCEL_RE.match(n)]
+        return sorted(names, key=lambda n: int(ACCEL_RE.match(n).group(1)))
+
+    def chip_count(self) -> int:
+        return len(self._names())
+
+    def chips(self) -> List[ChipInfo]:
+        return [self.chip_info(n) for n in self._names()]
+
+    def _attr(self, name: str, attr: str, default: Optional[str] = None) -> str:
+        p = os.path.join(self.sys_dir, name, "device", attr)
+        try:
+            with open(p) as f:
+                return f.read().strip()
+        except OSError:
+            if default is not None:
+                return default
+            raise
+
+    def chip_info(self, name: str) -> ChipInfo:
+        m = ACCEL_RE.match(name)
+        if not m:
+            raise ValueError(f"not a TPU chip name: {name!r}")
+        return ChipInfo(
+            name=name,
+            index=int(m.group(1)),
+            chip_id=int(self._attr(name, "chip_id", default="0")),
+            pci_addr=self._attr(name, "pci_addr", default=""),
+            coords=_parse_triple(self._attr(name, "coords", default="0,0,0"), ","),
+            topology=_parse_triple(
+                self._attr(name, "topology", default="1x1x1"), "x"
+            ),
+        )
+
+    # -- sampling -----------------------------------------------------------
+
+    def hbm_info(self, name: str) -> HbmInfo:
+        return HbmInfo(
+            total_bytes=int(self._attr(name, "hbm_total_bytes", default="0")),
+            used_bytes=int(self._attr(name, "hbm_used_bytes", default="0")),
+        )
+
+    def duty_cycle(self, name: str) -> int:
+        return int(self._attr(name, "duty_cycle_pct", default="0"))
+
+    def health(self, name: str) -> str:
+        return self._attr(name, "health", default="ok")
+
+    # -- events -------------------------------------------------------------
+
+    def _next_event_file(self) -> Optional[str]:
+        if not os.path.isdir(self.events_dir):
+            return None
+        entries = sorted(
+            e for e in os.listdir(self.events_dir) if e.endswith(".json")
+        )
+        return os.path.join(self.events_dir, entries[0]) if entries else None
+
+    def wait_for_event(self, timeout_s: float) -> Optional[TpuErrorEvent]:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            path = self._next_event_file()
+            if path is not None:
+                obj = None
+                try:
+                    with open(path) as f:
+                        obj = json.load(f)
+                except OSError:
+                    pass  # racing consumer took it
+                except (json.JSONDecodeError, ValueError, TypeError):
+                    log.warning("discarding malformed TPU event file %s", path)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                if isinstance(obj, dict):
+                    return TpuErrorEvent(
+                        code=int(obj.get("code", -1)),
+                        device=obj.get("device"),
+                        message=obj.get("message", ""),
+                    )
+                # malformed/raced: fall through to the deadline check
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(min(EVENT_POLL_INTERVAL_S, max(0.0, deadline - time.monotonic())))
+
+
+# ---- test-fixture helper ---------------------------------------------------
+
+
+def write_fixture(
+    root: str,
+    num_chips: int,
+    topology: str = "2x2x1",
+    hbm_total: int = 16 * 2**30,
+    with_dev_nodes: bool = True,
+) -> None:
+    """Fabricate the sysfs/dev contract under ``root`` for tests, like the
+    reference fabricates MIG capability trees (beta_plugin_test.go:385-439).
+
+    Chips are laid out row-major over the host topology.
+    """
+    bounds = _parse_triple(topology, "x")
+    os.makedirs(os.path.join(root, "var/run/tpu/events"), exist_ok=True)
+    if with_dev_nodes:
+        os.makedirs(os.path.join(root, "dev"), exist_ok=True)
+    for i in range(num_chips):
+        x = i % bounds[0]
+        y = (i // bounds[0]) % bounds[1]
+        z = i // (bounds[0] * bounds[1])
+        d = os.path.join(root, "sys/class/accel", f"accel{i}", "device")
+        os.makedirs(d, exist_ok=True)
+        attrs = {
+            "chip_id": str(i),
+            "pci_addr": f"0000:00:{4+i:02x}.0",
+            "coords": f"{x},{y},{z}",
+            "topology": topology,
+            "hbm_total_bytes": str(hbm_total),
+            "hbm_used_bytes": "0",
+            "duty_cycle_pct": "0",
+            "health": "ok",
+        }
+        for k, v in attrs.items():
+            with open(os.path.join(d, k), "w") as f:
+                f.write(v + "\n")
+        if with_dev_nodes:
+            open(os.path.join(root, "dev", f"accel{i}"), "w").close()
+
+
+def post_event(root: str, code: int, device: Optional[str], message: str = "") -> None:
+    """Drop an error event into the queue (test + fault-injection helper)."""
+    events = os.path.join(root, "var/run/tpu/events")
+    os.makedirs(events, exist_ok=True)
+    seq = time.monotonic_ns()
+    tmp = os.path.join(events, f".{seq}.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"code": code, "device": device, "message": message}, f)
+    os.rename(tmp, os.path.join(events, f"{seq}.json"))

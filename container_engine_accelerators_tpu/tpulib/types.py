@@ -1,0 +1,63 @@
+"""tpulib data types and backend interface."""
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipInfo:
+    name: str  # "accelN"
+    index: int
+    chip_id: int
+    pci_addr: str
+    coords: Tuple[int, int, int]  # ICI mesh coordinates
+    topology: Tuple[int, int, int]  # host-local mesh bounds
+
+
+@dataclasses.dataclass(frozen=True)
+class HbmInfo:
+    total_bytes: int
+    used_bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuErrorEvent:
+    """A TPU runtime/driver error event — the Xid analog
+    (ref: health_check/health_checker.go:179-226)."""
+
+    code: int
+    device: Optional[str]  # "accelN", or None = whole-node event
+    message: str = ""
+
+
+class TpuLib:
+    """Backend interface; seam for mocks, mirroring the reference's
+    ``callDevice`` interface (health_checker.go:170-177)."""
+
+    def chip_count(self) -> int:
+        raise NotImplementedError
+
+    def chips(self) -> List[ChipInfo]:
+        raise NotImplementedError
+
+    def chip_info(self, name: str) -> ChipInfo:
+        raise NotImplementedError
+
+    def hbm_info(self, name: str) -> HbmInfo:
+        raise NotImplementedError
+
+    def duty_cycle(self, name: str) -> int:
+        """0-100 TensorCore busy percentage (NVML duty-cycle analog)."""
+        raise NotImplementedError
+
+    def health(self, name: str) -> str:
+        """"ok" or "error:<code>"."""
+        raise NotImplementedError
+
+    def wait_for_event(self, timeout_s: float) -> Optional[TpuErrorEvent]:
+        """Block up to timeout_s for the next error event; None on timeout
+        (ref: nvml.WaitForEvent 5000ms poll, health_checker.go:238-243)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,15 @@
+from container_engine_accelerators_tpu.utils.devname import (
+    device_name_from_path,
+    device_path_from_name,
+)
+from container_engine_accelerators_tpu.utils.config import (
+    TPUConfig,
+    TPUSharingConfig,
+)
+
+__all__ = [
+    "device_name_from_path",
+    "device_path_from_name",
+    "TPUConfig",
+    "TPUSharingConfig",
+]

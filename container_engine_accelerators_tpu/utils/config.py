@@ -1,0 +1,162 @@
+"""Node TPU configuration: the three config tiers of the reference, TPU-side.
+
+Tier 2 of the reference's config system is a JSON file the node bootstrap
+drops at ``/etc/nvidia/gpu_config.json``; ours is ``/etc/tpu/tpu_config.json``
+(ref: cmd/nvidia_gpu/nvidia_gpu.go:54-71, pkg/gpu/nvidia/manager.go:68-133).
+Tier 3 is env: the reference reads critical Xid codes from ``XID_CONFIG``;
+we read critical TPU error codes from ``TPU_ERR_CONFIG``.
+
+Schema (accepts both lowerCamel and the reference's Go-style keys):
+
+    {
+      "tpuPartitionSize": "2x2",            # sub-slice topology, MIG analog
+      "tpuSharingConfig": {
+        "tpuSharingStrategy": "time-sharing" | "core-sharing",
+        "maxSharedClientsPerTpu": 4
+      },
+      "healthCriticalCodes": [48]
+    }
+"""
+
+import dataclasses
+import json
+import os
+from typing import List, Optional
+
+from container_engine_accelerators_tpu.sharing import SharingStrategy
+
+# Valid sub-slice partition sizes for a 4-chip (2x2) tray / 8-chip host.
+# TPU analog of the reference's MIG partition-size table (mig.go:33-46).
+VALID_PARTITION_SIZES = ("1x1", "2x1", "2x2", "2x2x1", "2x2x2")
+
+TPU_ERR_CONFIG_ENV = "TPU_ERR_CONFIG"
+
+
+@dataclasses.dataclass
+class TPUSharingConfig:
+    strategy: SharingStrategy = SharingStrategy.UNDEFINED
+    max_shared_clients_per_tpu: int = 0
+
+
+@dataclasses.dataclass
+class TPUConfig:
+    """Settings used to configure the TPUs on a node (ref: manager.go:68-84)."""
+
+    partition_size: str = ""
+    # Deprecated in favor of sharing.  Kept for config-file parity with the
+    # reference's MaxTimeSharedClientsPerGPU (manager.go:71-73).
+    max_time_shared_clients_per_tpu: int = 0
+    sharing: TPUSharingConfig = dataclasses.field(default_factory=TPUSharingConfig)
+    health_critical_codes: List[int] = dataclasses.field(default_factory=list)
+
+    # ---- parsing -----------------------------------------------------------
+
+    @classmethod
+    def from_file(cls, path: str) -> "TPUConfig":
+        """Parse the node config JSON.  Missing file ⇒ empty config, like the
+        reference (nvidia_gpu.go:56-59)."""
+        if not os.path.exists(path):
+            return cls()
+        with open(path) as f:
+            raw = f.read().strip()
+        if not raw:
+            return cls()
+        return cls.from_json(json.loads(raw))
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "TPUConfig":
+        def pick(d, *keys, default=None):
+            for k in keys:
+                if k in d:
+                    return d[k]
+            return default
+
+        sharing_obj = pick(obj, "tpuSharingConfig", "TPUSharingConfig", default={})
+        strategy_raw = pick(
+            sharing_obj, "tpuSharingStrategy", "TPUSharingStrategy", default=""
+        )
+        sharing = TPUSharingConfig(
+            strategy=SharingStrategy.parse(strategy_raw)
+            if strategy_raw
+            else SharingStrategy.UNDEFINED,
+            max_shared_clients_per_tpu=int(
+                pick(
+                    sharing_obj,
+                    "maxSharedClientsPerTpu",
+                    "MaxSharedClientsPerTPU",
+                    default=0,
+                )
+            ),
+        )
+        return cls(
+            partition_size=pick(
+                obj, "tpuPartitionSize", "TPUPartitionSize", default=""
+            ),
+            max_time_shared_clients_per_tpu=int(
+                pick(
+                    obj,
+                    "maxTimeSharedClientsPerTpu",
+                    "MaxTimeSharedClientsPerTPU",
+                    default=0,
+                )
+            ),
+            sharing=sharing,
+            health_critical_codes=list(
+                pick(obj, "healthCriticalCodes", "HealthCriticalCodes", default=[])
+            ),
+        )
+
+    # ---- defaulting / validation ------------------------------------------
+
+    def add_defaults_and_validate(self) -> None:
+        """Defaulting + validation, mirroring manager.go:86-111.
+
+        The deprecated max_time_shared_clients_per_tpu wins over the sharing
+        block when both are set; a strategy requires max clients > 0 and
+        vice versa.
+        """
+        if self.max_time_shared_clients_per_tpu > 0:
+            self.sharing.strategy = SharingStrategy.TIME_SHARING
+            self.sharing.max_shared_clients_per_tpu = (
+                self.max_time_shared_clients_per_tpu
+            )
+        else:
+            s = self.sharing.strategy
+            if s in (SharingStrategy.TIME_SHARING, SharingStrategy.CORE_SHARING):
+                if self.sharing.max_shared_clients_per_tpu <= 0:
+                    raise ValueError(
+                        "maxSharedClientsPerTpu should be > 0 for time-sharing "
+                        "or core-sharing TPU sharing strategies"
+                    )
+            elif s == SharingStrategy.UNDEFINED:
+                if self.sharing.max_shared_clients_per_tpu > 0:
+                    raise ValueError(
+                        "TPU sharing strategy needs to be specified when "
+                        "maxSharedClientsPerTpu > 0"
+                    )
+            else:  # pragma: no cover - parse() already rejects unknowns
+                raise ValueError(f"invalid TPU sharing strategy: {s}")
+
+        if self.partition_size and self.partition_size not in VALID_PARTITION_SIZES:
+            raise ValueError(
+                f"invalid tpuPartitionSize {self.partition_size!r}, "
+                f"should be one of {VALID_PARTITION_SIZES}"
+            )
+
+    def add_health_critical_codes(
+        self, env: Optional[dict] = None
+    ) -> None:
+        """Parse critical error codes from TPU_ERR_CONFIG env (csv ints),
+        mirroring AddHealthCriticalXid (manager.go:113-133)."""
+        env = env if env is not None else os.environ
+        raw = env.get(TPU_ERR_CONFIG_ENV, "")
+        if not raw:
+            return
+        codes = []
+        for part in raw.split(","):
+            part = part.strip()
+            try:
+                codes.append(int(part))
+            except ValueError:
+                raise ValueError(f"Invalid TPU_ERR_CONFIG entry: {part!r}")
+        self.health_critical_codes = codes

@@ -1,0 +1,26 @@
+"""Shared device-plugin data types (kubelet DevicePlugin v1beta1 shapes)."""
+
+import dataclasses
+
+HEALTHY = "Healthy"
+UNHEALTHY = "Unhealthy"
+
+
+@dataclasses.dataclass
+class Device:
+    id: str
+    health: str = HEALTHY
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    host_path: str
+    container_path: str
+    permissions: str = "mrw"
+
+
+@dataclasses.dataclass(frozen=True)
+class Mount:
+    host_path: str
+    container_path: str
+    read_only: bool = False

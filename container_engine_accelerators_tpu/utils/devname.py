@@ -1,0 +1,38 @@
+"""Device-name utilities for TPU accelerator nodes.
+
+TPU VMs expose one char device per chip as ``/dev/accel0`` .. ``/dev/accelN``
+(plus ``/dev/vfio/*`` when bound through vfio).  This is the TPU analog of
+the reference's ``/dev/nvidiaN`` naming helper
+(ref: pkg/gpu/nvidia/util/util.go:22-29).
+"""
+
+import re
+
+DEVICE_RE = re.compile(r"^accel([0-9]+)$")
+DEVICE_PATH_RE = re.compile(r"^/dev/(accel[0-9]+)$")
+
+
+def device_name_from_path(path: str) -> str:
+    """Map ``/dev/accelN`` to the canonical device name ``accelN``.
+
+    Raises ValueError for paths that are not TPU accelerator device nodes.
+    """
+    m = DEVICE_PATH_RE.match(path)
+    if not m:
+        raise ValueError(f"{path!r} is not a TPU device path (/dev/accelN)")
+    return m.group(1)
+
+
+def device_path_from_name(name: str) -> str:
+    """Map canonical device name ``accelN`` to its ``/dev`` path."""
+    if not DEVICE_RE.match(name):
+        raise ValueError(f"{name!r} is not a TPU device name (accelN)")
+    return f"/dev/{name}"
+
+
+def device_index(name: str) -> int:
+    """Return N for device name ``accelN``."""
+    m = DEVICE_RE.match(name)
+    if not m:
+        raise ValueError(f"{name!r} is not a TPU device name (accelN)")
+    return int(m.group(1))

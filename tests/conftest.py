@@ -1,0 +1,39 @@
+"""Test harness setup.
+
+All tests run hardware-free, mirroring the reference's test strategy
+(SURVEY.md §4): the hardware surface is a filesystem layout, so tests fake
+it with tempdirs; JAX-level tests run on a virtual 8-device CPU mesh.
+"""
+
+import os
+import sys
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fake_dev(tmp_path):
+    """A fake /dev tree with TPU device nodes, like the reference's tempdir
+    /dev fixtures (beta_plugin_test.go:244-263)."""
+    dev = tmp_path / "dev"
+    dev.mkdir()
+
+    def make(*names):
+        for n in names:
+            (dev / n).touch()
+        return str(dev)
+
+    make("accel0", "accel1", "accel2", "accel3")
+    return str(dev)

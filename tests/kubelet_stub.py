@@ -1,0 +1,38 @@
+"""KubeletStub — a real gRPC Registration server on a temp unix socket.
+
+Python port of the reference's test double (beta_plugin_test.go:35-69): the
+plugin under test dials this stub's kubelet.sock and Registers; tests then
+dial the plugin's own socket as a DevicePlugin client.
+"""
+
+import concurrent.futures
+import queue
+
+import grpc
+
+from container_engine_accelerators_tpu.deviceplugin import api
+from container_engine_accelerators_tpu.deviceplugin import (
+    deviceplugin_v1beta1_pb2 as pb,
+)
+
+
+class KubeletStub:
+    def __init__(self, socket_path: str):
+        self.socket_path = socket_path
+        self.requests: "queue.Queue[pb.RegisterRequest]" = queue.Queue()
+        self.server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=2)
+        )
+        api.add_registration_servicer(self.server, self)
+        self.server.add_insecure_port(f"unix:{socket_path}")
+
+    # Registration service
+    def Register(self, request, context):
+        self.requests.put(request)
+        return pb.Empty()
+
+    def start(self):
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(grace=0)

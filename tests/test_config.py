@@ -1,0 +1,108 @@
+"""Tests for TPUConfig defaulting/validation (ref: manager_test.go:23-141)."""
+
+import json
+
+import pytest
+
+from container_engine_accelerators_tpu.sharing import SharingStrategy
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+
+
+def test_missing_file_gives_empty_config(tmp_path):
+    cfg = TPUConfig.from_file(str(tmp_path / "nope.json"))
+    cfg.add_defaults_and_validate()
+    assert cfg.partition_size == ""
+    assert cfg.sharing.strategy == SharingStrategy.UNDEFINED
+
+
+def test_parse_full_config(tmp_path):
+    p = tmp_path / "tpu_config.json"
+    p.write_text(
+        json.dumps(
+            {
+                "tpuPartitionSize": "2x2",
+                "tpuSharingConfig": {
+                    "tpuSharingStrategy": "time-sharing",
+                    "maxSharedClientsPerTpu": 4,
+                },
+                "healthCriticalCodes": [48, 63],
+            }
+        )
+    )
+    cfg = TPUConfig.from_file(str(p))
+    cfg.add_defaults_and_validate()
+    assert cfg.partition_size == "2x2"
+    assert cfg.sharing.strategy == SharingStrategy.TIME_SHARING
+    assert cfg.sharing.max_shared_clients_per_tpu == 4
+    assert cfg.health_critical_codes == [48, 63]
+
+
+def test_parse_go_style_keys():
+    cfg = TPUConfig.from_json(
+        {
+            "TPUPartitionSize": "2x1",
+            "TPUSharingConfig": {
+                "TPUSharingStrategy": "core-sharing",
+                "MaxSharedClientsPerTPU": 2,
+            },
+        }
+    )
+    cfg.add_defaults_and_validate()
+    assert cfg.partition_size == "2x1"
+    assert cfg.sharing.strategy == SharingStrategy.CORE_SHARING
+
+
+def test_deprecated_time_shared_field_wins():
+    # Mirrors manager.go:87-95: deprecated field overrides sharing block.
+    cfg = TPUConfig.from_json(
+        {
+            "maxTimeSharedClientsPerTpu": 8,
+            "tpuSharingConfig": {
+                "tpuSharingStrategy": "core-sharing",
+                "maxSharedClientsPerTpu": 2,
+            },
+        }
+    )
+    cfg.add_defaults_and_validate()
+    assert cfg.sharing.strategy == SharingStrategy.TIME_SHARING
+    assert cfg.sharing.max_shared_clients_per_tpu == 8
+
+
+def test_strategy_without_clients_rejected():
+    cfg = TPUConfig.from_json(
+        {"tpuSharingConfig": {"tpuSharingStrategy": "time-sharing"}}
+    )
+    with pytest.raises(ValueError, match="maxSharedClientsPerTpu"):
+        cfg.add_defaults_and_validate()
+
+
+def test_clients_without_strategy_rejected():
+    cfg = TPUConfig.from_json(
+        {"tpuSharingConfig": {"maxSharedClientsPerTpu": 3}}
+    )
+    with pytest.raises(ValueError, match="strategy needs to be specified"):
+        cfg.add_defaults_and_validate()
+
+
+def test_bad_partition_size_rejected():
+    cfg = TPUConfig.from_json({"tpuPartitionSize": "3x7"})
+    with pytest.raises(ValueError, match="tpuPartitionSize"):
+        cfg.add_defaults_and_validate()
+
+
+def test_err_config_env_parse():
+    cfg = TPUConfig()
+    cfg.add_health_critical_codes(env={"TPU_ERR_CONFIG": "32, 79,74"})
+    assert cfg.health_critical_codes == [32, 79, 74]
+
+
+def test_err_config_env_invalid():
+    cfg = TPUConfig()
+    with pytest.raises(ValueError, match="TPU_ERR_CONFIG"):
+        cfg.add_health_critical_codes(env={"TPU_ERR_CONFIG": "32,abc"})
+
+
+def test_err_config_env_absent_keeps_file_codes():
+    cfg = TPUConfig(health_critical_codes=[7])
+    cfg.add_health_critical_codes(env={})
+    assert cfg.health_critical_codes == [7]

@@ -1,0 +1,312 @@
+"""DevicePlugin gRPC integration tests against a KubeletStub.
+
+Python port of the reference's table-driven integration suite
+(beta_plugin_test.go:71-599): fake /dev + sysfs in a tempdir, run the real
+serve loop, register with a stub kubelet, then drive ListAndWatch/Allocate
+as a DevicePlugin client over the plugin's unix socket.  Covers the four
+node configs: plain, time-sharing, partitioned, partitioned+time-sharing —
+plus health transitions and chip hotplug.
+"""
+
+import os
+import threading
+import time
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin import api
+from container_engine_accelerators_tpu.deviceplugin import (
+    deviceplugin_v1beta1_pb2 as pb,
+)
+from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
+from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+from container_engine_accelerators_tpu.utils.device import (
+    HEALTHY,
+    UNHEALTHY,
+    Device,
+    Mount,
+)
+from tests.kubelet_stub import KubeletStub
+
+PLUGIN_ENDPOINT = "tpu-plugin.sock"
+NUM_CHIPS = 4
+
+
+def make_manager(root, config_json=None, num_chips=NUM_CHIPS, topology="2x2x1"):
+    write_fixture(root, num_chips, topology=topology)
+    cfg = TPUConfig.from_json(config_json or {})
+    cfg.add_defaults_and_validate()
+    mounts = [
+        Mount(
+            host_path="/home/kubernetes/bin/tpu",
+            container_path="/usr/local/tpu",
+            read_only=True,
+        )
+    ]
+    return TpuManager(
+        os.path.join(root, "dev"),
+        mounts,
+        cfg,
+        lib=SysfsTpuLib(root),
+        device_check_interval_s=0.3,
+        socket_check_interval_s=0.1,
+    )
+
+
+class PluginHarness:
+    """Runs the real serve loop in a thread next to a KubeletStub."""
+
+    def __init__(self, tmp_path, config_json=None, num_chips=NUM_CHIPS):
+        self.root = str(tmp_path / "root")
+        os.makedirs(self.root)
+        self.plugin_dir = str(tmp_path / "device-plugin")
+        os.makedirs(self.plugin_dir)
+        self.manager = make_manager(self.root, config_json, num_chips)
+        self.stub = KubeletStub(os.path.join(self.plugin_dir, api.KUBELET_SOCKET))
+        self.channel = None
+        self.thread = None
+
+    def __enter__(self):
+        self.stub.start()
+        self.manager.start()
+        self.thread = threading.Thread(
+            target=self.manager.serve,
+            args=(self.plugin_dir,),
+            kwargs={"plugin_endpoint": PLUGIN_ENDPOINT},
+            daemon=True,
+        )
+        self.thread.start()
+        # Wait for registration to prove the plugin is up.
+        self.register_request = self.stub.requests.get(timeout=10)
+        self.channel = grpc.insecure_channel(
+            f"unix:{os.path.join(self.plugin_dir, PLUGIN_ENDPOINT)}"
+        )
+        grpc.channel_ready_future(self.channel).result(timeout=10)
+        self.client = api.DevicePluginClient(self.channel)
+        return self
+
+    def __exit__(self, *exc):
+        if self.channel is not None:
+            self.channel.close()
+        self.manager.stop()
+        self.thread.join(timeout=5)
+        self.stub.stop()
+        return False
+
+    def device_map(self, stream):
+        resp = next(stream)
+        return {d.ID: d.health for d in resp.devices}
+
+
+def allocate_ids(harness, ids):
+    req = pb.AllocateRequest()
+    creq = req.container_requests.add()
+    creq.devicesIDs.extend(ids)
+    return harness.client.allocate(req, timeout=5)
+
+
+# ---- registration ----------------------------------------------------------
+
+
+def test_registers_with_kubelet(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        r = h.register_request
+        assert r.resource_name == "google.com/tpu"
+        assert r.version == "v1beta1"
+        assert r.endpoint == PLUGIN_ENDPOINT
+
+
+# ---- plain config ----------------------------------------------------------
+
+
+def test_list_and_watch_plain(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        stream = h.client.list_and_watch(pb.Empty(), timeout=10)
+        devices = h.device_map(stream)
+        assert devices == {f"accel{i}": HEALTHY for i in range(NUM_CHIPS)}
+
+
+def test_allocate_plain(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        resp = allocate_ids(h, ["accel1", "accel2"])
+        assert len(resp.container_responses) == 1
+        cresp = resp.container_responses[0]
+        paths = sorted(d.host_path for d in cresp.devices)
+        assert paths == [
+            os.path.join(h.root, "dev", "accel1"),
+            os.path.join(h.root, "dev", "accel2"),
+        ]
+        for d in cresp.devices:
+            assert d.container_path == d.host_path
+            assert d.permissions == "mrw"
+        assert len(cresp.mounts) == 1
+        assert cresp.mounts[0].host_path == "/home/kubernetes/bin/tpu"
+        assert cresp.mounts[0].container_path == "/usr/local/tpu"
+        assert cresp.mounts[0].read_only is True
+        assert dict(cresp.envs) == {}
+
+
+def test_allocate_unknown_device_rejected(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        with pytest.raises(grpc.RpcError) as exc_info:
+            allocate_ids(h, ["accel9"])
+        assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_unhealthy_device_flow(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        stream = h.client.list_and_watch(pb.Empty(), timeout=10)
+        assert h.device_map(stream)["accel0"] == HEALTHY
+        # Health checker pushes a transition; ListAndWatch re-announces.
+        h.manager.health_events.put(Device(id="accel0", health=UNHEALTHY))
+        devices = h.device_map(stream)
+        assert devices["accel0"] == UNHEALTHY
+        assert devices["accel1"] == HEALTHY
+        with pytest.raises(grpc.RpcError):
+            allocate_ids(h, ["accel0"])
+
+
+def test_hotplug_restarts_server(tmp_path):
+    """New chip appears → plugin re-registers and advertises it
+    (ref: beta_plugin_test.go:366-377)."""
+    with PluginHarness(tmp_path, num_chips=2) as h:
+        open(os.path.join(h.root, "dev", "accel2"), "w").close()
+        # Expect a re-registration within the device check interval.
+        second = h.stub.requests.get(timeout=10)
+        assert second.resource_name == "google.com/tpu"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ch = grpc.insecure_channel(
+                f"unix:{os.path.join(h.plugin_dir, PLUGIN_ENDPOINT)}"
+            )
+            try:
+                grpc.channel_ready_future(ch).result(timeout=2)
+                stream = api.DevicePluginClient(ch).list_and_watch(
+                    pb.Empty(), timeout=5
+                )
+                devices = h.device_map(stream)
+                ch.close()
+                if "accel2" in devices:
+                    return
+            except grpc.RpcError:
+                ch.close()
+            time.sleep(0.2)
+        pytest.fail("hotplugged accel2 never advertised")
+
+
+def test_socket_deletion_triggers_reregistration(tmp_path):
+    """kubelet restart wipes the plugin dir → plugin re-registers
+    (ref: manager.go:475-481)."""
+    with PluginHarness(tmp_path) as h:
+        os.unlink(os.path.join(h.plugin_dir, PLUGIN_ENDPOINT))
+        second = h.stub.requests.get(timeout=10)
+        assert second.endpoint == PLUGIN_ENDPOINT
+
+
+# ---- time-sharing ----------------------------------------------------------
+
+TIME_SHARING_CONFIG = {
+    "tpuSharingConfig": {
+        "tpuSharingStrategy": "time-sharing",
+        "maxSharedClientsPerTpu": 2,
+    }
+}
+
+
+def test_list_and_watch_time_sharing(tmp_path):
+    with PluginHarness(tmp_path, TIME_SHARING_CONFIG) as h:
+        stream = h.client.list_and_watch(pb.Empty(), timeout=10)
+        devices = h.device_map(stream)
+        assert set(devices) == {
+            f"accel{i}/vtpu{j}" for i in range(NUM_CHIPS) for j in range(2)
+        }
+
+
+def test_allocate_time_sharing(tmp_path):
+    with PluginHarness(tmp_path, TIME_SHARING_CONFIG) as h:
+        resp = allocate_ids(h, ["accel1/vtpu0"])
+        cresp = resp.container_responses[0]
+        assert [d.host_path for d in cresp.devices] == [
+            os.path.join(h.root, "dev", "accel1")
+        ]
+        # Two virtual devices in one request is invalid under time-sharing.
+        with pytest.raises(grpc.RpcError) as exc_info:
+            allocate_ids(h, ["accel1/vtpu0", "accel1/vtpu1"])
+        assert exc_info.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_time_sharing_inherits_health(tmp_path):
+    with PluginHarness(tmp_path, TIME_SHARING_CONFIG) as h:
+        stream = h.client.list_and_watch(pb.Empty(), timeout=10)
+        h.device_map(stream)
+        h.manager.health_events.put(Device(id="accel0", health=UNHEALTHY))
+        devices = h.device_map(stream)
+        assert devices["accel0/vtpu0"] == UNHEALTHY
+        assert devices["accel0/vtpu1"] == UNHEALTHY
+        assert devices["accel1/vtpu0"] == HEALTHY
+
+
+# ---- partitioned (sub-slice) ----------------------------------------------
+
+PARTITION_CONFIG = {"tpuPartitionSize": "2x1"}
+
+
+def test_list_and_watch_partitioned(tmp_path):
+    with PluginHarness(tmp_path, PARTITION_CONFIG) as h:
+        stream = h.client.list_and_watch(pb.Empty(), timeout=10)
+        devices = h.device_map(stream)
+        assert devices == {"slice0": HEALTHY, "slice1": HEALTHY}
+
+
+def test_allocate_partitioned_maps_to_member_chips(tmp_path):
+    with PluginHarness(tmp_path, PARTITION_CONFIG) as h:
+        resp = allocate_ids(h, ["slice0"])
+        cresp = resp.container_responses[0]
+        # 2x1 sub-slice on a 2x2x1 host: slice0 = chips at (0,0),(1,0).
+        assert sorted(d.host_path for d in cresp.devices) == [
+            os.path.join(h.root, "dev", "accel0"),
+            os.path.join(h.root, "dev", "accel1"),
+        ]
+        envs = dict(cresp.envs)
+        assert envs["TPU_VISIBLE_DEVICES"] == "0,1"
+        assert envs["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "2,1,1"
+        assert envs["TPU_PROCESS_BOUNDS"] == "1,1,1"
+
+
+def test_chip_fault_takes_down_owning_slice(tmp_path):
+    with PluginHarness(tmp_path, PARTITION_CONFIG) as h:
+        stream = h.client.list_and_watch(pb.Empty(), timeout=10)
+        h.device_map(stream)
+        h.manager.health_events.put(Device(id="accel3", health=UNHEALTHY))
+        devices = h.device_map(stream)
+        assert devices["slice1"] == UNHEALTHY
+        assert devices["slice0"] == HEALTHY
+
+
+# ---- partitioned + time-sharing -------------------------------------------
+
+PARTITION_SHARING_CONFIG = {
+    "tpuPartitionSize": "2x1",
+    "tpuSharingConfig": {
+        "tpuSharingStrategy": "time-sharing",
+        "maxSharedClientsPerTpu": 2,
+    },
+}
+
+
+def test_partitioned_time_sharing(tmp_path):
+    with PluginHarness(tmp_path, PARTITION_SHARING_CONFIG) as h:
+        stream = h.client.list_and_watch(pb.Empty(), timeout=10)
+        devices = h.device_map(stream)
+        assert set(devices) == {
+            f"slice{i}/vtpu{j}" for i in range(2) for j in range(2)
+        }
+        resp = allocate_ids(h, ["slice1/vtpu1"])
+        cresp = resp.container_responses[0]
+        assert sorted(d.host_path for d in cresp.devices) == [
+            os.path.join(h.root, "dev", "accel2"),
+            os.path.join(h.root, "dev", "accel3"),
+        ]
+        assert dict(cresp.envs)["TPU_VISIBLE_DEVICES"] == "2,3"

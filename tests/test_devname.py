@@ -1,0 +1,24 @@
+"""Tests for device-name utilities (ref: pkg/gpu/nvidia/util/util_test.go)."""
+
+import pytest
+
+from container_engine_accelerators_tpu.utils.devname import (
+    device_index,
+    device_name_from_path,
+    device_path_from_name,
+)
+
+
+def test_roundtrip():
+    assert device_name_from_path("/dev/accel0") == "accel0"
+    assert device_name_from_path("/dev/accel15") == "accel15"
+    assert device_path_from_name("accel3") == "/dev/accel3"
+    assert device_index("accel7") == 7
+
+
+@pytest.mark.parametrize(
+    "bad", ["/dev/accel", "/dev/nvidia0", "accel0", "/dev/accel0x", "/dev/vfio/3"]
+)
+def test_bad_paths_rejected(bad):
+    with pytest.raises(ValueError):
+        device_name_from_path(bad)

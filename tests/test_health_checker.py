@@ -1,0 +1,89 @@
+"""Health checker unit tests (ref: health_check/health_checker_test.go:31-243).
+
+Synthetic events are fed to catch_error directly; assertions check which
+devices flip Unhealthy: non-critical skipped, unknown-device skipped,
+device-less event ⇒ ALL unhealthy.
+"""
+
+import os
+import queue
+
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
+from container_engine_accelerators_tpu.health import TpuHealthChecker
+from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.tpulib.sysfs import post_event
+from container_engine_accelerators_tpu.tpulib.types import TpuErrorEvent
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+from container_engine_accelerators_tpu.utils.device import UNHEALTHY
+
+
+@pytest.fixture
+def manager(tmp_path):
+    root = str(tmp_path)
+    write_fixture(root, 4)
+    cfg = TPUConfig.from_json({})
+    cfg.add_defaults_and_validate()
+    m = TpuManager(os.path.join(root, "dev"), [], cfg, lib=SysfsTpuLib(root))
+    m.start()
+    return m
+
+
+def drain(q):
+    out = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue.Empty:
+            return out
+
+
+def test_critical_event_marks_device_unhealthy(manager):
+    hc = TpuHealthChecker(manager, manager.lib)
+    hc.catch_error(TpuErrorEvent(code=48, device="accel2"))
+    events = drain(manager.health_events)
+    assert [(e.id, e.health) for e in events] == [("accel2", UNHEALTHY)]
+
+
+def test_non_critical_event_skipped(manager):
+    hc = TpuHealthChecker(manager, manager.lib)
+    hc.catch_error(TpuErrorEvent(code=13, device="accel2"))
+    assert drain(manager.health_events) == []
+
+
+def test_configured_code_becomes_critical(manager):
+    hc = TpuHealthChecker(manager, manager.lib, critical_codes=[31, 72])
+    hc.catch_error(TpuErrorEvent(code=31, device="accel1"))
+    hc.catch_error(TpuErrorEvent(code=72, device="accel0"))
+    assert {e.id for e in drain(manager.health_events)} == {"accel0", "accel1"}
+
+
+def test_unknown_device_ignored(manager):
+    hc = TpuHealthChecker(manager, manager.lib)
+    hc.catch_error(TpuErrorEvent(code=48, device="accel9"))
+    assert drain(manager.health_events) == []
+
+
+def test_deviceless_event_marks_all_unhealthy(manager):
+    hc = TpuHealthChecker(manager, manager.lib)
+    hc.catch_error(TpuErrorEvent(code=48, device=None))
+    assert {e.id for e in drain(manager.health_events)} == {
+        "accel0",
+        "accel1",
+        "accel2",
+        "accel3",
+    }
+
+
+def test_event_loop_end_to_end(manager, tmp_path):
+    """Events posted to the node queue flow through wait_for_event into the
+    manager's health queue (the fault-injection path, SURVEY.md §5)."""
+    hc = TpuHealthChecker(manager, manager.lib)
+    hc.start()
+    try:
+        post_event(str(tmp_path), code=48, device="accel3", message="HBM ECC")
+        e = manager.health_events.get(timeout=10)
+        assert (e.id, e.health) == ("accel3", UNHEALTHY)
+    finally:
+        hc.stop()

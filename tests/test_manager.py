@@ -1,0 +1,101 @@
+"""Manager unit tests: discovery, env contract (ref: manager_test.go:143-214)."""
+
+import os
+
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
+from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+from container_engine_accelerators_tpu.utils.device import HEALTHY
+
+HBM = 16 * 2**30
+
+
+def make_manager(tmp_path, config_json, num_chips=1):
+    root = str(tmp_path)
+    write_fixture(root, num_chips, hbm_total=HBM)
+    cfg = TPUConfig.from_json(config_json)
+    cfg.add_defaults_and_validate()
+    m = TpuManager(os.path.join(root, "dev"), [], cfg, lib=SysfsTpuLib(root))
+    m.start()
+    return m
+
+
+CORE_SHARING = {
+    "tpuSharingConfig": {
+        "tpuSharingStrategy": "core-sharing",
+        "maxSharedClientsPerTpu": 4,
+    }
+}
+
+
+def test_core_sharing_envs_single_client(tmp_path):
+    """MPS-env analog (ref: manager.go:312-325): one of 4 clients gets 25%
+    of the TensorCore and a quarter of HBM."""
+    m = make_manager(tmp_path, CORE_SHARING)
+    envs = m.envs(["accel0/vtpu0"])
+    assert envs["TPU_CORE_PERCENTAGE"] == "25"
+    assert envs["TPU_HBM_LIMIT_BYTES"] == str(HBM // 4)
+    assert envs["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.2500"
+
+
+def test_core_sharing_envs_multi_client(tmp_path):
+    m = make_manager(tmp_path, CORE_SHARING)
+    envs = m.envs(["accel0/vtpu0", "accel0/vtpu1", "accel0/vtpu2"])
+    assert envs["TPU_CORE_PERCENTAGE"] == "75"
+    assert envs["TPU_HBM_LIMIT_BYTES"] == str(3 * HBM // 4)
+
+
+def test_plain_config_no_envs(tmp_path):
+    m = make_manager(tmp_path, {}, num_chips=4)
+    assert m.envs(["accel0"]) == {}
+
+
+def test_discovery_and_hotplug_detection(tmp_path):
+    m = make_manager(tmp_path, {}, num_chips=2)
+    assert set(m.devices) == {"accel0", "accel1"}
+    assert all(d.health == HEALTHY for d in m.devices.values())
+    assert not m.has_additional_chips_installed()
+    open(os.path.join(str(tmp_path), "dev", "accel2"), "w").close()
+    assert m.has_additional_chips_installed()
+
+
+def test_check_device_paths(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "dev"))
+    cfg = TPUConfig.from_json({})
+    cfg.add_defaults_and_validate()
+    m = TpuManager(os.path.join(root, "dev"), [], cfg, lib=SysfsTpuLib(root))
+    assert not m.check_device_paths()
+    open(os.path.join(root, "dev", "accel0"), "w").close()
+    assert m.check_device_paths()
+
+
+def test_core_sharing_requires_chips(tmp_path):
+    root = str(tmp_path)
+    os.makedirs(os.path.join(root, "dev"))
+    cfg = TPUConfig.from_json(CORE_SHARING)
+    cfg.add_defaults_and_validate()
+    m = TpuManager(os.path.join(root, "dev"), [], cfg, lib=SysfsTpuLib(root))
+    with pytest.raises(RuntimeError, match="core-sharing requires"):
+        m.start()
+
+
+def test_hotplug_restart_recomputes_partitions(tmp_path):
+    """Regression: hotplug restart must re-run partitioning, not just chip
+    discovery, or new chips stay unschedulable behind a stale slice table."""
+    from container_engine_accelerators_tpu.tpulib.sysfs import write_fixture
+
+    root = str(tmp_path)
+    write_fixture(root, 2, topology="2x1x1")
+    cfg = TPUConfig.from_json({"tpuPartitionSize": "2x1"})
+    cfg.add_defaults_and_validate()
+    m = TpuManager(os.path.join(root, "dev"), [], cfg, lib=SysfsTpuLib(root))
+    m.start()
+    assert set(m.list_physical_devices()) == {"slice0"}
+    # Tray upgrade: 2 more chips appear and the host topology becomes 2x2.
+    write_fixture(root, 4, topology="2x2x1")
+    assert m.has_additional_chips_installed()
+    m.start()
+    assert set(m.list_physical_devices()) == {"slice0", "slice1"}

@@ -1,0 +1,197 @@
+"""Metrics tests (ref: metrics/metrics_test.go:26-209, devices.go tests).
+
+A mock collector supplies canned duty-cycle/HBM per chip; a real gRPC
+PodResources stub on a unix socket supplies container→device assignments;
+assertions read Prometheus gauge values from the registry.
+"""
+
+import concurrent.futures
+import os
+
+import grpc
+import pytest
+from prometheus_client import CollectorRegistry
+
+from container_engine_accelerators_tpu.metrics import podresources_v1_pb2 as pb
+from container_engine_accelerators_tpu.metrics.devices import PodResourcesClient
+from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+from container_engine_accelerators_tpu.tpulib.types import HbmInfo
+
+GIB = 2**30
+
+
+class MockCollector:
+    def __init__(self, stats):
+        # stats: {chip: (duty, used)}
+        self.stats = stats
+
+    def collect_tpu_device(self, name):
+        duty, used = self.stats[name]
+        return duty, HbmInfo(total_bytes=16 * GIB, used_bytes=used)
+
+    def devices(self):
+        return sorted(self.stats)
+
+    def model(self, name):
+        return "tpu-v5e"
+
+
+class PodResourcesStub:
+    """Real gRPC PodResourcesLister on a temp unix socket."""
+
+    def __init__(self, socket_path, response):
+        self.response = response
+        self.server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=2)
+        )
+        handler = grpc.method_handlers_generic_handler(
+            "v1.PodResourcesLister",
+            {
+                "List": grpc.unary_unary_rpc_method_handler(
+                    lambda req, ctx: self.response,
+                    request_deserializer=pb.ListPodResourcesRequest.FromString,
+                    response_serializer=(
+                        pb.ListPodResourcesResponse.SerializeToString
+                    ),
+                )
+            },
+        )
+        self.server.add_generic_rpc_handlers((handler,))
+        self.server.add_insecure_port(f"unix:{socket_path}")
+        self.server.start()
+
+
+def make_pod_resources():
+    resp = pb.ListPodResourcesResponse()
+    pod = resp.pod_resources.add(name="train-job-0", namespace="default")
+    c = pod.containers.add(name="worker")
+    d = c.devices.add(resource_name="google.com/tpu")
+    d.device_ids.extend(["accel0", "accel1"])
+    # A shared (virtual) allocation must be skipped for per-container stats.
+    pod2 = resp.pod_resources.add(name="shared-pod", namespace="default")
+    c2 = pod2.containers.add(name="shared")
+    d2 = c2.devices.add(resource_name="google.com/tpu")
+    d2.device_ids.extend(["accel2/vtpu0"])
+    # A non-TPU resource must be ignored entirely.
+    pod3 = resp.pod_resources.add(name="gpu-pod", namespace="default")
+    c3 = pod3.containers.add(name="cuda")
+    d3 = c3.devices.add(resource_name="nvidia.com/gpu")
+    d3.device_ids.extend(["nvidia0"])
+    return resp
+
+
+@pytest.fixture
+def stub(tmp_path):
+    sock = str(tmp_path / "pod-resources.sock")
+    s = PodResourcesStub(sock, make_pod_resources())
+    yield sock
+    s.server.stop(grace=0)
+
+
+def test_get_devices_for_all_containers(stub):
+    client = PodResourcesClient(stub)
+    result = client.get_devices_for_all_containers()
+    assert len(result) == 1
+    (cid, ids), = result.items()
+    assert (cid.namespace, cid.pod, cid.container) == (
+        "default",
+        "train-job-0",
+        "worker",
+    )
+    assert ids == ["accel0", "accel1"]
+
+
+def test_collect_once_sets_gauges(stub):
+    registry = CollectorRegistry()
+    collector = MockCollector(
+        {
+            "accel0": (78, 4 * GIB),
+            "accel1": (12, 1 * GIB),
+            "accel2": (0, 0),
+            "accel3": (0, 0),
+        }
+    )
+    server = MetricServer(
+        collector=collector,
+        registry=registry,
+        pod_resources_socket=stub,
+    )
+    server.collect_once()
+
+    labels = {
+        "namespace": "default",
+        "pod": "train-job-0",
+        "container": "worker",
+        "make": "google",
+        "accelerator_id": "accel0",
+        "model": "tpu-v5e",
+    }
+    assert registry.get_sample_value("duty_cycle", labels) == 78
+    assert registry.get_sample_value("memory_total", labels) == 16 * GIB
+    assert registry.get_sample_value("memory_used", labels) == 4 * GIB
+    assert (
+        registry.get_sample_value(
+            "request",
+            {
+                "namespace": "default",
+                "pod": "train-job-0",
+                "container": "worker",
+                "resource_name": "google.com/tpu",
+            },
+        )
+        == 2
+    )
+    # Node-level gauges cover all chips, including unallocated ones.
+    node_labels = {"make": "google", "accelerator_id": "accel3", "model": "tpu-v5e"}
+    assert registry.get_sample_value("duty_cycle_tpu_node", node_labels) == 0
+    assert (
+        registry.get_sample_value("memory_total_tpu_node", node_labels) == 16 * GIB
+    )
+    # The shared pod must have no per-container sample (virtual ID skipped).
+    assert (
+        registry.get_sample_value(
+            "duty_cycle",
+            {**labels, "pod": "shared-pod", "container": "shared",
+             "accelerator_id": "accel2"},
+        )
+        is None
+    )
+
+
+def test_collect_survives_pod_resources_outage(tmp_path):
+    registry = CollectorRegistry()
+    collector = MockCollector({"accel0": (50, 0)})
+    server = MetricServer(
+        collector=collector,
+        registry=registry,
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+    )
+    server.collect_once()  # must not raise; node gauges still exported
+    node_labels = {"make": "google", "accelerator_id": "accel0", "model": "tpu-v5e"}
+    assert registry.get_sample_value("duty_cycle_tpu_node", node_labels) == 50
+
+
+def test_reset_clears_stale_series(stub):
+    registry = CollectorRegistry()
+    collector = MockCollector({"accel0": (10, 0), "accel1": (0, 0),
+                               "accel2": (0, 0), "accel3": (0, 0)})
+    server = MetricServer(
+        collector=collector, registry=registry, pod_resources_socket=stub
+    )
+    server.collect_once()
+    assert registry.get_sample_value(
+        "duty_cycle_tpu_node",
+        {"make": "google", "accelerator_id": "accel0", "model": "tpu-v5e"},
+    ) == 10
+    # Force the periodic reset; a now-empty node must export nothing stale.
+    server._last_reset -= 2 * 60
+    server.collector = MockCollector({})
+    server.pod_resources.socket_path = "/nonexistent.sock"
+    server.collect_once()
+    assert (
+        registry.get_sample_value(
+            "duty_cycle_tpu_node",
+            {"make": "google", "accelerator_id": "accel0", "model": "tpu-v5e"},
+        )
+        is None
+    )

@@ -1,0 +1,93 @@
+"""Sub-slice partition manager unit tests (ref: mig/mig_test.go:28-145)."""
+
+import os
+
+import pytest
+
+from container_engine_accelerators_tpu.partition import (
+    SubsliceDeviceManager,
+    compute_subslices,
+)
+from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.utils.device import HEALTHY, UNHEALTHY
+
+
+def make_lib(tmp_path, num_chips=4, topology="2x2x1"):
+    root = str(tmp_path)
+    write_fixture(root, num_chips, topology=topology)
+    return SysfsTpuLib(root), os.path.join(root, "dev")
+
+
+def test_compute_subslices_2x1_on_2x2(tmp_path):
+    lib, _ = make_lib(tmp_path)
+    tiles = compute_subslices(lib.chips(), "2x1")
+    assert [[c.name for c in t] for t in tiles] == [
+        ["accel0", "accel1"],
+        ["accel2", "accel3"],
+    ]
+
+
+def test_compute_subslices_1x1(tmp_path):
+    lib, _ = make_lib(tmp_path)
+    tiles = compute_subslices(lib.chips(), "1x1")
+    assert len(tiles) == 4
+    assert all(len(t) == 1 for t in tiles)
+
+
+def test_compute_subslices_whole_mesh(tmp_path):
+    lib, _ = make_lib(tmp_path)
+    tiles = compute_subslices(lib.chips(), "2x2")
+    assert len(tiles) == 1
+    assert [c.name for c in tiles[0]] == ["accel0", "accel1", "accel2", "accel3"]
+
+
+def test_compute_subslices_8_chip_host(tmp_path):
+    lib, _ = make_lib(tmp_path, num_chips=8, topology="2x2x2")
+    tiles = compute_subslices(lib.chips(), "2x2x1")
+    assert len(tiles) == 2
+
+
+def test_non_tiling_size_rejected(tmp_path):
+    lib, _ = make_lib(tmp_path)
+    with pytest.raises(ValueError, match="does not tile"):
+        compute_subslices(lib.chips(), "2x2x2")
+
+
+def test_manager_specs_and_envs(tmp_path):
+    lib, dev = make_lib(tmp_path)
+    mgr = SubsliceDeviceManager(lib, dev)
+    mgr.start("1x2")
+    devs = mgr.list_partition_devices()
+    assert set(devs) == {"slice0", "slice1"}
+    # 1x2 on a 2x2 mesh: slice0 = column x=0 → chips (0,0) and (0,1),
+    # which are accel0 and accel2 in row-major layout.
+    specs = mgr.device_spec("slice0")
+    assert sorted(s.host_path for s in specs) == [
+        os.path.join(dev, "accel0"),
+        os.path.join(dev, "accel2"),
+    ]
+    assert mgr.envs("slice0")["TPU_VISIBLE_DEVICES"] == "0,2"
+    assert mgr.envs("slice0")["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,2,1"
+
+
+def test_manager_health_and_chip_ownership(tmp_path):
+    lib, dev = make_lib(tmp_path)
+    mgr = SubsliceDeviceManager(lib, dev)
+    mgr.start("2x1")
+    assert mgr.slice_for_chip("accel3") == "slice1"
+    assert mgr.slice_for_chip("accel9") is None
+    mgr.set_device_health("slice1", UNHEALTHY)
+    assert mgr.list_partition_devices()["slice1"].health == UNHEALTHY
+    assert mgr.list_partition_devices()["slice0"].health == HEALTHY
+    with pytest.raises(ValueError, match="unhealthy"):
+        mgr.device_spec("slice1")
+    with pytest.raises(ValueError, match="non-existing"):
+        mgr.device_spec("slice7")
+
+
+def test_missing_device_node_rejected(tmp_path):
+    lib, dev = make_lib(tmp_path)
+    os.unlink(os.path.join(dev, "accel2"))
+    mgr = SubsliceDeviceManager(lib, dev)
+    with pytest.raises(FileNotFoundError):
+        mgr.start("2x1")

@@ -1,0 +1,88 @@
+"""Tests for sharing virtual-device logic (ref: gpusharing_test.go:24-119)."""
+
+import pytest
+
+from container_engine_accelerators_tpu.sharing import (
+    SharingStrategy,
+    is_virtual_device_id,
+    validate_request,
+    virtual_device_ids,
+    virtual_to_physical_device_id,
+)
+
+
+@pytest.mark.parametrize(
+    "device_id,expected",
+    [
+        ("accel0/vtpu0", True),
+        ("accel12/vtpu345", True),
+        ("slice2/vtpu1", True),
+        ("accel0", False),
+        ("slice2", False),
+        ("nvidia0/vgpu0", False),
+        ("accel0/vtpu", False),
+        ("xaccel0/vtpu1", False),
+        ("accel0/vtpu1/extra", False),
+    ],
+)
+def test_is_virtual_device_id(device_id, expected):
+    assert is_virtual_device_id(device_id) is expected
+
+
+@pytest.mark.parametrize(
+    "virtual,physical",
+    [
+        ("accel0/vtpu0", "accel0"),
+        ("accel3/vtpu17", "accel3"),
+        ("slice2/vtpu1", "slice2"),
+    ],
+)
+def test_virtual_to_physical(virtual, physical):
+    assert virtual_to_physical_device_id(virtual) == physical
+
+
+@pytest.mark.parametrize("bad", ["accel0", "slice1", "foo/vtpu1"])
+def test_virtual_to_physical_rejects(bad):
+    with pytest.raises(ValueError):
+        virtual_to_physical_device_id(bad)
+
+
+def test_virtual_device_ids_expansion():
+    assert virtual_device_ids("accel1", 3) == [
+        "accel1/vtpu0",
+        "accel1/vtpu1",
+        "accel1/vtpu2",
+    ]
+
+
+class TestValidateRequest:
+    def test_time_sharing_single_ok(self):
+        validate_request(["accel0/vtpu1"], 4, SharingStrategy.TIME_SHARING)
+
+    def test_time_sharing_multi_rejected(self):
+        with pytest.raises(ValueError, match="time-sharing"):
+            validate_request(
+                ["accel0/vtpu1", "accel0/vtpu2"], 4, SharingStrategy.TIME_SHARING
+            )
+
+    def test_core_sharing_multi_on_single_chip_ok(self):
+        validate_request(
+            ["accel0/vtpu1", "accel0/vtpu2"], 1, SharingStrategy.CORE_SHARING
+        )
+
+    def test_core_sharing_multi_on_multi_chip_rejected(self):
+        with pytest.raises(ValueError, match="core-sharing"):
+            validate_request(
+                ["accel0/vtpu1", "accel0/vtpu2"], 4, SharingStrategy.CORE_SHARING
+            )
+
+    def test_physical_ids_always_ok(self):
+        # Non-virtual multi-device requests bypass sharing validation.
+        validate_request(["accel0", "accel1"], 4, SharingStrategy.TIME_SHARING)
+
+
+def test_strategy_parse_mps_alias():
+    assert SharingStrategy.parse("mps") == SharingStrategy.CORE_SHARING
+    assert SharingStrategy.parse("time-sharing") == SharingStrategy.TIME_SHARING
+    with pytest.raises(ValueError):
+        SharingStrategy.parse("bogus")

@@ -1,0 +1,47 @@
+"""tpulib sysfs backend tests: the node filesystem contract."""
+
+import pytest
+
+from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.tpulib.sysfs import post_event
+
+
+def test_enumeration_and_attrs(tmp_path):
+    write_fixture(str(tmp_path), 4, topology="2x2x1", hbm_total=16 * 2**30)
+    lib = SysfsTpuLib(str(tmp_path))
+    assert lib.chip_count() == 4
+    chips = lib.chips()
+    assert [c.name for c in chips] == ["accel0", "accel1", "accel2", "accel3"]
+    assert chips[0].coords == (0, 0, 0)
+    assert chips[3].coords == (1, 1, 0)
+    assert chips[0].topology == (2, 2, 1)
+    hbm = lib.hbm_info("accel0")
+    assert hbm.total_bytes == 16 * 2**30
+    assert hbm.used_bytes == 0
+    assert lib.duty_cycle("accel0") == 0
+    assert lib.health("accel0") == "ok"
+
+
+def test_empty_root(tmp_path):
+    lib = SysfsTpuLib(str(tmp_path))
+    assert lib.chip_count() == 0
+    assert lib.chips() == []
+
+
+def test_event_queue_fifo_and_consume(tmp_path):
+    write_fixture(str(tmp_path), 1)
+    lib = SysfsTpuLib(str(tmp_path))
+    post_event(str(tmp_path), 48, "accel0", "first")
+    post_event(str(tmp_path), 63, None, "second")
+    e1 = lib.wait_for_event(1.0)
+    assert (e1.code, e1.device, e1.message) == (48, "accel0", "first")
+    e2 = lib.wait_for_event(1.0)
+    assert (e2.code, e2.device) == (63, None)
+    assert lib.wait_for_event(0.1) is None
+
+
+def test_bad_chip_name_rejected(tmp_path):
+    write_fixture(str(tmp_path), 1)
+    lib = SysfsTpuLib(str(tmp_path))
+    with pytest.raises(ValueError):
+        lib.chip_info("nvidia0")
