@@ -1,0 +1,35 @@
+# Build/test entry points (ref: Makefile:20-36 — test = unit suite with race
+# detection; presubmit = vet/format.  Python analog: pytest + compileall.)
+
+PY := python3
+NATIVE_BUILD := native/tpushim/build
+
+.PHONY: all native test presubmit proto clean
+
+all: native
+
+native: $(NATIVE_BUILD)/libtpushim.so
+
+$(NATIVE_BUILD)/libtpushim.so: native/tpushim/tpushim.cc native/tpushim/tpushim.h
+	mkdir -p $(NATIVE_BUILD)
+	g++ -std=c++17 -O2 -Wall -Wextra -fPIC -shared \
+	    -o $(NATIVE_BUILD)/libtpushim.so native/tpushim/tpushim.cc
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+presubmit:
+	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests
+
+# Regenerate protobuf message modules (grpc_tools absent: bare protoc only;
+# service stubs are hand-written in deviceplugin/api.py).
+proto:
+	protoc -Iprotos/deviceplugin/v1beta1 \
+	    --python_out=container_engine_accelerators_tpu/deviceplugin \
+	    protos/deviceplugin/v1beta1/deviceplugin_v1beta1.proto
+	protoc -Iprotos/podresources/v1 \
+	    --python_out=container_engine_accelerators_tpu/metrics \
+	    protos/podresources/v1/podresources_v1.proto
+
+clean:
+	rm -rf $(NATIVE_BUILD)

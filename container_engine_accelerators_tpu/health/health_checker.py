@@ -67,7 +67,14 @@ class TpuHealthChecker:
 
     def _listen_to_events(self) -> None:
         while not self._stop.is_set():
-            event = self.lib.wait_for_event(EVENT_WAIT_TIMEOUT_S)
+            try:
+                event = self.lib.wait_for_event(EVENT_WAIT_TIMEOUT_S)
+            except Exception as e:
+                # Keep monitoring alive across transient backend errors, but
+                # back off so a persistent failure can't spin the CPU.
+                log.error("TPU event wait failed: %s; backing off", e)
+                self._stop.wait(EVENT_WAIT_TIMEOUT_S)
+                continue
             if event is None:
                 continue
             self.catch_error(event)

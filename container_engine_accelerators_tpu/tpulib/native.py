@@ -1,0 +1,204 @@
+"""ctypes binding over the C++ tpushim library (native/tpushim/).
+
+The native backend owns the inotify event loop and filesystem sampling —
+the role NVML's C library plays for the reference (cgo helper,
+pkg/gpu/nvidia/metrics/util.go:17-73).  The Python contract is identical
+to SysfsTpuLib; ``open_lib`` prefers this backend when the .so is built.
+
+Search order for the library: $TPUSHIM_PATH, the in-repo build dir,
+then the system loader.
+"""
+
+import ctypes
+import os
+from typing import List, Optional
+
+from container_engine_accelerators_tpu.tpulib.types import (
+    ChipInfo,
+    HbmInfo,
+    TpuErrorEvent,
+    TpuLib,
+)
+
+_NAME_LEN = 32
+_ADDR_LEN = 32
+_MSG_LEN = 256
+_HEALTH_LEN = 64
+
+
+class _ChipInfoStruct(ctypes.Structure):
+    _fields_ = [
+        ("name", ctypes.c_char * _NAME_LEN),
+        ("index", ctypes.c_int32),
+        ("chip_id", ctypes.c_int32),
+        ("pci_addr", ctypes.c_char * _ADDR_LEN),
+        ("coords", ctypes.c_int32 * 3),
+        ("topology", ctypes.c_int32 * 3),
+    ]
+
+
+class _EventStruct(ctypes.Structure):
+    _fields_ = [
+        ("code", ctypes.c_int32),
+        ("device", ctypes.c_char * _NAME_LEN),
+        ("message", ctypes.c_char * _MSG_LEN),
+    ]
+
+
+def _find_library() -> ctypes.CDLL:
+    env = os.environ.get("TPUSHIM_PATH")
+    if env:
+        # An explicit override must never fall through to another copy.
+        if not os.path.exists(env):
+            raise OSError(f"TPUSHIM_PATH={env} does not exist")
+        return ctypes.CDLL(env)
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    candidates = [
+        os.path.join(repo, "native", "tpushim", "build", "libtpushim.so"),
+        "libtpushim.so",  # system loader
+    ]
+    errors = []
+    for c in candidates:
+        try:
+            return ctypes.CDLL(c)
+        except OSError as e:
+            errors.append(f"{c}: {e}")
+    raise OSError(
+        "libtpushim.so not found; build with `make native`. Tried: "
+        + "; ".join(errors)
+    )
+
+
+def _load() -> ctypes.CDLL:
+    lib = _find_library()
+    lib.tpu_open.argtypes = [ctypes.c_char_p]
+    lib.tpu_open.restype = ctypes.c_void_p
+    lib.tpu_close.argtypes = [ctypes.c_void_p]
+    lib.tpu_chip_count.argtypes = [ctypes.c_void_p]
+    lib.tpu_chip_info.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(_ChipInfoStruct),
+    ]
+    lib.tpu_hbm_info.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.tpu_duty_cycle.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tpu_health.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int,
+    ]
+    lib.tpu_wait_for_event.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(_EventStruct),
+    ]
+    lib.tpushim_version.restype = ctypes.c_char_p
+    return lib
+
+
+class NativeTpuLib(TpuLib):
+    def __init__(self, root: str = "/"):
+        self._lib = _load()
+        self._ctx = self._lib.tpu_open(root.encode())
+        if not self._ctx:
+            raise OSError("tpu_open failed")
+        self.root = root
+
+    def close(self) -> None:
+        if self._ctx:
+            self._lib.tpu_close(self._ctx)
+            self._ctx = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- enumeration ---------------------------------------------------------
+
+    _ERANGE = 34
+
+    def chip_count(self) -> int:
+        return max(0, self._lib.tpu_chip_count(self._ctx))
+
+    def chips(self) -> List[ChipInfo]:
+        out = []
+        for i in range(self.chip_count()):
+            chip = self._chip_at(i)
+            if chip is None:  # hotplug removal raced the enumeration
+                break
+            out.append(chip)
+        return out
+
+    def _chip_at(self, index: int) -> Optional[ChipInfo]:
+        s = _ChipInfoStruct()
+        rc = self._lib.tpu_chip_info(self._ctx, index, ctypes.byref(s))
+        if rc == -self._ERANGE:
+            return None
+        if rc != 0:
+            raise OSError(f"tpu_chip_info({index}) failed: {rc}")
+        return ChipInfo(
+            name=s.name.decode(),
+            index=s.index,
+            chip_id=s.chip_id,
+            pci_addr=s.pci_addr.decode(),
+            coords=tuple(s.coords),
+            topology=tuple(s.topology),
+        )
+
+    def chip_info(self, name: str) -> ChipInfo:
+        for chip in self.chips():
+            if chip.name == name:
+                return chip
+        raise ValueError(f"not a TPU chip name: {name!r}")
+
+    # -- sampling ------------------------------------------------------------
+
+    def hbm_info(self, name: str) -> HbmInfo:
+        total = ctypes.c_int64()
+        used = ctypes.c_int64()
+        rc = self._lib.tpu_hbm_info(
+            self._ctx, name.encode(), ctypes.byref(total), ctypes.byref(used)
+        )
+        if rc != 0:
+            raise OSError(f"tpu_hbm_info({name}) failed: {rc}")
+        return HbmInfo(total_bytes=total.value, used_bytes=used.value)
+
+    def duty_cycle(self, name: str) -> int:
+        rc = self._lib.tpu_duty_cycle(self._ctx, name.encode())
+        return max(0, rc)
+
+    def health(self, name: str) -> str:
+        buf = ctypes.create_string_buffer(_HEALTH_LEN)
+        rc = self._lib.tpu_health(self._ctx, name.encode(), buf, _HEALTH_LEN)
+        if rc != 0:
+            raise OSError(f"tpu_health({name}) failed: {rc}")
+        return buf.value.decode()
+
+    # -- events --------------------------------------------------------------
+
+    def wait_for_event(self, timeout_s: float) -> Optional[TpuErrorEvent]:
+        ev = _EventStruct()
+        rc = self._lib.tpu_wait_for_event(
+            self._ctx, int(timeout_s * 1000), ctypes.byref(ev)
+        )
+        if rc < 0:
+            # A hard error must not look like a timeout: the health checker
+            # would spin at 100% CPU retrying instantly forever.
+            raise OSError(f"tpu_wait_for_event failed: {rc}")
+        if rc == 0:
+            return None
+        device = ev.device.decode()
+        return TpuErrorEvent(
+            code=ev.code,
+            device=device or None,
+            message=ev.message.decode(),
+        )

@@ -1,0 +1,351 @@
+// tpushim implementation — see tpushim.h for the contract.
+
+#include "tpushim.h"
+
+#include <dirent.h>
+#include <errno.h>
+#include <limits.h>
+#include <poll.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/inotify.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+bool is_accel_name(const std::string& n, int* index) {
+  if (n.rfind("accel", 0) != 0 || n.size() <= 5) return false;
+  for (size_t i = 5; i < n.size(); i++) {
+    if (n[i] < '0' || n[i] > '9') return false;
+  }
+  *index = std::atoi(n.c_str() + 5);
+  return true;
+}
+
+bool read_file(const std::string& path, std::string* out) {
+  std::ifstream f(path);
+  if (!f) return false;
+  std::stringstream ss;
+  ss << f.rdbuf();
+  *out = ss.str();
+  while (!out->empty() && (out->back() == '\n' || out->back() == '\r' ||
+                           out->back() == ' '))
+    out->pop_back();
+  return true;
+}
+
+void parse_triple(const std::string& raw, char sep, int32_t out[3]) {
+  out[0] = out[1] = out[2] = 1;
+  std::stringstream ss(raw);
+  std::string part;
+  for (int i = 0; i < 3 && std::getline(ss, part, sep); i++) {
+    out[i] = std::atoi(part.c_str());
+  }
+}
+
+// Minimal parser for the flat event JSON our node components write:
+//   {"code": <int>, "device": "accelN"|null, "message": "<str>"}
+// Strict on shape, tolerant of key order and whitespace.  Unknown keys are
+// skipped.  Returns false on anything structurally unexpected.
+struct EventJson {
+  long code = -1;
+  std::string device;  // empty = null / absent
+  std::string message;
+};
+
+void skip_ws(const std::string& s, size_t* i) {
+  while (*i < s.size() && (s[*i] == ' ' || s[*i] == '\t' || s[*i] == '\n' ||
+                           s[*i] == '\r'))
+    (*i)++;
+}
+
+bool parse_json_string(const std::string& s, size_t* i, std::string* out) {
+  if (*i >= s.size() || s[*i] != '"') return false;
+  (*i)++;
+  out->clear();
+  while (*i < s.size()) {
+    char c = s[*i];
+    if (c == '"') {
+      (*i)++;
+      return true;
+    }
+    if (c == '\\') {
+      (*i)++;
+      if (*i >= s.size()) return false;
+      char e = s[*i];
+      switch (e) {
+        case 'n': out->push_back('\n'); break;
+        case 't': out->push_back('\t'); break;
+        case 'r': out->push_back('\r'); break;
+        case 'b': out->push_back('\b'); break;
+        case 'f': out->push_back('\f'); break;
+        case '"': out->push_back('"'); break;
+        case '\\': out->push_back('\\'); break;
+        case '/': out->push_back('/'); break;
+        case 'u': {
+          // Keep it simple: skip 4 hex digits, emit '?' for non-ASCII.
+          if (*i + 4 >= s.size()) return false;
+          *i += 4;
+          out->push_back('?');
+          break;
+        }
+        default: return false;
+      }
+      (*i)++;
+    } else {
+      out->push_back(c);
+      (*i)++;
+    }
+  }
+  return false;  // unterminated
+}
+
+bool parse_event_json(const std::string& s, EventJson* ev) {
+  size_t i = 0;
+  skip_ws(s, &i);
+  if (i >= s.size() || s[i] != '{') return false;
+  i++;
+  skip_ws(s, &i);
+  if (i < s.size() && s[i] == '}') return true;  // empty object
+  while (i < s.size()) {
+    std::string key;
+    if (!parse_json_string(s, &i, &key)) return false;
+    skip_ws(s, &i);
+    if (i >= s.size() || s[i] != ':') return false;
+    i++;
+    skip_ws(s, &i);
+    if (i >= s.size()) return false;
+    if (s[i] == '"') {
+      std::string val;
+      if (!parse_json_string(s, &i, &val)) return false;
+      if (key == "device") ev->device = val;
+      else if (key == "message") ev->message = val;
+    } else if (s.compare(i, 4, "null") == 0) {
+      i += 4;
+    } else if (s.compare(i, 4, "true") == 0) {
+      i += 4;
+    } else if (s.compare(i, 5, "false") == 0) {
+      i += 5;
+    } else {
+      // number
+      size_t start = i;
+      if (s[i] == '-') i++;
+      while (i < s.size() &&
+             ((s[i] >= '0' && s[i] <= '9') || s[i] == '.' || s[i] == 'e' ||
+              s[i] == 'E' || s[i] == '+' || s[i] == '-'))
+        i++;
+      if (i == start) return false;
+      if (key == "code") ev->code = std::strtol(s.c_str() + start, nullptr, 10);
+    }
+    skip_ws(s, &i);
+    if (i >= s.size()) return false;
+    if (s[i] == ',') {
+      i++;
+      skip_ws(s, &i);
+      continue;
+    }
+    if (s[i] == '}') return true;
+    return false;
+  }
+  return false;
+}
+
+}  // namespace
+
+struct tpu_ctx {
+  std::string root;
+  std::string sys_dir;
+  std::string events_dir;
+  int inotify_fd = -1;
+  int watch_fd = -1;
+};
+
+extern "C" {
+
+tpu_ctx* tpu_open(const char* root) {
+  tpu_ctx* ctx = new (std::nothrow) tpu_ctx();
+  if (!ctx) return nullptr;
+  ctx->root = root ? root : "/";
+  if (!ctx->root.empty() && ctx->root.back() != '/') ctx->root += '/';
+  ctx->sys_dir = ctx->root + "sys/class/accel";
+  ctx->events_dir = ctx->root + "var/run/tpu/events";
+  ctx->inotify_fd = inotify_init1(IN_NONBLOCK | IN_CLOEXEC);
+  if (ctx->inotify_fd >= 0) {
+    // Watch may fail if the dir doesn't exist yet; we retry on each wait.
+    ctx->watch_fd = inotify_add_watch(ctx->inotify_fd, ctx->events_dir.c_str(),
+                                      IN_MOVED_TO | IN_CLOSE_WRITE);
+  }
+  return ctx;
+}
+
+void tpu_close(tpu_ctx* ctx) {
+  if (!ctx) return;
+  if (ctx->inotify_fd >= 0) close(ctx->inotify_fd);
+  delete ctx;
+}
+
+static std::vector<std::string> list_chips(tpu_ctx* ctx) {
+  std::vector<std::pair<int, std::string>> found;
+  DIR* d = opendir(ctx->sys_dir.c_str());
+  if (!d) return {};
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    int idx;
+    std::string name(e->d_name);
+    if (is_accel_name(name, &idx)) found.emplace_back(idx, name);
+  }
+  closedir(d);
+  std::sort(found.begin(), found.end());
+  std::vector<std::string> names;
+  names.reserve(found.size());
+  for (auto& p : found) names.push_back(p.second);
+  return names;
+}
+
+static bool chip_attr(tpu_ctx* ctx, const std::string& name,
+                      const char* attr, std::string* out) {
+  return read_file(ctx->sys_dir + "/" + name + "/device/" + attr, out);
+}
+
+int tpu_chip_count(tpu_ctx* ctx) {
+  if (!ctx) return -EINVAL;
+  return static_cast<int>(list_chips(ctx).size());
+}
+
+int tpu_chip_info(tpu_ctx* ctx, int index, tpu_chip_info_t* out) {
+  if (!ctx || !out) return -EINVAL;
+  std::vector<std::string> chips = list_chips(ctx);
+  if (index < 0 || index >= static_cast<int>(chips.size())) return -ERANGE;
+  const std::string& name = chips[index];
+  memset(out, 0, sizeof(*out));
+  snprintf(out->name, sizeof(out->name), "%s", name.c_str());
+  out->index = std::atoi(name.c_str() + 5);
+  std::string v;
+  out->chip_id = chip_attr(ctx, name, "chip_id", &v) ? std::atoi(v.c_str()) : 0;
+  if (chip_attr(ctx, name, "pci_addr", &v))
+    snprintf(out->pci_addr, sizeof(out->pci_addr), "%s", v.c_str());
+  parse_triple(chip_attr(ctx, name, "coords", &v) ? v : "0,0,0", ',',
+               out->coords);
+  parse_triple(chip_attr(ctx, name, "topology", &v) ? v : "1x1x1", 'x',
+               out->topology);
+  return 0;
+}
+
+int tpu_hbm_info(tpu_ctx* ctx, const char* name, int64_t* total_bytes,
+                 int64_t* used_bytes) {
+  if (!ctx || !name || !total_bytes || !used_bytes) return -EINVAL;
+  std::string v;
+  *total_bytes =
+      chip_attr(ctx, name, "hbm_total_bytes", &v) ? std::atoll(v.c_str()) : 0;
+  *used_bytes =
+      chip_attr(ctx, name, "hbm_used_bytes", &v) ? std::atoll(v.c_str()) : 0;
+  return 0;
+}
+
+int tpu_duty_cycle(tpu_ctx* ctx, const char* name) {
+  if (!ctx || !name) return -EINVAL;
+  std::string v;
+  if (!chip_attr(ctx, name, "duty_cycle_pct", &v)) return 0;
+  int pct = std::atoi(v.c_str());
+  if (pct < 0) pct = 0;
+  if (pct > 100) pct = 100;
+  return pct;
+}
+
+int tpu_health(tpu_ctx* ctx, const char* name, char* buf, int buf_len) {
+  if (!ctx || !name || !buf || buf_len <= 0) return -EINVAL;
+  std::string v;
+  if (!chip_attr(ctx, name, "health", &v)) v = "ok";
+  snprintf(buf, buf_len, "%s", v.c_str());
+  return 0;
+}
+
+// Pop the oldest event file (lexicographic = chronological: producers name
+// files by monotonic nanosecond sequence).  Malformed files are unlinked
+// and skipped so one bad writer can't wedge the stream.
+static int try_pop_event(tpu_ctx* ctx, tpu_event_t* out) {
+  DIR* d = opendir(ctx->events_dir.c_str());
+  if (!d) return 0;
+  std::vector<std::string> files;
+  struct dirent* e;
+  while ((e = readdir(d)) != nullptr) {
+    std::string name(e->d_name);
+    if (name.size() > 5 && name.rfind(".json") == name.size() - 5 &&
+        name[0] != '.')
+      files.push_back(name);
+  }
+  closedir(d);
+  std::sort(files.begin(), files.end());
+  for (const std::string& name : files) {
+    std::string path = ctx->events_dir + "/" + name;
+    std::string body;
+    if (!read_file(path, &body)) {
+      unlink(path.c_str());
+      continue;  // racing consumer took it
+    }
+    EventJson ev;
+    bool parsed = parse_event_json(body, &ev);
+    // Unlink best-effort AFTER a successful read: on a read-only events dir
+    // the event is still delivered (matching SysfsTpuLib) rather than lost.
+    unlink(path.c_str());
+    if (!parsed) continue;  // malformed: discarded
+    memset(out, 0, sizeof(*out));
+    out->code = static_cast<int32_t>(ev.code);
+    snprintf(out->device, sizeof(out->device), "%s", ev.device.c_str());
+    snprintf(out->message, sizeof(out->message), "%s", ev.message.c_str());
+    return 1;
+  }
+  return 0;
+}
+
+int tpu_wait_for_event(tpu_ctx* ctx, int timeout_ms, tpu_event_t* out) {
+  if (!ctx || !out) return -EINVAL;
+  if (ctx->inotify_fd < 0) return -EBADF;
+  if (ctx->watch_fd < 0) {
+    // Events dir may have been created after open.
+    ctx->watch_fd = inotify_add_watch(ctx->inotify_fd, ctx->events_dir.c_str(),
+                                      IN_MOVED_TO | IN_CLOSE_WRITE);
+  }
+  // Drain anything already queued before blocking.
+  int got = try_pop_event(ctx, out);
+  if (got) return got;
+
+  struct pollfd pfd = {ctx->inotify_fd, POLLIN, 0};
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  int64_t deadline_ms =
+      ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL + timeout_ms;
+  const int slice_ms = 200;  // re-check dir even without inotify (NFS etc.)
+  for (;;) {
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    int64_t now_ms = ts.tv_sec * 1000LL + ts.tv_nsec / 1000000LL;
+    int64_t remaining = deadline_ms - now_ms;
+    if (remaining <= 0) return 0;
+    int wait = ctx->watch_fd >= 0
+                   ? static_cast<int>(std::min<int64_t>(remaining, 10000))
+                   : static_cast<int>(std::min<int64_t>(remaining, slice_ms));
+    int rc = poll(&pfd, 1, wait);
+    if (rc > 0) {
+      char buf[4096];
+      while (read(ctx->inotify_fd, buf, sizeof(buf)) > 0) {
+      }
+    }
+    // A wakeup can be for a writer's tmp file before its rename lands
+    // (IN_CLOSE_WRITE on ".<seq>.tmp"); the deadline loop naturally
+    // re-polls until the IN_MOVED_TO arrives.
+    got = try_pop_event(ctx, out);
+    if (got) return got;
+  }
+}
+
+const char* tpushim_version(void) { return "tpushim 0.1.0"; }
+
+}  // extern "C"

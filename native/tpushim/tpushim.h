@@ -1,0 +1,70 @@
+/* tpushim — native TPU node library (the NVML-analog).
+ *
+ * The reference consumes NVML as its native device library: enumeration,
+ * memory/utilization sampling, and the Xid event stream (SURVEY.md §2.2;
+ * ref: pkg/gpu/nvidia/metrics/util.go:17-73 links NVML via cgo).  TPU nodes
+ * expose the same information as a filesystem contract (documented in
+ * container_engine_accelerators_tpu/tpulib/__init__.py); this library is
+ * the C++ implementation of that contract with an inotify-driven event
+ * loop, consumed from Python via ctypes (no pybind11 in the image).
+ *
+ * All functions return 0 on success, negative errno-style codes on error.
+ */
+#ifndef TPUSHIM_H_
+#define TPUSHIM_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUSHIM_NAME_LEN 32
+#define TPUSHIM_ADDR_LEN 32
+#define TPUSHIM_MSG_LEN 256
+#define TPUSHIM_HEALTH_LEN 64
+
+typedef struct tpu_ctx tpu_ctx;
+
+typedef struct {
+  char name[TPUSHIM_NAME_LEN]; /* "accelN" */
+  int32_t index;
+  int32_t chip_id;
+  char pci_addr[TPUSHIM_ADDR_LEN];
+  int32_t coords[3];   /* ICI mesh coordinates */
+  int32_t topology[3]; /* host-local mesh bounds */
+} tpu_chip_info_t;
+
+typedef struct {
+  int32_t code;
+  /* device[0] == '\0' means "no device attribution" (whole node). */
+  char device[TPUSHIM_NAME_LEN];
+  char message[TPUSHIM_MSG_LEN];
+} tpu_event_t;
+
+/* Open a context rooted at `root` ("/" on a real node; a fixture dir in
+ * tests).  Returns NULL on allocation failure only — a root with no chips
+ * is valid (chip_count() == 0). */
+tpu_ctx* tpu_open(const char* root);
+void tpu_close(tpu_ctx* ctx);
+
+int tpu_chip_count(tpu_ctx* ctx);
+int tpu_chip_info(tpu_ctx* ctx, int index, tpu_chip_info_t* out);
+int tpu_hbm_info(tpu_ctx* ctx, const char* name, int64_t* total_bytes,
+                 int64_t* used_bytes);
+/* Returns duty cycle 0-100, or negative on error. */
+int tpu_duty_cycle(tpu_ctx* ctx, const char* name);
+int tpu_health(tpu_ctx* ctx, const char* name, char* buf, int buf_len);
+
+/* Block up to timeout_ms for the next error event from
+ * <root>/var/run/tpu/events (inotify; consumed files are unlinked).
+ * Returns 1 with *out filled, 0 on timeout, negative on error. */
+int tpu_wait_for_event(tpu_ctx* ctx, int timeout_ms, tpu_event_t* out);
+
+const char* tpushim_version(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUSHIM_H_ */

@@ -1,0 +1,114 @@
+"""Native (C++ tpushim) backend tests: same contract as the sysfs backend.
+
+Builds libtpushim.so via `make native` once per session; the ctypes binding
+must behave identically to SysfsTpuLib on the same fixture (the reference
+analogously seams NVML behind interfaces so both real and mock satisfy the
+same tests).
+"""
+
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from container_engine_accelerators_tpu.tpulib.sysfs import (
+    post_event,
+    write_fixture,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SO_PATH = os.path.join(REPO, "native", "tpushim", "build", "libtpushim.so")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def build_native():
+    subprocess.run(["make", "native"], cwd=REPO, check=True, capture_output=True)
+
+
+@pytest.fixture
+def native_lib(tmp_path):
+    from container_engine_accelerators_tpu.tpulib.native import NativeTpuLib
+
+    write_fixture(str(tmp_path), 4, topology="2x2x1", hbm_total=16 * 2**30)
+    lib = NativeTpuLib(str(tmp_path))
+    yield lib
+    lib.close()
+
+
+def test_enumeration(native_lib):
+    assert native_lib.chip_count() == 4
+    chips = native_lib.chips()
+    assert [c.name for c in chips] == ["accel0", "accel1", "accel2", "accel3"]
+    assert chips[3].coords == (1, 1, 0)
+    assert chips[0].topology == (2, 2, 1)
+    assert chips[2].pci_addr == "0000:00:06.0"
+
+
+def test_sampling(native_lib):
+    hbm = native_lib.hbm_info("accel1")
+    assert hbm.total_bytes == 16 * 2**30
+    assert hbm.used_bytes == 0
+    assert native_lib.duty_cycle("accel1") == 0
+    assert native_lib.health("accel1") == "ok"
+
+
+def test_event_roundtrip(native_lib, tmp_path):
+    post_event(str(tmp_path), 48, "accel2", "HBM ECC")
+    e = native_lib.wait_for_event(2.0)
+    assert (e.code, e.device, e.message) == (48, "accel2", "HBM ECC")
+    # Deviceless event → device None.
+    post_event(str(tmp_path), 63, None, "link down")
+    e2 = native_lib.wait_for_event(2.0)
+    assert (e2.code, e2.device) == (63, None)
+    assert native_lib.wait_for_event(0.2) is None
+
+
+def test_event_inotify_wakeup(native_lib, tmp_path):
+    """An event posted while blocked must wake the waiter promptly."""
+    result = {}
+
+    def waiter():
+        result["event"] = native_lib.wait_for_event(10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    start = time.monotonic()
+    post_event(str(tmp_path), 72, "accel0", "hang")
+    t.join(timeout=5)
+    latency = time.monotonic() - start
+    assert result["event"] is not None
+    assert result["event"].code == 72
+    assert latency < 3.0, f"event latency {latency:.1f}s — inotify not working"
+
+
+def test_malformed_event_discarded(native_lib, tmp_path):
+    events = os.path.join(str(tmp_path), "var/run/tpu/events")
+    with open(os.path.join(events, "0000.json"), "w") as f:
+        f.write('{"code": 48, "device": "acc')  # truncated
+    post_event(str(tmp_path), 48, "accel1", "good one")
+    e = native_lib.wait_for_event(2.0)
+    assert e is not None and e.device == "accel1"
+    assert os.listdir(events) == []  # both files consumed
+
+
+def test_empty_root(tmp_path):
+    from container_engine_accelerators_tpu.tpulib.native import NativeTpuLib
+
+    lib = NativeTpuLib(str(tmp_path))
+    assert lib.chip_count() == 0
+    assert lib.chips() == []
+    lib.close()
+
+
+def test_open_lib_prefers_native(tmp_path):
+    from container_engine_accelerators_tpu.tpulib import open_lib
+    from container_engine_accelerators_tpu.tpulib.native import NativeTpuLib
+
+    write_fixture(str(tmp_path), 1)
+    lib = open_lib(str(tmp_path))
+    assert isinstance(lib, NativeTpuLib)
+    assert lib.chip_count() == 1
+    lib.close()
