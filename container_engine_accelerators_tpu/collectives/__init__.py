@@ -1,0 +1,6 @@
+from container_engine_accelerators_tpu.collectives.bench import (
+    CollectiveResult,
+    run_sweep,
+)
+
+__all__ = ["CollectiveResult", "run_sweep"]

@@ -1,0 +1,250 @@
+"""XLA collectives bandwidth rig — the nccl-tests analog, TPU-native.
+
+The reference validates its comms stack with nccl-tests under MPI: a
+message-size sweep 1M→512M (×2/step), 100 iters, 5 warmup, reporting bus
+bandwidth (gpudirect-tcpx/nccl-config.yaml:17,60-63).  Here the transport
+is XLA collectives over ICI/DCN and the launcher is JAX — same sweep
+semantics, same bus-bandwidth accounting as nccl-tests:
+
+    all-reduce      busbw = algbw * 2(n-1)/n
+    all-gather      busbw = algbw * (n-1)/n      (S = total output bytes)
+    reduce-scatter  busbw = algbw * (n-1)/n
+    ppermute-ring   busbw = algbw               (point-to-point shift)
+
+Collectives are expressed with shard_map + lax primitives so the exact
+collective (not a GSPMD rewrite) is benchmarked.
+
+CLI (the nccl-test pod's entrypoint, deploy/xla-collectives/):
+
+    python -m container_engine_accelerators_tpu.collectives.bench \
+        -b 1M -e 512M -f 2 --iters 100 --warmup 5 --op all_reduce \
+        [--line-rate-gbps 1600 --pass-threshold 0.9]
+"""
+
+import argparse
+import dataclasses
+import functools
+import json
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class CollectiveResult:
+    op: str
+    size_bytes: int  # total message size S (nccl-tests convention)
+    time_us: float
+    alg_bw_gbps: float  # GB/s
+    bus_bw_gbps: float
+
+
+def _parse_size(s: str) -> int:
+    s = s.strip().upper()
+    mult = 1
+    if s.endswith("G"):
+        mult, s = 2**30, s[:-1]
+    elif s.endswith("M"):
+        mult, s = 2**20, s[:-1]
+    elif s.endswith("K"):
+        mult, s = 2**10, s[:-1]
+    return int(float(s) * mult)
+
+
+def _bus_factor(op: str, n: int) -> float:
+    if op == "all_reduce":
+        return 2 * (n - 1) / n
+    if op in ("all_gather", "reduce_scatter"):
+        return (n - 1) / n
+    return 1.0  # ppermute ring
+
+
+def _make_collective(op: str, mesh: Mesh) -> Callable:
+    """Build a jitted fn(x, reps) running `reps` chained collectives.
+
+    The chain lives INSIDE shard_map as a fori_loop over per-device local
+    blocks, with a data dependency between iterations so XLA can neither
+    elide nor overlap them — the same serialization nccl-tests enforces.
+    Each iteration is made local-shape-preserving (slicing its own chunk
+    back out of an all-gather, re-tiling a reduce-scatter) so the loop
+    carries a fixed-shape value.
+    """
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+
+    if op == "all_reduce":
+
+        def one(c):
+            return jax.lax.psum(c, axis)
+
+    elif op == "all_gather":
+
+        def one(c):
+            gathered = jax.lax.all_gather(c, axis, tiled=True)  # (n*e,)
+            idx = jax.lax.axis_index(axis)
+            return jax.lax.dynamic_slice_in_dim(
+                gathered, idx * c.shape[0], c.shape[0]
+            )
+
+    elif op == "reduce_scatter":
+
+        def one(c):
+            scattered = jax.lax.psum_scatter(c, axis, tiled=True)  # (e/n,)
+            return jnp.tile(scattered, n)
+
+    elif op == "ppermute":
+
+        def one(c):
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return jax.lax.ppermute(c, axis, perm)
+
+    else:
+        raise ValueError(f"unknown collective {op!r}")
+
+    def step(c):
+        y = one(c)
+        # psum output is typed axis-invariant; convert back to varying so
+        # the fori_loop carry type is stable.  Other collectives already
+        # produce varying outputs (pcast would reject a no-op cast).
+        if op == "all_reduce":
+            if hasattr(jax.lax, "pcast"):
+                y = jax.lax.pcast(y, (axis,), to="varying")
+            elif hasattr(jax.lax, "pvary"):
+                y = jax.lax.pvary(y, (axis,))
+        return y
+
+    def local_loop(c, reps):
+        return jax.lax.fori_loop(0, reps, lambda i, c: step(c), c)
+
+    mapped = shard_map(
+        local_loop,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(axis),
+    )
+    # reps is a traced argument (dynamic fori_loop bound), so warmup and
+    # timed runs share ONE compiled executable — a separate warmup
+    # executable would leave the timed one cold.
+    return jax.jit(mapped)
+
+
+def run_sweep(
+    mesh: Optional[Mesh] = None,
+    min_bytes: int = 2**20,
+    max_bytes: int = 2**29,
+    step_factor: int = 2,
+    iters: int = 100,
+    warmup: int = 5,
+    op: str = "all_reduce",
+    dtype=jnp.bfloat16,
+) -> List[CollectiveResult]:
+    if step_factor < 2:
+        raise ValueError(f"step factor must be >= 2, got {step_factor}")
+    if mesh is None:
+        devs = jax.devices()
+        mesh = Mesh(np.array(devs), ("x",))
+    n = mesh.devices.size
+    itemsize = jnp.dtype(dtype).itemsize
+    results = []
+
+    fn = _make_collective(op, mesh)
+    size = min_bytes
+    while size <= max_bytes:
+        # nccl-tests accounting: `size` S is the per-rank payload — the
+        # buffer each rank holds for all-reduce / reduce-scatter / sendrecv,
+        # and the total gathered output for all-gather.  shard_map splits
+        # the global array n ways, so the global element count is sized to
+        # make each device's local block S bytes (S/n for all-gather,
+        # whose chained step re-gathers to S).
+        local_elems = max(1, size // itemsize)
+        if op == "all_gather":
+            local_elems = max(1, size // itemsize // n)
+        global_shape = (n * local_elems,)
+        x = jax.device_put(
+            jnp.ones(global_shape, dtype),
+            NamedSharding(mesh, P(mesh.axis_names[0])),
+        )
+        jax.block_until_ready(fn(x, max(warmup, 1)))  # compile + warmup
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x, iters))
+        dt = (time.perf_counter() - t0) / iters
+
+        payload_bytes = local_elems * itemsize
+        if op == "all_gather":
+            payload_bytes *= n
+        alg_bw = payload_bytes / dt / 1e9
+        results.append(
+            CollectiveResult(
+                op=op,
+                size_bytes=payload_bytes,
+                time_us=dt * 1e6,
+                alg_bw_gbps=alg_bw,
+                bus_bw_gbps=alg_bw * _bus_factor(op, n),
+            )
+        )
+        size *= step_factor
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="XLA collectives bandwidth sweep")
+    p.add_argument("-b", "--min-bytes", default="1M")
+    p.add_argument("-e", "--max-bytes", default="512M")
+    p.add_argument("-f", "--step-factor", type=int, default=2)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument(
+        "--op",
+        default="all_reduce",
+        choices=["all_reduce", "all_gather", "reduce_scatter", "ppermute"],
+    )
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--line-rate-gbps", type=float, default=None,
+                   help="ICI/DCN line rate; enables the >=threshold pass bar")
+    p.add_argument("--pass-threshold", type=float, default=0.9)
+    p.add_argument("--json", action="store_true", help="one JSON line per size")
+    args = p.parse_args(argv)
+
+    from container_engine_accelerators_tpu.parallel import dcn
+
+    dcn.initialize()
+
+    results = run_sweep(
+        min_bytes=_parse_size(args.min_bytes),
+        max_bytes=_parse_size(args.max_bytes),
+        step_factor=args.step_factor,
+        iters=args.iters,
+        warmup=args.warmup,
+        op=args.op,
+        dtype=jnp.dtype(args.dtype),
+    )
+
+    n = len(jax.devices())
+    print(f"# {args.op} over {n} devices ({jax.devices()[0].platform})")
+    print(f"# {'bytes':>12} {'time(us)':>12} {'algbw(GB/s)':>12} "
+          f"{'busbw(GB/s)':>12}")
+    best = 0.0
+    for r in results:
+        best = max(best, r.bus_bw_gbps)
+        if args.json:
+            print(json.dumps(dataclasses.asdict(r)))
+        else:
+            print(f"  {r.size_bytes:>12} {r.time_us:>12.1f} "
+                  f"{r.alg_bw_gbps:>12.2f} {r.bus_bw_gbps:>12.2f}")
+    if args.line_rate_gbps:
+        frac = best / args.line_rate_gbps
+        ok = frac >= args.pass_threshold
+        print(f"# peak busbw {best:.1f} GB/s = {frac:.1%} of line rate "
+              f"{args.line_rate_gbps} GB/s -> {'PASS' if ok else 'FAIL'}")
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+from container_engine_accelerators_tpu.models.resnet import ResNet, resnet
+
+__all__ = ["ResNet", "resnet"]

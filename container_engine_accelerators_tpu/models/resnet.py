@@ -1,0 +1,142 @@
+"""ResNet in Flax — the flagship training workload.
+
+The reference ships ResNet as its GPU training demo (TF benchmarks image,
+sweeping depths 34-152 and batch sizes, demo/gpu-training/generate_job.sh:
+19-24) and as the TPU demo (TF 1.x TPU models, demo/tpu-training/
+resnet-tpu.yaml:69-73).  This is the TPU-native re-design: Flax + XLA,
+bfloat16 compute / float32 params, NHWC layout (TPU-preferred), and no
+data-dependent Python control flow so the whole step jits onto the MXU.
+
+Depths 18/34 use basic blocks; 50/101/152 use bottlenecks, matching the
+torchvision/TF channel plan the reference demo sweeps.
+"""
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+STAGE_SIZES = {
+    18: [2, 2, 2, 2],
+    34: [3, 4, 6, 3],
+    50: [3, 4, 6, 3],
+    101: [3, 4, 23, 3],
+    152: [3, 8, 36, 3],
+}
+
+
+class ResNetBlock(nn.Module):
+    """Basic residual block (depths 18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckResNetBlock(nn.Module):
+    """Bottleneck residual block (depths 50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    small_inputs: bool = False  # CIFAR-style stem for 32x32 test inputs
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        act = nn.relu
+
+        x = jnp.asarray(x, self.dtype)
+        if self.small_inputs:
+            x = conv(self.num_filters, (3, 3), (1, 1), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = act(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2**i,
+                    conv=conv,
+                    norm=norm,
+                    act=act,
+                    strides=strides,
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        # Classifier in float32 for numerically stable softmax/loss.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def resnet(depth: int = 50, **kwargs) -> ResNet:
+    """Build a ResNet of any depth the reference demo sweeps (34-152)."""
+    if depth not in STAGE_SIZES:
+        raise ValueError(f"unsupported ResNet depth {depth}; "
+                         f"choose from {sorted(STAGE_SIZES)}")
+    block_cls = ResNetBlock if depth < 50 else BottleneckResNetBlock
+    return ResNet(
+        stage_sizes=STAGE_SIZES[depth], block_cls=block_cls, **kwargs
+    )

@@ -1,0 +1,21 @@
+from container_engine_accelerators_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    create_hybrid_mesh,
+    create_mesh,
+    replicated,
+    shard_params,
+)
+from container_engine_accelerators_tpu.parallel import dcn
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharding",
+    "create_hybrid_mesh",
+    "create_mesh",
+    "replicated",
+    "shard_params",
+    "dcn",
+]

@@ -1,0 +1,79 @@
+"""Multi-host JAX initialization inside Kubernetes.
+
+The reference launches multi-host jobs with MPI + ssh between pods
+(gpudirect-tcpx/nccl-test.yaml, nccl-config.yaml:31-35).  The TPU-native
+launcher is ``jax.distributed.initialize`` with deterministic coordinator
+addressing from the Job's headless Service DNS — no ssh, no MPI
+(SURVEY.md §7 hard part (e)).
+
+Env contract (set by the Job manifest, deploy/xla-collectives/):
+
+    TPU_WORKER_ID         process index        (or JOB_COMPLETION_INDEX)
+    TPU_WORKER_COUNT      number of processes  (Job parallelism)
+    TPU_COORDINATOR_ADDR  host:port of process 0; when unset it is derived
+                          as <job>-0.<service>:8476 from JOB_NAME/SERVICE.
+"""
+
+import logging
+import os
+from typing import Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+def resolve_cluster(env=None) -> Tuple[Optional[str], int, int]:
+    """Return (coordinator_address, num_processes, process_id) from env.
+
+    Returns (None, 1, 0) for single-process runs.
+    """
+    env = env if env is not None else os.environ
+    num = int(env.get("TPU_WORKER_COUNT", env.get("NUM_TPU_WORKERS", "1")))
+    if num <= 1:
+        return None, 1, 0
+    pid_raw = env.get("TPU_WORKER_ID", env.get("JOB_COMPLETION_INDEX"))
+    if pid_raw is None:
+        raise ValueError(
+            "TPU_WORKER_COUNT > 1 but neither TPU_WORKER_ID nor "
+            "JOB_COMPLETION_INDEX is set"
+        )
+    process_id = int(pid_raw)
+    if not 0 <= process_id < num:
+        raise ValueError(f"process id {process_id} outside [0, {num})")
+
+    addr = env.get("TPU_COORDINATOR_ADDR")
+    if not addr:
+        job = env.get("JOB_NAME")
+        service = env.get("TPU_SERVICE_NAME", job)
+        if not job:
+            raise ValueError(
+                "multi-host run needs TPU_COORDINATOR_ADDR or JOB_NAME to "
+                "derive the coordinator from headless-service DNS"
+            )
+        # Indexed Jobs give pod 0 the stable DNS name <job>-0.<service>.
+        addr = f"{job}-0.{service}:{DEFAULT_COORDINATOR_PORT}"
+    elif ":" not in addr:
+        addr = f"{addr}:{DEFAULT_COORDINATOR_PORT}"
+    return addr, num, process_id
+
+
+def initialize(env=None) -> Tuple[int, int]:
+    """Initialize jax.distributed from the K8s env contract.
+
+    Safe to call in single-process runs (no-op).  Returns
+    (num_processes, process_id).
+    """
+    import jax
+
+    addr, num, pid = resolve_cluster(env)
+    if num <= 1:
+        return 1, 0
+    log.info(
+        "jax.distributed.initialize(coordinator=%s, num_processes=%d, "
+        "process_id=%d)", addr, num, pid,
+    )
+    jax.distributed.initialize(
+        coordinator_address=addr, num_processes=num, process_id=pid
+    )
+    return num, pid

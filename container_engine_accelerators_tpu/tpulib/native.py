@@ -81,6 +81,11 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int,
         ctypes.POINTER(_ChipInfoStruct),
     ]
+    lib.tpu_chip_info_all.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(_ChipInfoStruct),
+        ctypes.c_int,
+    ]
     lib.tpu_hbm_info.argtypes = [
         ctypes.c_void_p,
         ctypes.c_char_p,
@@ -129,14 +134,26 @@ class NativeTpuLib(TpuLib):
     def chip_count(self) -> int:
         return max(0, self._lib.tpu_chip_count(self._ctx))
 
+    _MAX_CHIPS = 256
+
     def chips(self) -> List[ChipInfo]:
-        out = []
-        for i in range(self.chip_count()):
-            chip = self._chip_at(i)
-            if chip is None:  # hotplug removal raced the enumeration
-                break
-            out.append(chip)
-        return out
+        # One native call, one directory scan: a consistent snapshot that
+        # can't race hotplug mid-enumeration.
+        arr = (_ChipInfoStruct * self._MAX_CHIPS)()
+        n = self._lib.tpu_chip_info_all(self._ctx, arr, self._MAX_CHIPS)
+        if n < 0:
+            raise OSError(f"tpu_chip_info_all failed: {n}")
+        return [
+            ChipInfo(
+                name=s.name.decode(),
+                index=s.index,
+                chip_id=s.chip_id,
+                pci_addr=s.pci_addr.decode(),
+                coords=tuple(s.coords),
+                topology=tuple(s.topology),
+            )
+            for s in arr[:n]
+        ]
 
     def _chip_at(self, index: int) -> Optional[ChipInfo]:
         s = _ChipInfoStruct()

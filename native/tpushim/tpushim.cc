@@ -91,10 +91,32 @@ bool parse_json_string(const std::string& s, size_t* i, std::string* out) {
         case '\\': out->push_back('\\'); break;
         case '/': out->push_back('/'); break;
         case 'u': {
-          // Keep it simple: skip 4 hex digits, emit '?' for non-ASCII.
           if (*i + 4 >= s.size()) return false;
+          int cp = 0;
+          for (int k = 1; k <= 4; k++) {
+            char h = s[*i + k];
+            int d;
+            if (h >= '0' && h <= '9') d = h - '0';
+            else if (h >= 'a' && h <= 'f') d = h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') d = h - 'A' + 10;
+            else return false;
+            cp = cp * 16 + d;
+          }
           *i += 4;
-          out->push_back('?');
+          // UTF-8 encode (surrogate pairs outside our producers' range
+          // degrade to '?' rather than corrupting the byte stream).
+          if (cp < 0x80) {
+            out->push_back(static_cast<char>(cp));
+          } else if (cp < 0x800) {
+            out->push_back(static_cast<char>(0xC0 | (cp >> 6)));
+            out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          } else if (cp >= 0xD800 && cp <= 0xDFFF) {
+            out->push_back('?');
+          } else {
+            out->push_back(static_cast<char>(0xE0 | (cp >> 12)));
+            out->push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+            out->push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          }
           break;
         }
         default: return false;
@@ -220,11 +242,27 @@ int tpu_chip_count(tpu_ctx* ctx) {
   return static_cast<int>(list_chips(ctx).size());
 }
 
+static void fill_chip_info(tpu_ctx* ctx, const std::string& name,
+                           tpu_chip_info_t* out);
+
 int tpu_chip_info(tpu_ctx* ctx, int index, tpu_chip_info_t* out) {
   if (!ctx || !out) return -EINVAL;
   std::vector<std::string> chips = list_chips(ctx);
   if (index < 0 || index >= static_cast<int>(chips.size())) return -ERANGE;
-  const std::string& name = chips[index];
+  fill_chip_info(ctx, chips[index], out);
+  return 0;
+}
+
+int tpu_chip_info_all(tpu_ctx* ctx, tpu_chip_info_t* out, int max_n) {
+  if (!ctx || !out || max_n < 0) return -EINVAL;
+  std::vector<std::string> chips = list_chips(ctx);
+  int n = std::min<int>(max_n, static_cast<int>(chips.size()));
+  for (int i = 0; i < n; i++) fill_chip_info(ctx, chips[i], &out[i]);
+  return n;
+}
+
+static void fill_chip_info(tpu_ctx* ctx, const std::string& name,
+                           tpu_chip_info_t* out) {
   memset(out, 0, sizeof(*out));
   snprintf(out->name, sizeof(out->name), "%s", name.c_str());
   out->index = std::atoi(name.c_str() + 5);
@@ -236,7 +274,6 @@ int tpu_chip_info(tpu_ctx* ctx, int index, tpu_chip_info_t* out) {
                out->coords);
   parse_triple(chip_attr(ctx, name, "topology", &v) ? v : "1x1x1", 'x',
                out->topology);
-  return 0;
 }
 
 int tpu_hbm_info(tpu_ctx* ctx, const char* name, int64_t* total_bytes,

@@ -50,6 +50,10 @@ void tpu_close(tpu_ctx* ctx);
 
 int tpu_chip_count(tpu_ctx* ctx);
 int tpu_chip_info(tpu_ctx* ctx, int index, tpu_chip_info_t* out);
+/* Fill up to max_n entries from ONE directory scan; returns the number
+ * filled (the snapshot is consistent, unlike per-index queries racing
+ * hotplug). */
+int tpu_chip_info_all(tpu_ctx* ctx, tpu_chip_info_t* out, int max_n);
 int tpu_hbm_info(tpu_ctx* ctx, const char* name, int64_t* total_bytes,
                  int64_t* used_bytes);
 /* Returns duty cycle 0-100, or negative on error. */

@@ -8,7 +8,29 @@ it with tempdirs; JAX-level tests run on a virtual 8-device CPU mesh.
 import os
 import sys
 
-# Must be set before jax is imported anywhere.
+# Tests need a virtual 8-device CPU mesh.  Under the axon TPU environment,
+# sitecustomize pre-initializes JAX with the TPU backend before conftest
+# runs, so env changes here are too late — re-exec the test process with
+# the TPU plugin disabled and CPU forced.
+if (
+    os.environ.get("PALLAS_AXON_POOL_IPS")
+    and os.environ.get("CEA_TPU_TESTS") != "1"
+):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest"] + sys.argv[1:],
+        env,
+    )
+
+# Plain environments: set before jax is imported anywhere.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

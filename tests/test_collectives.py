@@ -1,0 +1,98 @@
+"""Collectives rig tests: correctness of the sweep machinery on the CPU
+mesh (bandwidth numbers are meaningless on CPU; semantics are not)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from container_engine_accelerators_tpu.collectives.bench import (
+    _bus_factor,
+    _make_collective,
+    _parse_size,
+    run_sweep,
+)
+
+
+def test_parse_size():
+    assert _parse_size("1M") == 2**20
+    assert _parse_size("512M") == 512 * 2**20
+    assert _parse_size("2G") == 2 * 2**30
+    assert _parse_size("128K") == 128 * 2**10
+    assert _parse_size("4096") == 4096
+
+
+def test_bus_factors_match_nccl_tests_conventions():
+    assert _bus_factor("all_reduce", 8) == pytest.approx(2 * 7 / 8)
+    assert _bus_factor("all_gather", 8) == pytest.approx(7 / 8)
+    assert _bus_factor("reduce_scatter", 8) == pytest.approx(7 / 8)
+    assert _bus_factor("ppermute", 8) == 1.0
+
+
+def test_all_reduce_value_correct():
+    """One chained all_reduce rep: every shard must hold the global sum."""
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    n = len(jax.devices())
+    fn = _make_collective("all_reduce", mesh)
+    x = jnp.arange(n * 4, dtype=jnp.float32)
+    out = fn(x, 1)
+    # psum of shards: shard i holds x[i*4:(i+1)*4]; sum over i.
+    expected = x.reshape(n, 4).sum(0)
+    np.testing.assert_allclose(np.asarray(out).reshape(n, 4)[0], expected)
+    np.testing.assert_allclose(np.asarray(out).reshape(n, 4)[-1], expected)
+
+
+def test_ppermute_ring_rotates():
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    n = len(jax.devices())
+    fn = _make_collective("ppermute", mesh)
+    x = jnp.repeat(jnp.arange(n, dtype=jnp.float32), 2)  # shard i = [i, i]
+    out = np.asarray(fn(x, 1)).reshape(n, 2)
+    # One ring shift: device (i+1) now holds i's data.
+    for i in range(n):
+        assert out[(i + 1) % n][0] == i
+
+
+def test_ppermute_full_ring_roundtrip():
+    """n chained shifts must return every shard to its origin."""
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    n = len(jax.devices())
+    fn = _make_collective("ppermute", mesh)
+    x = jnp.arange(n * 2, dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(fn(x, n)), np.asarray(x))
+
+
+@pytest.mark.parametrize("op", ["all_reduce", "all_gather", "reduce_scatter",
+                                "ppermute"])
+def test_sweep_runs_all_ops(op):
+    results = run_sweep(
+        min_bytes=2**12, max_bytes=2**13, iters=2, warmup=1, op=op,
+        dtype=jnp.float32,
+    )
+    assert len(results) == 2
+    for r in results:
+        assert r.time_us > 0
+        assert r.bus_bw_gbps > 0
+        assert r.size_bytes >= 2**12
+
+
+def test_bad_step_factor_rejected():
+    with pytest.raises(ValueError, match="step factor"):
+        run_sweep(min_bytes=2**12, max_bytes=2**13, step_factor=1, iters=1,
+                  warmup=1)
+
+
+def test_per_rank_payload_accounting():
+    """nccl-tests convention: size_bytes is the per-rank payload, not the
+    global array size (which is n x larger for all_reduce)."""
+    results = run_sweep(
+        min_bytes=2**12, max_bytes=2**12, iters=2, warmup=1,
+        op="all_reduce", dtype=jnp.float32,
+    )
+    assert results[0].size_bytes == 2**12
+    gathered = run_sweep(
+        min_bytes=2**13, max_bytes=2**13, iters=2, warmup=1,
+        op="all_gather", dtype=jnp.float32,
+    )
+    assert gathered[0].size_bytes == 2**13

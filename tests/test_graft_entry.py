@@ -1,0 +1,27 @@
+"""Driver-contract tests: entry() and dryrun_multichip() must work."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax
+
+
+def test_entry_compiles(monkeypatch):
+    monkeypatch.setenv("GRAFT_BATCH", "2")
+    monkeypatch.setenv("GRAFT_IMAGE_SIZE", "64")
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+    assert out.shape == (2, 1000)
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)

@@ -1,0 +1,111 @@
+"""Model + train-step tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.models import resnet
+from container_engine_accelerators_tpu.models.train import (
+    cosine_sgd,
+    create_train_state,
+    make_sharded_train_step,
+    train_step,
+)
+from container_engine_accelerators_tpu.parallel import (
+    batch_sharding,
+    create_mesh,
+)
+
+
+def tiny_model():
+    return resnet(depth=18, num_classes=10, num_filters=8, small_inputs=True)
+
+
+def test_resnet_depths_build():
+    for depth in (18, 34, 50, 101, 152):
+        m = resnet(depth=depth)
+        assert m is not None
+    with pytest.raises(ValueError, match="unsupported ResNet depth"):
+        resnet(depth=42)
+
+
+def test_forward_shapes_and_dtype():
+    m = tiny_model()
+    x = jnp.ones((2, 32, 32, 3))
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    logits = m.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+    assert logits.dtype == jnp.float32  # head upcasts for stable loss
+
+
+def test_resnet50_bottleneck_param_shapes():
+    m = resnet(depth=50, num_filters=8)
+    x = jnp.ones((1, 64, 64, 3))
+    variables = m.init(jax.random.PRNGKey(0), x, train=False)
+    # Bottleneck expansion: final stage output channels = 8 * 2^3 * 4.
+    head_kernel = variables["params"]["head"]["kernel"]
+    assert head_kernel.shape[0] == 8 * 8 * 4
+
+
+def test_train_step_learns():
+    """Loss must decrease on a fixed batch — the end-to-end learning check."""
+    m = tiny_model()
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (16, 32, 32, 3))
+    y = jax.random.randint(rng, (16,), 0, 10)
+    state = create_train_state(
+        m, rng, x, tx=cosine_sgd(base_lr=0.05, total_steps=50, warmup_steps=0)
+    )
+    step = jax.jit(train_step)
+    state, first = step(state, x, y)
+    for _ in range(15):
+        state, metrics = step(state, x, y)
+    assert float(metrics["loss"]) < float(first["loss"])
+    assert int(state.step) == 16
+
+
+def test_sharded_train_step_runs_and_matches_mesh():
+    mesh = create_mesh(data=4, model=2)
+    m = tiny_model()
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (16, 32, 32, 3))
+    y = jax.random.randint(rng, (16,), 0, 10)
+    state = create_train_state(m, rng, x)
+    step_fn, placed = make_sharded_train_step(mesh, state)
+    xs = jax.device_put(x, batch_sharding(mesh))
+    ys = jax.device_put(y, batch_sharding(mesh))
+    new_state, metrics = step_fn(placed, xs, ys)
+    assert np.isfinite(float(metrics["loss"]))
+    # Tensor parallelism is real: at least one param is sharded over model.
+    shardings = jax.tree_util.tree_map(
+        lambda a: a.sharding.spec, new_state.params
+    )
+    specs = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda s: hasattr(s, "__iter__") or s is None
+    )
+    assert any("model" in str(s) for s in specs), specs
+
+
+def test_sharded_matches_single_device_loss():
+    """The sharded step must compute the same math as the local step."""
+    mesh = create_mesh(data=4, model=2)
+    m = tiny_model()
+    rng = jax.random.PRNGKey(1)
+    x = jax.random.normal(rng, (8, 32, 32, 3))
+    y = jax.random.randint(rng, (8,), 0, 10)
+
+    state_local = create_train_state(m, rng, x)
+    _, local_metrics = jax.jit(train_step)(state_local, x, y)
+
+    state_sh = create_train_state(m, rng, x)
+    step_fn, placed = make_sharded_train_step(mesh, state_sh)
+    _, sharded_metrics = step_fn(
+        placed,
+        jax.device_put(x, batch_sharding(mesh)),
+        jax.device_put(y, batch_sharding(mesh)),
+    )
+    np.testing.assert_allclose(
+        float(local_metrics["loss"]), float(sharded_metrics["loss"]),
+        rtol=2e-2,
+    )

@@ -112,3 +112,17 @@ def test_open_lib_prefers_native(tmp_path):
     assert isinstance(lib, NativeTpuLib)
     assert lib.chip_count() == 1
     lib.close()
+
+
+def test_unicode_escapes_decoded(native_lib, tmp_path):
+    """\\uXXXX escapes from conservative JSON writers must decode, not
+    corrupt the device field (e.g. Go encoders escape '<' as \\u003c)."""
+    import json as _json
+
+    events = os.path.join(str(tmp_path), "var/run/tpu/events")
+    with open(os.path.join(events, "0001.json"), "w") as f:
+        f.write('{"code": 48, "device": "\\u0061ccel1", '
+                '"message": "temp \\u003c threshold"}')
+    e = native_lib.wait_for_event(2.0)
+    assert e.device == "accel1"
+    assert e.message == "temp < threshold"

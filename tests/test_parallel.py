@@ -1,0 +1,116 @@
+"""Mesh/sharding helpers + DCN cluster-resolution tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from container_engine_accelerators_tpu.parallel import (
+    batch_sharding,
+    create_hybrid_mesh,
+    create_mesh,
+    shard_params,
+)
+from container_engine_accelerators_tpu.parallel.dcn import resolve_cluster
+from container_engine_accelerators_tpu.parallel.mesh import _param_spec
+
+
+class TestMesh:
+    def test_create_mesh_all_data(self):
+        mesh = create_mesh()
+        assert dict(mesh.shape) == {"data": 8, "model": 1}
+
+    def test_create_mesh_dp_tp(self):
+        mesh = create_mesh(data=4, model=2)
+        assert dict(mesh.shape) == {"data": 4, "model": 2}
+
+    def test_bad_factorization_rejected(self):
+        with pytest.raises(ValueError):
+            create_mesh(data=3, model=2)
+        with pytest.raises(ValueError):
+            create_mesh(model=3)
+
+    def test_hybrid_mesh_exposes_dcn_axis(self):
+        # 2 "slices" of 4 devices each on the virtual CPU mesh.
+        mesh = create_hybrid_mesh(ici_data=4, ici_model=1, num_slices=2)
+        assert dict(mesh.shape) == {"dcn": 2, "data": 4, "model": 1}
+
+    def test_batch_sharding_spans_dcn_and_data(self):
+        mesh = create_hybrid_mesh(ici_data=4, ici_model=1, num_slices=2)
+        sh = batch_sharding(mesh)
+        assert sh.spec == P(("dcn", "data"))
+
+
+class TestParamSpec:
+    def test_conv_kernel_sharded_on_output_channels(self):
+        # HWIO conv kernel: output channel axis (last) wins ties.
+        assert _param_spec((3, 3, 64, 128), 2) == P(None, None, None, "model")
+
+    def test_dense_kernel(self):
+        assert _param_spec((256, 512), 4) == P(None, "model")
+
+    def test_small_param_replicated(self):
+        assert _param_spec((7,), 4) == P()
+        assert _param_spec((), 4) == P()
+
+    def test_indivisible_replicated(self):
+        assert _param_spec((65, 33), 4) == P()
+
+    def test_model_size_one_replicates(self):
+        assert _param_spec((256, 512), 1) == P()
+
+    def test_shard_params_tree(self):
+        mesh = create_mesh(data=4, model=2)
+        params = {"w": jnp.ones((8, 16)), "b": jnp.ones((3,))}
+        sh = shard_params(params, mesh)
+        assert sh["w"].spec == P(None, "model")
+        assert sh["b"].spec == P()
+
+
+class TestResolveCluster:
+    def test_single_process_default(self):
+        assert resolve_cluster({}) == (None, 1, 0)
+
+    def test_explicit_coordinator(self):
+        addr, n, pid = resolve_cluster(
+            {
+                "TPU_WORKER_COUNT": "4",
+                "TPU_WORKER_ID": "2",
+                "TPU_COORDINATOR_ADDR": "host0:9999",
+            }
+        )
+        assert (addr, n, pid) == ("host0:9999", 4, 2)
+
+    def test_coordinator_port_defaulted(self):
+        addr, _, _ = resolve_cluster(
+            {
+                "TPU_WORKER_COUNT": "2",
+                "TPU_WORKER_ID": "0",
+                "TPU_COORDINATOR_ADDR": "host0",
+            }
+        )
+        assert addr == "host0:8476"
+
+    def test_derived_from_job_dns(self):
+        addr, n, pid = resolve_cluster(
+            {
+                "TPU_WORKER_COUNT": "2",
+                "JOB_COMPLETION_INDEX": "1",
+                "JOB_NAME": "allreduce",
+            }
+        )
+        assert addr == "allreduce-0.allreduce:8476"
+        assert (n, pid) == (2, 1)
+
+    def test_missing_worker_id_rejected(self):
+        with pytest.raises(ValueError, match="TPU_WORKER_ID"):
+            resolve_cluster({"TPU_WORKER_COUNT": "2"})
+
+    def test_out_of_range_rejected(self):
+        with pytest.raises(ValueError, match="outside"):
+            resolve_cluster({"TPU_WORKER_COUNT": "2", "TPU_WORKER_ID": "5"})
+
+    def test_no_dns_material_rejected(self):
+        with pytest.raises(ValueError, match="JOB_NAME"):
+            resolve_cluster({"TPU_WORKER_COUNT": "2", "TPU_WORKER_ID": "0"})
