@@ -1,0 +1,29 @@
+#!/usr/bin/env python3
+"""Node topology-label daemon entry point (DaemonSet).
+
+Analog of the reference's label-nodes-daemon
+(ref: gpudirect-tcpxo/topology-scheduler/label-nodes-daemon.py:58-67):
+every 600s, read GCE/TPU metadata and patch this node's topology labels.
+"""
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.scheduler import labeler
+from container_engine_accelerators_tpu.scheduler.k8s import (
+    CoreV1,
+    in_cluster_transport,
+)
+
+
+def main():
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    labeler.run_forever(CoreV1(in_cluster_transport()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Topology-aware scheduler daemon entry point.
+
+Deployment-mode analog of the reference's schedule-daemon
+(ref: gpudirect-tcpxo/topology-scheduler/schedule-daemon.py:402-423):
+in-cluster credentials, 1s loop, gate prefix and ignored namespaces via
+flags.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.scheduler.daemon import (
+    DEFAULT_GATE_PREFIX,
+    SchedulerDaemon,
+)
+from container_engine_accelerators_tpu.scheduler.k8s import (
+    CoreV1,
+    in_cluster_transport,
+)
+
+
+def main():
+    parser = argparse.ArgumentParser(prog="topology-scheduler")
+    parser.add_argument("-g", "--gate", default=DEFAULT_GATE_PREFIX,
+                        help="scheduling-gate name prefix to own")
+    parser.add_argument("-i", "--interval", type=float, default=1.0,
+                        help="seconds between scheduling passes")
+    parser.add_argument("--ignored-namespace", nargs="*", default=[])
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    api = CoreV1(in_cluster_transport())
+    SchedulerDaemon(
+        api,
+        gate_prefix=args.gate,
+        interval_s=args.interval,
+        ignored_namespaces=args.ignored_namespace,
+    ).run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -60,7 +60,11 @@ def create_hybrid_mesh(
     """
     if num_slices <= 1:
         return create_mesh(ici_data, ici_model)
-    try:
+    devices = jax.devices()
+    if getattr(devices[0], "slice_index", None) is not None:
+        # Real multi-slice hardware: let mesh_utils honor slice boundaries.
+        # A shape error here is a misconfiguration and must surface — a
+        # silent flat fallback would route ICI-axis traffic over DCN.
         mesh_devices = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(ici_data, ici_model),
             dcn_mesh_shape=(num_slices, 1),
@@ -70,10 +74,9 @@ def create_hybrid_mesh(
         mesh_devices = np.asarray(mesh_devices).reshape(
             num_slices, ici_data, ici_model
         )
-    except ValueError:
+    else:
         # Devices without slice_index (CPU mesh in tests, single-slice
         # simulation): slice-major assignment over the flat device list.
-        devices = jax.devices()
         need = num_slices * ici_data * ici_model
         if len(devices) < need:
             raise ValueError(

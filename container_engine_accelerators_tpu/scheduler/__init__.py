@@ -1,0 +1,32 @@
+"""Topology-aware scheduler for multi-host TPU jobs.
+
+TPU-native analog of the reference's topology scheduler
+(ref: gpudirect-tcpxo/topology-scheduler/schedule-daemon.py,
+label-nodes-daemon.py): a label daemon stamps nodes with DCN topology
+(cluster/rack/host) plus TPU slice/ICI-coordinate labels, and a
+scheduling daemon places scheduling-gated job pods to minimize summed
+topology distance — ICI hop distance within a slice, hierarchical DCN
+distance across slices.
+"""
+
+from container_engine_accelerators_tpu.scheduler.daemon import (
+    SchedulerDaemon,
+    calculate_pods_assignment,
+    find_pod_gates,
+    find_schedulable_nodes,
+    find_schedulable_pods,
+)
+from container_engine_accelerators_tpu.scheduler.topology import (
+    node_topology_distance,
+    node_topology_key,
+)
+
+__all__ = [
+    "SchedulerDaemon",
+    "calculate_pods_assignment",
+    "find_pod_gates",
+    "find_schedulable_nodes",
+    "find_schedulable_pods",
+    "node_topology_distance",
+    "node_topology_key",
+]

@@ -1,0 +1,374 @@
+"""Topology-aware scheduling daemon for gated TPU job pods.
+
+Behavioral parity with the reference scheduler
+(ref: gpudirect-tcpxo/topology-scheduler/schedule-daemon.py):
+
+- pods carrying a scheduling gate prefixed ``gke.io/topology-aware-auto-``
+  are collected per gate (:197-205), grouped by job and FIFO-ordered by
+  creation time (:26-37,368-369);
+- candidate nodes must carry topology labels, have every taint tolerated,
+  and have free capacity = allocatable − Σ(requests of pods already on
+  the node) (:127-194);
+- pods sorted by completion index, nodes by topology key; an exhaustive
+  increasing-index search picks the assignment minimizing the summed
+  neighbor distance (:329-360) — here ICI hops within a slice, DCN
+  hierarchy across (topology.py);
+- binding removes the gate and pins ``kubernetes.io/hostname`` via
+  required nodeAffinity, then PUTs the pod back (:298-326).
+
+Everything operates on plain Kubernetes-JSON dicts, so the whole flow is
+unit-testable against fixture dicts with no cluster (SURVEY.md §4).
+"""
+
+import logging
+import time
+from itertools import groupby
+from typing import Dict, List, Optional, Set
+
+from container_engine_accelerators_tpu.scheduler.k8s import ApiException, CoreV1
+from container_engine_accelerators_tpu.scheduler.quantity import parse_quantity
+from container_engine_accelerators_tpu.scheduler.topology import (
+    PLACEMENT_GROUP_LABEL,
+    node_topology_distance,
+    node_topology_key,
+)
+
+log = logging.getLogger(__name__)
+
+DEFAULT_GATE_PREFIX = "gke.io/topology-aware-auto-"
+TPU_RESOURCE = "google.com/tpu"
+JOB_NAME_LABEL = "job-name"
+COMPLETION_INDEX_LABEL = "batch.kubernetes.io/job-completion-index"
+
+
+# ---- pod/job ordering ------------------------------------------------------
+
+
+def split_pods_based_on_jobs(pods) -> List[List[dict]]:
+    """Group schedulable-pod dicts by job name (consecutive groupby, as in
+    the reference; callers sort groups by creation time right after)."""
+    return [
+        list(group)
+        for _, group in groupby(pods, key=lambda p: p.get("job_name"))
+    ]
+
+
+def job_creation_time(job: List[dict]):
+    return job[0].get("creation_time") or ""
+
+
+def pod_sorting_key(pod: dict):
+    """Completion index when present; otherwise (prefix, numeric-suffix)
+    so 'xxx-pod2' sorts before 'xxx-pod10'."""
+    if pod.get("index") is not None:
+        return int(pod["index"])
+    name = pod["name"]
+    stripped = name.rstrip("0123456789")
+    suffix = name[len(stripped):]
+    return (stripped, int(suffix) if suffix else 0)
+
+
+# ---- discovery -------------------------------------------------------------
+
+
+def find_pod_gates(pods: List[dict], prefix: str) -> Set[str]:
+    """All gate names with the topology prefix across pending pods."""
+    gates = set()
+    for pod in pods:
+        for g in pod.get("spec", {}).get("schedulingGates", []) or []:
+            if g.get("name", "").startswith(prefix):
+                gates.add(g["name"])
+    return gates
+
+
+def _container_requests(spec: dict):
+    cpu = mem = tpu = 0.0
+    for container in spec.get("containers", []):
+        req = (container.get("resources") or {}).get("requests") or {}
+        cpu += parse_quantity(req.get("cpu", 0))
+        mem += parse_quantity(req.get("memory", 0))
+        tpu += int(parse_quantity(req.get(TPU_RESOURCE, 0)))
+    return cpu, mem, tpu
+
+
+def find_schedulable_pods(pods: List[dict], gate_name: str) -> Dict[str, dict]:
+    """Pods still carrying ``gate_name``, flattened to scheduling records."""
+    out = {}
+    for pod in pods:
+        spec = pod.get("spec", {})
+        if not any(
+            g.get("name") == gate_name
+            for g in spec.get("schedulingGates", []) or []
+        ):
+            continue
+        meta = pod.get("metadata", {})
+        labels = meta.get("labels") or {}
+        cpu, mem, tpu = _container_requests(spec)
+        rec = {
+            "name": meta.get("name"),
+            "namespace": meta.get("namespace", "default"),
+            "index": labels.get(COMPLETION_INDEX_LABEL),
+            "job_name": labels.get(JOB_NAME_LABEL),
+            "creation_time": meta.get("creationTimestamp"),
+            "cpu": cpu,
+            "memory": mem,
+            "tpu": tpu,
+            "node_selector": spec.get("nodeSelector"),
+            "tolerations": spec.get("tolerations") or [],
+        }
+        out[rec["name"]] = rec
+        log.info(
+            "schedulable pod %s/%s cpu=%s mem=%s tpu=%s index=%s",
+            rec["namespace"], rec["name"], cpu, mem, tpu, rec["index"],
+        )
+    return out
+
+
+def _pod_used_resources(pod: dict):
+    """Requests of a pod already placed on a node; terminated containers
+    free their share (ref: schedule-daemon.py:94-109)."""
+    statuses = (pod.get("status") or {}).get("containerStatuses")
+    spec = pod.get("spec", {})
+    if statuses is None:
+        return _container_requests(spec)
+    cpu = mem = tpu = 0.0
+    for container, st in zip(spec.get("containers", []), statuses):
+        if (st.get("state") or {}).get("terminated") is not None:
+            continue
+        req = (container.get("resources") or {}).get("requests") or {}
+        cpu += parse_quantity(req.get("cpu", 0))
+        mem += parse_quantity(req.get("memory", 0))
+        tpu += int(parse_quantity(req.get(TPU_RESOURCE, 0)))
+    return cpu, mem, tpu
+
+
+def pods_tolerations(job: List[dict]) -> List[dict]:
+    """Jobs are homogeneous: all pods share one toleration set."""
+    return job[0].get("tolerations") or [] if job else []
+
+
+def _taints_tolerated(taints, tolerations) -> bool:
+    tol_by_key = {t.get("key"): t for t in tolerations or []}
+    for taint in taints or []:
+        tol = tol_by_key.get(taint.get("key"))
+        if tol is None:
+            return False
+        if tol.get("operator") == "Equal" and tol.get("value") != taint.get("value"):
+            return False
+    return True
+
+
+def find_schedulable_nodes(
+    nodes: List[dict], pods: List[dict], tolerations: List[dict]
+) -> Dict[str, dict]:
+    """Topology-labeled, untainted-or-tolerated nodes with free capacity."""
+    out = {}
+    for node in nodes:
+        meta = node.get("metadata", {})
+        name = meta.get("name")
+        labels = meta.get("labels") or {}
+        if PLACEMENT_GROUP_LABEL not in labels:
+            log.info("skipping node %s: no topology metadata", name)
+            continue
+        if not _taints_tolerated(node.get("spec", {}).get("taints"), tolerations):
+            log.info("skipping node %s: untolerated taint", name)
+            continue
+
+        alloc = (node.get("status") or {}).get("allocatable") or {}
+        free_cpu = parse_quantity(alloc.get("cpu", 0))
+        free_mem = parse_quantity(alloc.get("memory", 0))
+        free_tpu = int(parse_quantity(alloc.get(TPU_RESOURCE, 0)))
+        for pod in pods:
+            if pod.get("spec", {}).get("nodeName") == name:
+                cpu, mem, tpu = _pod_used_resources(pod)
+                free_cpu -= cpu
+                free_mem -= mem
+                free_tpu -= tpu
+
+        info = {
+            "name": name,
+            "cpu": free_cpu,
+            "memory": free_mem,
+            "tpu": free_tpu,
+            "node_labels": labels,
+        }
+        out[name] = info
+        log.info(
+            "candidate node %s cpu=%s mem=%s tpu=%s key=%s",
+            name, free_cpu, free_mem, free_tpu, node_topology_key(info),
+        )
+    return out
+
+
+# ---- assignment search -----------------------------------------------------
+
+
+def can_schedule(node: dict, pod: dict) -> bool:
+    selector = pod.get("node_selector")
+    labels = node["node_labels"]
+    if selector:
+        for key, value in selector.items():
+            if labels.get(key) != value:
+                return False
+    return (
+        node["cpu"] >= pod["cpu"]
+        and node["memory"] >= pod["memory"]
+        and node["tpu"] >= pod["tpu"]
+    )
+
+
+def calculate_pods_assignment(
+    sorted_nodes: List[dict], sorted_pods: List[dict]
+) -> List[int]:
+    """Exhaustive strictly-increasing-index assignment search minimizing
+    Σ distance(consecutive pods' nodes) (ref: schedule-daemon.py:329-360).
+
+    Node order is the topology sort, so increasing indices enumerate
+    physically-contiguous candidate sets; strict monotonicity both halves
+    the search space and enforces one pod per node.
+    """
+    if not sorted_pods:
+        return []
+    assignment = [-i for i in reversed(range(1, len(sorted_pods) + 1))]
+    best, best_distance = [], float("inf")
+
+    while True:
+        all_ok = True
+        i = len(assignment) - 1
+        while i >= 0 and all_ok:
+            assignment[i] += 1
+            if assignment[i] == len(sorted_nodes):
+                break
+            if assignment[i] >= 0 and can_schedule(
+                sorted_nodes[assignment[i]], sorted_pods[i]
+            ):
+                i -= 1
+            elif i < len(assignment) - 1 and assignment[i] == assignment[i + 1] - 1:
+                all_ok = False
+        if assignment[-1] == len(sorted_nodes):
+            break
+        if all_ok:
+            distance = sum(
+                node_topology_distance(
+                    sorted_nodes[assignment[i]], sorted_nodes[assignment[i - 1]]
+                )
+                for i in range(1, len(sorted_pods))
+            )
+            if distance < best_distance:
+                best, best_distance = assignment.copy(), distance
+
+    return best
+
+
+# ---- binding ---------------------------------------------------------------
+
+
+def schedule_pod_on_node(
+    api: CoreV1, pod_name: str, namespace: str, node_name: str, gate_name: str
+) -> bool:
+    """Remove the gate, pin the hostname via nodeAffinity, PUT the pod."""
+    try:
+        pod = api.read_namespaced_pod(pod_name, namespace)
+        gates = pod.get("spec", {}).get("schedulingGates", []) or []
+        if not any(g.get("name") == gate_name for g in gates):
+            return False
+        pod["spec"]["schedulingGates"] = [
+            g for g in gates if g.get("name") != gate_name
+        ]
+        pod["spec"]["affinity"] = {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{
+                        "matchExpressions": [{
+                            "key": "kubernetes.io/hostname",
+                            "operator": "In",
+                            "values": [node_name],
+                        }]
+                    }]
+                }
+            }
+        }
+        api.replace_namespaced_pod(pod_name, namespace, pod)
+        log.info("pod %s/%s scheduled on %s", namespace, pod_name, node_name)
+        return True
+    except ApiException as e:
+        log.error("binding %s/%s failed: %s", namespace, pod_name, e)
+        return False
+
+
+# ---- daemon ----------------------------------------------------------------
+
+
+class SchedulerDaemon:
+    def __init__(
+        self,
+        api: CoreV1,
+        gate_prefix: str = DEFAULT_GATE_PREFIX,
+        interval_s: float = 1.0,
+        ignored_namespaces: Optional[List[str]] = None,
+        settle_s: float = 5.0,
+        sleep=time.sleep,
+    ):
+        self.api = api
+        self.gate_prefix = gate_prefix
+        self.interval_s = interval_s
+        self.ignored_namespaces = set(ignored_namespaces or [])
+        self.settle_s = settle_s  # job-atomicity heuristic (ref :455-457)
+        self._sleep = sleep
+
+    def list_pods(self) -> List[dict]:
+        pods = []
+        for ns in self.api.list_namespaces():
+            name = ns.get("metadata", {}).get("name")
+            if name and name not in self.ignored_namespaces:
+                pods.extend(self.api.list_namespaced_pods(name))
+        return pods
+
+    def schedule_gate(self, pods: List[dict], gate: str) -> int:
+        """One pass for one gate; returns the number of pods bound."""
+        pods_to_schedule = find_schedulable_pods(pods, gate)
+        nodes = self.api.list_nodes()
+        log.info("gate %s: %d pods to schedule", gate, len(pods_to_schedule))
+
+        bound = 0
+        jobs = split_pods_based_on_jobs(pods_to_schedule.values())
+        for job in sorted(jobs, key=job_creation_time):
+            job_name = job[0].get("job_name")
+            candidates = find_schedulable_nodes(nodes, pods, pods_tolerations(job))
+            sorted_pods = sorted(job, key=pod_sorting_key)
+            sorted_nodes = sorted(candidates.values(), key=node_topology_key)
+            assignment = calculate_pods_assignment(sorted_nodes, sorted_pods)
+            if not assignment:
+                log.info("no placement for job %s under gate %s", job_name, gate)
+                continue
+            for i, pod in enumerate(sorted_pods):
+                node = sorted_nodes[assignment[i]]
+                if schedule_pod_on_node(
+                    self.api, pod["name"], pod["namespace"], node["name"], gate
+                ):
+                    bound += 1
+        return bound
+
+    def run_once(self) -> int:
+        pods = self.list_pods()
+        gates = find_pod_gates(pods, self.gate_prefix)
+        log.info("%d pods, %d gates", len(pods), len(gates))
+        if not gates:
+            return 0
+        self._sleep(self.settle_s)
+        bound = 0
+        for gate in gates:
+            pods = self.list_pods()  # re-list: stragglers may have appeared
+            bound += self.schedule_gate(pods, gate)
+        return bound
+
+    def run_forever(self):
+        while True:
+            t0 = time.time()
+            try:
+                self.run_once()
+            except ApiException as e:
+                log.error("scheduling pass failed: %s", e)
+            elapsed = time.time() - t0
+            if elapsed < self.interval_s:
+                self._sleep(self.interval_s - elapsed)

@@ -1,0 +1,105 @@
+"""Minimal Kubernetes API client (stdlib-only).
+
+The reference uses the official ``kubernetes`` Python client
+(ref: gpudirect-tcpxo/topology-scheduler/schedule-daemon.py:20-23,420-423);
+that package is not available in this image, so this is a thin REST
+client over ``urllib`` speaking the same API endpoints the scheduler
+needs.  All resources are plain parsed-JSON dicts (the wire format),
+which is also what the scheduling logic operates on — so tests inject a
+fake ``transport`` and never need a cluster.
+
+In-cluster config mirrors the official client's loader: API server from
+``KUBERNETES_SERVICE_HOST``/``_PORT``, bearer token and CA from the
+service-account mount.
+"""
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# transport(method, path, body_dict_or_None) -> parsed-JSON dict
+Transport = Callable[[str, str, Optional[dict]], dict]
+
+
+class ApiException(Exception):
+    def __init__(self, status: int, reason: str, body: str = ""):
+        super().__init__(f"HTTP {status}: {reason} {body[:200]}")
+        self.status = status
+        self.reason = reason
+        self.body = body
+
+
+def in_cluster_transport(
+    host: Optional[str] = None,
+    token_path: str = os.path.join(SERVICE_ACCOUNT_DIR, "token"),
+    ca_path: str = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt"),
+) -> Transport:
+    """Build a transport using the pod's service-account credentials."""
+    if host is None:
+        host = "https://{}:{}".format(
+            os.environ["KUBERNETES_SERVICE_HOST"],
+            os.environ.get("KUBERNETES_SERVICE_PORT", "443"),
+        )
+    ctx = ssl.create_default_context(
+        cafile=ca_path if os.path.exists(ca_path) else None
+    )
+
+    def transport(method: str, path: str, body: Optional[dict] = None) -> dict:
+        token = ""
+        if os.path.exists(token_path):  # re-read: tokens rotate
+            with open(token_path) as f:
+                token = f.read().strip()
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(host + path, data=data, method=method)
+        if token:
+            req.add_header("Authorization", "Bearer " + token)
+        req.add_header("Accept", "application/json")
+        if method == "PATCH":
+            req.add_header("Content-Type", "application/strategic-merge-patch+json")
+        elif data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, context=ctx, timeout=60) as resp:
+                return json.loads(resp.read().decode() or "{}")
+        except urllib.error.HTTPError as e:
+            raise ApiException(e.code, e.reason, e.read().decode(errors="replace"))
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            # Transient network failure: surface as ApiException so the
+            # daemon's catch-and-retry loop survives it (daemon.run_forever).
+            raise ApiException(0, f"transport error: {e}")
+
+    return transport
+
+
+class CoreV1:
+    """The CoreV1 surface the scheduler and labeler use."""
+
+    def __init__(self, transport: Transport):
+        self._t = transport
+
+    def list_namespaces(self) -> List[dict]:
+        return self._t("GET", "/api/v1/namespaces").get("items", [])
+
+    def list_namespaced_pods(self, namespace: str) -> List[dict]:
+        return self._t("GET", f"/api/v1/namespaces/{namespace}/pods").get(
+            "items", []
+        )
+
+    def list_nodes(self) -> List[dict]:
+        return self._t("GET", "/api/v1/nodes").get("items", [])
+
+    def read_namespaced_pod(self, name: str, namespace: str) -> dict:
+        return self._t("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def replace_namespaced_pod(self, name: str, namespace: str, pod: dict) -> dict:
+        return self._t("PUT", f"/api/v1/namespaces/{namespace}/pods/{name}", pod)
+
+    def patch_node_labels(self, name: str, labels: Dict[str, str]) -> dict:
+        return self._t(
+            "PATCH", f"/api/v1/nodes/{name}", {"metadata": {"labels": labels}}
+        )

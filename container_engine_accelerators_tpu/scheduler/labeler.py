@@ -1,0 +1,147 @@
+"""Node label daemon: GCE/TPU metadata → topology labels.
+
+The reference daemon reads the ``physical_host`` instance attribute and
+stamps ``topology.gke.io/{cluster,rack,host}``
+(ref: gpudirect-tcpxo/topology-scheduler/label-nodes-daemon.py:24-55).
+The TPU build stamps those same DCN labels plus the slice-local ICI
+labels the scheduler's distance function consumes (topology.py):
+
+  topology.tpu.gke.io/slice     TPU pod/slice id (``tpu-env`` TPU_NAME)
+  topology.tpu.gke.io/coords    this host's chip-origin in the slice mesh
+  cloud.google.com/gke-tpu-topology  slice bounds, e.g. ``4x4x4``
+
+Coordinates derive from the slice topology and the host's worker id:
+hosts tile the chip mesh in row-major order with a per-host sub-mesh
+(2x2x1 for v4/v5p-style 4-chip hosts), so
+``coords = unravel(worker_id, topology // host_bounds) * host_bounds``.
+
+The metadata fetcher is injectable for tests; the real one hits the GCE
+metadata server with the ``Metadata-Flavor: Google`` header.
+"""
+
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Optional, Tuple
+
+from container_engine_accelerators_tpu.scheduler.k8s import CoreV1
+from container_engine_accelerators_tpu.scheduler.topology import (
+    CLUSTER_LABEL,
+    COORDS_LABEL,
+    HOST_LABEL,
+    RACK_LABEL,
+    SLICE_LABEL,
+    TPU_TOPOLOGY_LABEL,
+    parse_topology,
+)
+
+log = logging.getLogger(__name__)
+
+METADATA_BASE = "http://metadata.google.internal/computeMetadata/v1"
+DEFAULT_HOST_BOUNDS = (2, 2, 1)  # chips per host on 4-chip TPU hosts
+UPDATE_INTERVAL_S = 600.0
+
+Fetcher = Callable[[str], Optional[str]]
+
+
+def metadata_fetcher(base: str = METADATA_BASE) -> Fetcher:
+    def fetch(path: str) -> Optional[str]:
+        req = urllib.request.Request(
+            base + path, headers={"Metadata-Flavor": "Google"}
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.read().decode()
+        except (urllib.error.URLError, OSError) as e:
+            log.warning("metadata fetch %s failed: %s", path, e)
+            return None
+
+    return fetch
+
+
+def parse_tpu_env(raw: str) -> Dict[str, str]:
+    """Parse the ``tpu-env`` attribute: ``KEY: 'value'`` per line."""
+    out = {}
+    for line in raw.splitlines():
+        if ":" not in line:
+            continue
+        key, _, value = line.partition(":")
+        out[key.strip()] = value.strip().strip("'\"")
+    return out
+
+
+def worker_coords(
+    worker_id: int,
+    topology: Tuple[int, ...],
+    host_bounds: Tuple[int, ...] = DEFAULT_HOST_BOUNDS,
+) -> Tuple[int, ...]:
+    """Chip-origin of host ``worker_id`` tiling the slice mesh row-major."""
+    grid = tuple(
+        max(1, t // h) for t, h in zip(topology, host_bounds)
+    )
+    rem = worker_id
+    idx = []
+    for g in reversed(grid):
+        idx.append(rem % g)
+        rem //= g
+    idx = tuple(reversed(idx))
+    return tuple(i * h for i, h in zip(idx, host_bounds))
+
+
+def compute_labels(fetch: Fetcher) -> Optional[Dict[str, str]]:
+    """All labels derivable from the metadata server; None if no identity."""
+    physical_host = fetch("/instance/attributes/physical_host")
+    if physical_host is None:
+        log.warning("physical host not found")
+        return None
+    parts = physical_host.strip().split("/")[1:]
+    if len(parts) < 3:
+        log.warning("malformed physical_host %r", physical_host)
+        return None
+    cluster, rack, host = parts[:3]
+    labels = {
+        CLUSTER_LABEL: cluster,
+        RACK_LABEL: rack,
+        HOST_LABEL: host,
+    }
+
+    tpu_env_raw = fetch("/instance/attributes/tpu-env")
+    if tpu_env_raw:
+        env = parse_tpu_env(tpu_env_raw)
+        slice_id = env.get("TPU_NAME") or env.get("NODE_ID")
+        topology_raw = env.get("TOPOLOGY")
+        worker_raw = env.get("WORKER_ID") or env.get("AGENT_WORKER_NUMBER")
+        if slice_id:
+            labels[SLICE_LABEL] = slice_id
+        topology = parse_topology(topology_raw)
+        if topology is not None:
+            labels[TPU_TOPOLOGY_LABEL] = topology_raw
+            if worker_raw is not None and worker_raw.isdigit():
+                coords = worker_coords(int(worker_raw), topology)
+                labels[COORDS_LABEL] = ",".join(str(c) for c in coords)
+        elif topology_raw:
+            log.warning("malformed TOPOLOGY metadata %r, skipping ICI labels",
+                        topology_raw)
+    return labels
+
+
+def update_node_labels(api: CoreV1, fetch: Fetcher) -> Optional[Dict[str, str]]:
+    node_name = fetch("/instance/name")
+    if node_name is None:
+        log.warning("node name not found")
+        return None
+    labels = compute_labels(fetch)
+    if labels is None:
+        return None
+    api.patch_node_labels(node_name.strip(), labels)
+    log.info("updated labels on node %s: %s", node_name.strip(), labels)
+    return labels
+
+
+def run_forever(api: CoreV1, fetch: Optional[Fetcher] = None):
+    fetch = fetch or metadata_fetcher()
+    while True:
+        log.info("starting node label update")
+        update_node_labels(api, fetch)
+        time.sleep(UPDATE_INTERVAL_S)

@@ -1,0 +1,123 @@
+"""Topology keys and distances for TPU-aware placement.
+
+The reference orders nodes by (placement-group, cluster, rack, host)
+labels and scores an assignment by a hierarchical label-prefix distance
+(ref: gpudirect-tcpxo/topology-scheduler/schedule-daemon.py:63-91).  The
+TPU-native extension: nodes inside one TPU slice also carry ICI mesh
+coordinates, and the distance between two hosts in the same slice is the
+torus hop distance between their coordinates — so the assignment search
+packs a job's pods onto ICI neighbors first, then minimizes DCN
+(cluster/rack/host) spread across slices.
+
+Node labels consumed (stamped by labeler.py):
+  cloud.google.com/gke-placement-group   opaque placement group id
+  topology.gke.io/cluster|rack|host      DCN physical hierarchy
+  topology.tpu.gke.io/slice              TPU slice id (pod name)
+  topology.tpu.gke.io/coords             host origin in slice mesh, "x,y,z"
+  cloud.google.com/gke-tpu-topology      slice mesh bounds, e.g. "4x4x4"
+"""
+
+from typing import Optional, Tuple
+
+# A mismatch at the outermost hierarchy level costs DCN_FAR; each matching
+# level divides by DCN_LEVEL_FACTOR (same envelope as the reference,
+# schedule-daemon.py:66-70).  Any DCN distance dwarfs any ICI distance.
+DCN_FAR = 1_000_000.0
+DCN_LEVEL_FACTOR = 100.0
+
+PLACEMENT_GROUP_LABEL = "cloud.google.com/gke-placement-group"
+CLUSTER_LABEL = "topology.gke.io/cluster"
+RACK_LABEL = "topology.gke.io/rack"
+HOST_LABEL = "topology.gke.io/host"
+SLICE_LABEL = "topology.tpu.gke.io/slice"
+COORDS_LABEL = "topology.tpu.gke.io/coords"
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+
+def parse_coords(raw: Optional[str]) -> Optional[Tuple[int, ...]]:
+    """'1,2,0' -> (1, 2, 0); None/garbage -> None."""
+    if not raw:
+        return None
+    try:
+        return tuple(int(p) for p in raw.replace("x", ",").split(","))
+    except ValueError:
+        return None
+
+
+def parse_topology(raw: Optional[str]) -> Optional[Tuple[int, ...]]:
+    """'4x4x4' -> (4, 4, 4)."""
+    if not raw:
+        return None
+    try:
+        return tuple(int(p) for p in raw.split("x"))
+    except ValueError:
+        return None
+
+
+def node_topology_key(node_info: dict) -> tuple:
+    """Sort key: DCN hierarchy, then slice, then ICI coordinates.
+
+    Nodes missing the DCN labels sort as an empty key (the reference does
+    the same and filters them out earlier, schedule-daemon.py:74-91).
+    """
+    labels = node_info["node_labels"]
+    if not all(
+        k in labels
+        for k in (PLACEMENT_GROUP_LABEL, CLUSTER_LABEL, RACK_LABEL, HOST_LABEL)
+    ):
+        return ()
+    key = (
+        labels[PLACEMENT_GROUP_LABEL],
+        labels[CLUSTER_LABEL],
+        labels[RACK_LABEL],
+        labels[HOST_LABEL],
+    )
+    slice_id = labels.get(SLICE_LABEL)
+    coords = parse_coords(labels.get(COORDS_LABEL))
+    if slice_id is not None and coords is not None:
+        key += (slice_id, coords)
+    return key
+
+
+def ici_hop_distance(
+    a: Tuple[int, ...], b: Tuple[int, ...], bounds: Optional[Tuple[int, ...]]
+) -> float:
+    """Torus hop distance between two ICI coordinates.
+
+    With mesh ``bounds`` (wraparound links, standard on full TPU pod
+    slices) each axis contributes min(|d|, bound - |d|) hops.
+    """
+    total = 0.0
+    for axis in range(min(len(a), len(b))):
+        d = abs(a[axis] - b[axis])
+        if bounds is not None and axis < len(bounds) and bounds[axis] > 0:
+            d = min(d, bounds[axis] - d)
+        total += d
+    return total
+
+
+def node_topology_distance(node1: dict, node2: dict) -> float:
+    """Distance between two nodes for the assignment objective.
+
+    Same slice + both have coords → ICI torus hops (small, < DCN floor).
+    Otherwise → hierarchical DCN distance: DCN_FAR at the first differing
+    level of (placement-group, cluster, rack, host), divided by
+    DCN_LEVEL_FACTOR per matching level; 0 when all four match.
+    """
+    l1, l2 = node1["node_labels"], node2["node_labels"]
+    slice1, slice2 = l1.get(SLICE_LABEL), l2.get(SLICE_LABEL)
+    if slice1 is not None and slice1 == slice2:
+        c1 = parse_coords(l1.get(COORDS_LABEL))
+        c2 = parse_coords(l2.get(COORDS_LABEL))
+        if c1 is not None and c2 is not None:
+            bounds = parse_topology(l1.get(TPU_TOPOLOGY_LABEL))
+            return ici_hop_distance(c1, c2, bounds)
+        return 0.0
+
+    k1, k2 = node_topology_key(node1)[:4], node_topology_key(node2)[:4]
+    result = DCN_FAR
+    for i in range(min(len(k1), len(k2))):
+        if k1[i] != k2[i]:
+            return result
+        result /= DCN_LEVEL_FACTOR
+    return 0.0 if k1 and k1 == k2 else result

@@ -37,6 +37,17 @@ class _ChipInfoStruct(ctypes.Structure):
     ]
 
 
+def _to_chip_info(s: "_ChipInfoStruct") -> ChipInfo:
+    return ChipInfo(
+        name=s.name.decode(),
+        index=s.index,
+        chip_id=s.chip_id,
+        pci_addr=s.pci_addr.decode(),
+        coords=tuple(s.coords),
+        topology=tuple(s.topology),
+    )
+
+
 class _EventStruct(ctypes.Structure):
     _fields_ = [
         ("code", ctypes.c_int32),
@@ -129,8 +140,6 @@ class NativeTpuLib(TpuLib):
 
     # -- enumeration ---------------------------------------------------------
 
-    _ERANGE = 34
-
     def chip_count(self) -> int:
         return max(0, self._lib.tpu_chip_count(self._ctx))
 
@@ -138,38 +147,17 @@ class NativeTpuLib(TpuLib):
 
     def chips(self) -> List[ChipInfo]:
         # One native call, one directory scan: a consistent snapshot that
-        # can't race hotplug mid-enumeration.
-        arr = (_ChipInfoStruct * self._MAX_CHIPS)()
-        n = self._lib.tpu_chip_info_all(self._ctx, arr, self._MAX_CHIPS)
-        if n < 0:
-            raise OSError(f"tpu_chip_info_all failed: {n}")
-        return [
-            ChipInfo(
-                name=s.name.decode(),
-                index=s.index,
-                chip_id=s.chip_id,
-                pci_addr=s.pci_addr.decode(),
-                coords=tuple(s.coords),
-                topology=tuple(s.topology),
-            )
-            for s in arr[:n]
-        ]
-
-    def _chip_at(self, index: int) -> Optional[ChipInfo]:
-        s = _ChipInfoStruct()
-        rc = self._lib.tpu_chip_info(self._ctx, index, ctypes.byref(s))
-        if rc == -self._ERANGE:
-            return None
-        if rc != 0:
-            raise OSError(f"tpu_chip_info({index}) failed: {rc}")
-        return ChipInfo(
-            name=s.name.decode(),
-            index=s.index,
-            chip_id=s.chip_id,
-            pci_addr=s.pci_addr.decode(),
-            coords=tuple(s.coords),
-            topology=tuple(s.topology),
-        )
+        # can't race hotplug mid-enumeration.  Grow the buffer until the
+        # scan fits so enumeration never silently truncates.
+        capacity = self._MAX_CHIPS
+        while True:
+            arr = (_ChipInfoStruct * capacity)()
+            n = self._lib.tpu_chip_info_all(self._ctx, arr, capacity)
+            if n < 0:
+                raise OSError(f"tpu_chip_info_all failed: {n}")
+            if n < capacity:
+                return [_to_chip_info(s) for s in arr[:n]]
+            capacity *= 2
 
     def chip_info(self, name: str) -> ChipInfo:
         for chip in self.chips():
