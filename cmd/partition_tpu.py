@@ -1,0 +1,215 @@
+#!/usr/bin/env python3
+"""One-shot TPU sub-slice partitioner (init-container tool).
+
+TPU-native analog of the reference's partition_gpu tool
+(ref: partition_gpu/partition_gpu.go:81-156): runs as an init container
+after the driver installer, reads the node config JSON, and programs the
+node's partition layout before the device plugin starts.
+
+Where the reference drives ``nvidia-smi mig`` against opaque hardware
+state (destroy CI/GI, create max partitions of the configured size,
+verify, partition_gpu.go:214-257), the TPU layout is a **deterministic
+tiling** of the host ICI mesh (partition/subslice.py): the same pure
+function of (chips, partition size) computed by the tool and the device
+plugin.  The programmed record is a node state file —
+``/var/run/tpu/partitions.json`` — which the tool atomically rewrites
+(destroy+create) and re-reads (verify); the plugin's
+SubsliceDeviceManager recomputes the identical tiling and can check the
+state file for drift.
+
+Exit behavior mirrors the reference: no config file / no partition size
+⇒ exit 0 with nothing to do (partition_gpu.go:84-97); invalid tiling or
+missing chips ⇒ exit 1.  ``--reboot-to-apply`` reproduces the Ampere
+reset path (kill PID 1 with SIGRTMIN+5, partition_gpu.go:209-212) for
+nodes whose TPU runtime holds the old layout.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.partition.subslice import (
+    compute_subslices,
+)
+from container_engine_accelerators_tpu.tpulib.sysfs import SysfsTpuLib
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+
+log = logging.getLogger("partition_tpu")
+
+# Target is the HOST's systemd (via hostPID), so the host glibc numbering
+# applies regardless of this container's libc: SIGRTMIN(34) + 5 = reboot.
+SIGRTMIN = 34
+
+# State-file bookkeeping keys that are not part of the layout proper.
+_PENDING_KEY = "pendingReboot"
+_BOOT_ID_KEY = "bootId"
+
+
+def default_state_file(root: str) -> str:
+    return os.path.join(root, "var/run/tpu/partitions.json")
+
+
+def read_state(state_file: str):
+    if not os.path.exists(state_file):
+        return None
+    try:
+        with open(state_file) as f:
+            state = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("unreadable partition state %s: %s", state_file, e)
+        return None
+    if not isinstance(state, dict):
+        log.warning("malformed partition state %s: not an object", state_file)
+        return None
+    return state
+
+
+def layout_of(state):
+    """Strip reboot bookkeeping; what remains is the programmed layout."""
+    if state is None:
+        return None
+    return {k: v for k, v in state.items()
+            if k not in (_PENDING_KEY, _BOOT_ID_KEY)}
+
+
+def read_boot_id(root: str) -> str:
+    path = os.path.join(root, "proc/sys/kernel/random/boot_id")
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def build_state(lib: SysfsTpuLib, partition_size: str) -> dict:
+    """Compute the partition layout record for this host."""
+    chips = lib.chips()
+    if not chips:
+        raise RuntimeError("no TPU chips found; is the driver installed?")
+    tiles = compute_subslices(chips, partition_size)
+    return {
+        "partitionSize": partition_size,
+        "hostTopology": "x".join(str(t) for t in chips[0].topology),
+        "partitions": [
+            {
+                "id": f"slice{m}",
+                "chips": [c.name for c in members],
+                "chipIndices": [c.index for c in members],
+                "coords": [list(c.coords) for c in members],
+            }
+            for m, members in enumerate(tiles)
+        ],
+    }
+
+
+def write_state(state_file: str, state: dict) -> None:
+    """Destroy-then-create, atomically: the rename is the commit point."""
+    os.makedirs(os.path.dirname(state_file), exist_ok=True)
+    tmp = state_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=2)
+    os.replace(tmp, state_file)
+
+
+def reboot_node() -> bool:
+    """Graceful systemd reboot, as the reference does for Ampere resets.
+    Failure is logged, not raised (ref: partition_gpu.go:127-129)."""
+    try:
+        os.kill(1, SIGRTMIN + 5)
+        return True
+    except OSError as e:
+        log.error("Failed to trigger node reboot: %s", e)
+        return False
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="partition_tpu")
+    parser.add_argument("--tpu-config", default="/etc/tpu/tpu_config.json",
+                        help="node TPU config JSON (tpuPartitionSize)")
+    parser.add_argument("--sysfs-root", default="/",
+                        help="root containing sys/class/accel (fixture in tests)")
+    parser.add_argument("--state-file", default=None,
+                        help="partition state file (default <root>/var/run/tpu/"
+                             "partitions.json)")
+    parser.add_argument("--reboot-to-apply", action="store_true",
+                        help="reboot the node when a different layout was live")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+
+    if not os.path.exists(args.tpu_config):
+        log.info("No TPU config file given, nothing to do.")
+        return 0
+    try:
+        config = TPUConfig.from_file(args.tpu_config)
+        config.add_defaults_and_validate()
+    except (ValueError, OSError) as e:
+        log.info("failed to parse TPU config file, taking no action: %s", e)
+        return 0
+    if not config.partition_size:
+        log.info("No TPU partitions are required, exiting")
+        return 0
+
+    state_file = args.state_file or default_state_file(args.sysfs_root)
+    lib = SysfsTpuLib(args.sysfs_root)
+    try:
+        desired = build_state(lib, config.partition_size)
+    except (RuntimeError, ValueError) as e:
+        log.error("cannot partition: %s", e)
+        return 1
+
+    current = read_state(state_file)
+    if layout_of(current) == desired and not (current or {}).get(_PENDING_KEY):
+        log.info("partition layout already programmed, verifying only")
+    elif (
+        current is not None
+        and current.get(_PENDING_KEY)
+        and layout_of(current) == desired
+        and current.get(_BOOT_ID_KEY) != read_boot_id(args.sysfs_root)
+    ):
+        # The reboot we requested has happened (boot id changed): the old
+        # layout is released; commit the new one.
+        log.info("node rebooted, committing pending partition layout")
+        write_state(state_file, desired)
+    else:
+        if current is not None and args.reboot_to_apply:
+            # A different layout was live (or a requested reboot never took
+            # effect).  Record the desired layout as PENDING with the
+            # current boot id, so the post-reboot run — and only it — can
+            # tell the reboot actually happened and commit.
+            log.info("cleaning up existing partition layout (%s); rebooting "
+                     "node to release it",
+                     (layout_of(current) or {}).get("partitionSize"))
+            pending = dict(desired)
+            pending[_PENDING_KEY] = True
+            pending[_BOOT_ID_KEY] = read_boot_id(args.sysfs_root)
+            write_state(state_file, pending)
+            reboot_node()
+            return 1  # cannot proceed until the node restarts
+        if current is not None:
+            log.info("cleaning up existing partition layout (%s)",
+                     (layout_of(current) or {}).get("partitionSize"))
+        log.info("creating %d partitions of size %s",
+                 len(desired["partitions"]), config.partition_size)
+        write_state(state_file, desired)
+
+    # Verify: re-read the committed state and show it (nvidia-smi analog).
+    committed = read_state(state_file)
+    if committed != desired:
+        log.error("verification failed: state file does not match layout")
+        return 1
+    for part in committed["partitions"]:
+        log.info("partition %s: chips %s", part["id"], ",".join(part["chips"]))
+    log.info("programmed %d x %s sub-slices over host topology %s",
+             len(committed["partitions"]), committed["partitionSize"],
+             committed["hostTopology"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

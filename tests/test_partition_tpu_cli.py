@@ -1,0 +1,151 @@
+"""partition_tpu one-shot tool tests (table-driven over fixture trees,
+mirroring partition_gpu_test.go:19-63 + the §4 fake-FS strategy)."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from container_engine_accelerators_tpu.tpulib.sysfs import write_fixture
+
+_spec = importlib.util.spec_from_file_location(
+    "partition_tpu",
+    os.path.join(os.path.dirname(__file__), "..", "cmd", "partition_tpu.py"),
+)
+partition_tpu = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(partition_tpu)
+
+
+def write_config(path, partition_size):
+    with open(path, "w") as f:
+        json.dump({"tpuPartitionSize": partition_size}, f)
+
+
+def run(tmp_path, *extra, config=True, partition_size="1x1", chips=4):
+    root = str(tmp_path / "root")
+    cfg = str(tmp_path / "tpu_config.json")
+    if chips:
+        write_fixture(root, chips, topology="2x2x1")
+    else:
+        os.makedirs(os.path.join(root, "sys/class/accel"), exist_ok=True)
+    if config:
+        write_config(cfg, partition_size)
+    rc = partition_tpu.main(
+        ["--tpu-config", cfg, "--sysfs-root", root, *extra]
+    )
+    return rc, root
+
+
+def read_state(root):
+    path = partition_tpu.default_state_file(root)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_no_config_file_is_noop(tmp_path):
+    rc, root = run(tmp_path, config=False)
+    assert rc == 0
+    assert read_state(root) is None
+
+
+def test_empty_partition_size_is_noop(tmp_path):
+    rc, root = run(tmp_path, partition_size="")
+    assert rc == 0
+    assert read_state(root) is None
+
+
+def test_invalid_config_takes_no_action(tmp_path):
+    # Mirrors partition_gpu.go:88-92: unparseable config => exit 0, no action.
+    rc, root = run(tmp_path, partition_size="3x9")
+    assert rc == 0
+    assert read_state(root) is None
+
+
+def test_partitions_1x1_makes_four_single_chip_slices(tmp_path):
+    rc, root = run(tmp_path, partition_size="1x1")
+    assert rc == 0
+    state = read_state(root)
+    assert state["partitionSize"] == "1x1"
+    assert state["hostTopology"] == "2x2x1"
+    assert [p["id"] for p in state["partitions"]] == [
+        "slice0", "slice1", "slice2", "slice3"]
+    assert all(len(p["chips"]) == 1 for p in state["partitions"])
+
+
+def test_partitions_2x1_makes_two_slices(tmp_path):
+    rc, root = run(tmp_path, partition_size="2x1")
+    assert rc == 0
+    state = read_state(root)
+    assert len(state["partitions"]) == 2
+    assert state["partitions"][0]["chips"] == ["accel0", "accel1"]
+    assert state["partitions"][1]["chips"] == ["accel2", "accel3"]
+
+
+def test_untileable_size_fails(tmp_path):
+    # 2x2x2 is a valid config value but cannot tile a 2x2x1 host.
+    rc, root = run(tmp_path, partition_size="2x2x2")
+    assert rc == 1
+    assert read_state(root) is None
+
+
+def test_no_chips_fails(tmp_path):
+    rc, _ = run(tmp_path, chips=0)
+    assert rc == 1
+
+
+def test_idempotent_rerun_and_relayout(tmp_path):
+    rc, root = run(tmp_path, partition_size="1x1")
+    assert rc == 0
+    cfg = str(tmp_path / "tpu_config.json")
+    # Re-run with same layout: verify-only, still 0.
+    assert partition_tpu.main(["--tpu-config", cfg, "--sysfs-root", root]) == 0
+    # New layout replaces the old state.
+    write_config(cfg, "2x2")
+    assert partition_tpu.main(["--tpu-config", cfg, "--sysfs-root", root]) == 0
+    state = read_state(root)
+    assert state["partitionSize"] == "2x2"
+    assert len(state["partitions"]) == 1
+
+
+def set_boot_id(root, value):
+    d = os.path.join(root, "proc/sys/kernel/random")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "boot_id"), "w") as f:
+        f.write(value + "\n")
+
+
+def test_reboot_to_apply_pending_then_commit(tmp_path, monkeypatch):
+    rc, root = run(tmp_path, partition_size="1x1")
+    assert rc == 0
+    set_boot_id(root, "boot-1")
+    rebooted = []
+    monkeypatch.setattr(partition_tpu, "reboot_node",
+                        lambda: rebooted.append(True) or True)
+    cfg = str(tmp_path / "tpu_config.json")
+    write_config(cfg, "2x2")
+    args = ["--tpu-config", cfg, "--sysfs-root", root, "--reboot-to-apply"]
+
+    # Layout change with a live layout: record PENDING, request reboot,
+    # exit 1 (cannot proceed until restart, partition_gpu.go:126-131).
+    assert partition_tpu.main(args) == 1
+    assert rebooted == [True]
+    state = read_state(root)
+    assert state["pendingReboot"] is True
+    assert state["bootId"] == "boot-1"
+
+    # Re-run with the SAME boot id (reboot never happened / kubelet
+    # restarted the init container): retry the reboot, stay pending.
+    assert partition_tpu.main(args) == 1
+    assert rebooted == [True, True]
+    assert read_state(root)["pendingReboot"] is True
+
+    # Re-run after a real reboot (boot id changed): commit and verify.
+    set_boot_id(root, "boot-2")
+    assert partition_tpu.main(args) == 0
+    state = read_state(root)
+    assert "pendingReboot" not in state
+    assert state["partitionSize"] == "2x2"
+    assert rebooted == [True, True]  # no further reboot
