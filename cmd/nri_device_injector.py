@@ -1,0 +1,56 @@
+#!/usr/bin/env python3
+"""NRI device injector daemon entry point (DaemonSet).
+
+Connects to containerd's NRI socket and injects annotated device nodes
+at CreateContainer (ref: nri_device_injector/nri_device_injector.go:56-77).
+Reconnects with backoff when containerd restarts.
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.nri.plugin import (
+    DEFAULT_NRI_SOCKET,
+    PLUGIN_IDX,
+    PLUGIN_NAME,
+    DeviceInjectorPlugin,
+)
+from container_engine_accelerators_tpu.nri.ttrpc import TtrpcError
+
+RECONNECT_DELAY_S = 5.0
+
+
+def main():
+    parser = argparse.ArgumentParser(prog="nri-device-injector")
+    parser.add_argument("--nri-socket", default=DEFAULT_NRI_SOCKET)
+    parser.add_argument("--plugin-name", default=PLUGIN_NAME)
+    parser.add_argument("--plugin-idx", default=PLUGIN_IDX)
+    args = parser.parse_args()
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    log = logging.getLogger("nri_device_injector")
+
+    while True:
+        try:
+            plugin = DeviceInjectorPlugin(
+                socket_path=args.nri_socket,
+                plugin_name=args.plugin_name,
+                plugin_idx=args.plugin_idx,
+            )
+            plugin.run()
+            log.info("NRI connection closed")
+        except (OSError, EOFError, TtrpcError) as e:
+            # EOFError: containerd closed mid-handshake; TtrpcError:
+            # registration rejected.  Both warrant retry, not a crash.
+            log.warning("NRI connection failed: %s", e)
+        time.sleep(RECONNECT_DELAY_S)
+
+
+if __name__ == "__main__":
+    main()

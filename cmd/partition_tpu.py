@@ -76,13 +76,16 @@ def layout_of(state):
             if k not in (_PENDING_KEY, _BOOT_ID_KEY)}
 
 
-def read_boot_id(root: str) -> str:
+def read_boot_id(root: str):
+    """The kernel boot id, or None when unreadable.  The reboot protocol
+    refuses to run without it — an empty sentinel would make the
+    'reboot happened' comparison permanently false (infinite reboots)."""
     path = os.path.join(root, "proc/sys/kernel/random/boot_id")
     try:
         with open(path) as f:
             return f.read().strip()
     except OSError:
-        return ""
+        return None
 
 
 def build_state(lib: SysfsTpuLib, partition_size: str) -> dict:
@@ -164,33 +167,45 @@ def main(argv=None) -> int:
         return 1
 
     current = read_state(state_file)
-    if layout_of(current) == desired and not (current or {}).get(_PENDING_KEY):
+    pending = current is not None and bool(current.get(_PENDING_KEY))
+    boot_id = read_boot_id(args.sysfs_root)
+    # Boot id changed since the pending record was written ⇒ the requested
+    # reboot actually happened and the old layout is released.
+    rebooted = (
+        pending and boot_id is not None and current.get(_BOOT_ID_KEY) != boot_id
+    )
+
+    if layout_of(current) == desired and not pending:
         log.info("partition layout already programmed, verifying only")
-    elif (
-        current is not None
-        and current.get(_PENDING_KEY)
-        and layout_of(current) == desired
-        and current.get(_BOOT_ID_KEY) != read_boot_id(args.sysfs_root)
-    ):
-        # The reboot we requested has happened (boot id changed): the old
-        # layout is released; commit the new one.
+    elif pending and layout_of(current) == desired and rebooted:
         log.info("node rebooted, committing pending partition layout")
         write_state(state_file, desired)
+    elif pending and not rebooted and not args.reboot_to_apply:
+        # A reboot was requested by a previous run and has not happened;
+        # committing now would hand the plugin a layout the TPU runtime
+        # doesn't hold yet.
+        log.error("node reboot still pending for layout change; reboot the "
+                  "node or re-run with --reboot-to-apply")
+        return 1
+    elif current is not None and args.reboot_to_apply:
+        # A different layout was live (or a requested reboot never took
+        # effect).  Record the desired layout as PENDING with the current
+        # boot id, so the post-reboot run — and only it — can tell the
+        # reboot actually happened and commit.
+        if boot_id is None:
+            log.error("cannot run the reboot protocol: boot id unreadable "
+                      "under %s", args.sysfs_root)
+            return 1
+        log.info("cleaning up existing partition layout (%s); rebooting "
+                 "node to release it",
+                 (layout_of(current) or {}).get("partitionSize"))
+        record = dict(desired)
+        record[_PENDING_KEY] = True
+        record[_BOOT_ID_KEY] = boot_id
+        write_state(state_file, record)
+        reboot_node()
+        return 1  # cannot proceed until the node restarts
     else:
-        if current is not None and args.reboot_to_apply:
-            # A different layout was live (or a requested reboot never took
-            # effect).  Record the desired layout as PENDING with the
-            # current boot id, so the post-reboot run — and only it — can
-            # tell the reboot actually happened and commit.
-            log.info("cleaning up existing partition layout (%s); rebooting "
-                     "node to release it",
-                     (layout_of(current) or {}).get("partitionSize"))
-            pending = dict(desired)
-            pending[_PENDING_KEY] = True
-            pending[_BOOT_ID_KEY] = read_boot_id(args.sysfs_root)
-            write_state(state_file, pending)
-            reboot_node()
-            return 1  # cannot proceed until the node restarts
         if current is not None:
             log.info("cleaning up existing partition layout (%s)",
                      (layout_of(current) or {}).get("partitionSize"))

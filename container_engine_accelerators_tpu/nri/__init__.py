@@ -1,0 +1,21 @@
+"""NRI device injector — containerd NRI plugin, TPU-native.
+
+Python implementation of the reference's NRI device-injector plugin
+(ref: nri_device_injector/nri_device_injector.go): pods annotate
+``devices.gke.io/container.<name>`` with a device list, and the plugin
+injects those device nodes at CreateContainer time — no device-plugin
+involvement, which is how unprivileged DCN/RX-daemon sidecars get their
+``/dev/vfio``-style aperture nodes (SURVEY.md §2 #13, #14).
+
+The wire stack (mux framing + ttrpc + NRI protobuf) is implemented
+in-repo because the containerd client libraries are Go-only; the
+protocol constants mirror github.com/containerd/{nri,ttrpc}.
+"""
+
+from container_engine_accelerators_tpu.nri.injector import (
+    CTR_DEVICE_KEY_PREFIX,
+    get_devices,
+    to_linux_device,
+)
+
+__all__ = ["CTR_DEVICE_KEY_PREFIX", "get_devices", "to_linux_device"]

@@ -1,0 +1,113 @@
+"""Device-injection logic: pod annotation → NRI LinuxDevice adjustments.
+
+Behavioral parity with the reference injector
+(ref: nri_device_injector/nri_device_injector.go:126-199): the
+annotation ``devices.gke.io/container.<name>`` holds a YAML/JSON list of
+``{path, type, major, minor, file_mode, uid, gid}``; duplicates by path
+keep the first entry; the device's type/major/minor come from lstat of
+the path on the node (annotated values are informational), and the
+annotation's file_mode/uid/gid override when non-zero.  For TPU nodes
+the annotated paths are ``/dev/accelN`` chips and ``/dev/vfio/*``
+aperture nodes (SURVEY.md §2.2).
+"""
+
+import os
+import stat as stat_module
+from typing import Dict, List
+
+import yaml
+
+from container_engine_accelerators_tpu.nri import nri_v1alpha1_pb2 as pb
+
+DEVICE_KEY_PREFIX = "devices.gke.io"
+CTR_DEVICE_KEY_PREFIX = DEVICE_KEY_PREFIX + "/container."
+
+BLOCK_DEVICE = "b"
+CHAR_DEVICE = "c"
+FIFO_DEVICE = "p"
+
+
+def get_devices(ctr_name: str, pod_annotations: Dict[str, str]) -> List[dict]:
+    """Parse the container's device annotation; [] when absent."""
+    raw = (pod_annotations or {}).get(CTR_DEVICE_KEY_PREFIX + ctr_name)
+    if raw is None:
+        return []
+    try:
+        parsed = yaml.safe_load(raw)
+    except yaml.YAMLError as e:
+        raise ValueError(f"invalid device annotation for {ctr_name!r}: {e}")
+    if parsed is None:
+        return []
+    if not isinstance(parsed, list):
+        raise ValueError(
+            f"invalid device annotation for {ctr_name!r}: expected a list"
+        )
+    devices, seen = [], set()
+    for entry in parsed:
+        if not isinstance(entry, dict) or "path" not in entry:
+            raise ValueError(
+                f"invalid device annotation for {ctr_name!r}: "
+                f"each entry needs a 'path'"
+            )
+        if entry["path"] in seen:
+            continue
+        seen.add(entry["path"])
+        devices.append(entry)
+    return devices
+
+
+def to_linux_device(entry: dict, lstat=os.lstat) -> pb.LinuxDevice:
+    """Stat the device path and build the NRI device (go:158-199)."""
+    path = entry["path"]
+    try:
+        st = lstat(path)
+    except OSError as e:
+        raise ValueError(f"failed to get info from device path {path}: {e}")
+    mode = st.st_mode
+    if stat_module.S_ISBLK(mode):
+        dev_type = BLOCK_DEVICE
+    elif stat_module.S_ISCHR(mode):
+        dev_type = CHAR_DEVICE
+    elif stat_module.S_ISFIFO(mode):
+        dev_type = FIFO_DEVICE
+    else:
+        raise ValueError(f"invalid device type {mode:o} from device path {path}")
+    device = pb.LinuxDevice(
+        path=path,
+        type=dev_type,
+        major=os.major(st.st_rdev),
+        minor=os.minor(st.st_rdev),
+    )
+    if entry.get("file_mode"):
+        device.file_mode.value = _parse_mode(entry["file_mode"])
+    if entry.get("uid"):
+        device.uid.value = int(entry["uid"])
+    if entry.get("gid"):
+        device.gid.value = int(entry["gid"])
+    return device
+
+
+def _parse_mode(value) -> int:
+    """File modes arrive as ints or strings: YAML 1.1 parses ``0660`` as
+    octal int, but ``0o660`` stays a string under PyYAML — accept both
+    (Go's yaml.v3, which the reference relies on, takes 0o as int)."""
+    if isinstance(value, int):
+        return value
+    s = str(value).strip()
+    if s.startswith(("0o", "0O", "0x", "0X", "0b", "0B")):
+        return int(s, 0)
+    if s.startswith("0") and s != "0":
+        return int(s, 8)
+    return int(s)
+
+
+def create_container_adjustment(
+    ctr_name: str, pod_annotations: Dict[str, str], lstat=os.lstat
+) -> pb.ContainerAdjustment:
+    """The CreateContainer hook body (go:86-123); raises on bad annotations
+    so the runtime rejects the container rather than silently starting it
+    without its devices."""
+    adjust = pb.ContainerAdjustment()
+    for entry in get_devices(ctr_name, pod_annotations):
+        adjust.linux.devices.append(to_linux_device(entry, lstat=lstat))
+    return adjust

@@ -1,0 +1,150 @@
+"""NRI plugin runtime: register with containerd, serve lifecycle hooks.
+
+Connection flow (mirrors github.com/containerd/nri pkg/stub): dial the
+NRI socket, multiplex it (mux.py), serve the ``Plugin`` ttrpc service on
+logical conn 1, call ``Runtime.RegisterPlugin`` on logical conn 2, then
+answer Configure/Synchronize/CreateContainer events until the runtime
+closes the connection.  Subscription is CreateContainer-only, like the
+reference plugin (nri_device_injector.go:86).
+"""
+
+import logging
+import socket
+import threading
+import time
+from typing import Optional
+
+from container_engine_accelerators_tpu.nri import injector
+from container_engine_accelerators_tpu.nri import mux as nri_mux
+from container_engine_accelerators_tpu.nri import nri_v1alpha1_pb2 as pb
+from container_engine_accelerators_tpu.nri.ttrpc import TtrpcClient, TtrpcServer
+
+log = logging.getLogger(__name__)
+
+DEFAULT_NRI_SOCKET = "/var/run/nri/nri.sock"
+PLUGIN_SERVICE = "nri.pkg.api.v1alpha1.Plugin"
+RUNTIME_SERVICE = "nri.pkg.api.v1alpha1.Runtime"
+PLUGIN_NAME = "device_injector_nri"
+PLUGIN_IDX = "10"
+
+
+def event_mask(*events: int) -> int:
+    """Bit (e-1) subscribes Event e (nri pkg/api/event.go)."""
+    m = 0
+    for e in events:
+        m |= 1 << (e - 1)
+    return m
+
+
+class DeviceInjectorPlugin:
+    def __init__(
+        self,
+        socket_path: str = DEFAULT_NRI_SOCKET,
+        plugin_name: str = PLUGIN_NAME,
+        plugin_idx: str = PLUGIN_IDX,
+        lstat=None,
+    ):
+        self.socket_path = socket_path
+        self.plugin_name = plugin_name
+        self.plugin_idx = plugin_idx
+        self._lstat = lstat  # test seam; None = os.lstat
+        self._shutdown = threading.Event()
+
+    # ---- Plugin service handlers (runtime -> us) ---------------------------
+
+    def _configure(self, payload: bytes) -> bytes:
+        req = pb.ConfigureRequest.FromString(payload)
+        log.info("configured by runtime %s %s", req.runtime_name,
+                 req.runtime_version)
+        return pb.ConfigureResponse(
+            events=event_mask(pb.CREATE_CONTAINER)
+        ).SerializeToString()
+
+    def _synchronize(self, payload: bytes) -> bytes:
+        req = pb.SynchronizeRequest.FromString(payload)
+        log.info("synchronized: %d pods, %d containers",
+                 len(req.pods), len(req.containers))
+        return pb.SynchronizeResponse().SerializeToString()
+
+    def _create_container(self, payload: bytes) -> bytes:
+        req = pb.CreateContainerRequest.FromString(payload)
+        ctr, pod = req.container.name, req.pod.name
+        log.info("CreateContainer %s/%s/%s", req.pod.namespace, pod, ctr)
+        kwargs = {"lstat": self._lstat} if self._lstat else {}
+        adjust = injector.create_container_adjustment(
+            ctr, dict(req.pod.annotations), **kwargs
+        )
+        for device in adjust.linux.devices:
+            log.info("injecting device %s (%s %d:%d) into %s/%s",
+                     device.path, device.type, device.major, device.minor,
+                     pod, ctr)
+        return pb.CreateContainerResponse(adjust=adjust).SerializeToString()
+
+    def _stop_container(self, payload: bytes) -> bytes:
+        return pb.StopContainerResponse().SerializeToString()
+
+    def _state_change(self, payload: bytes) -> bytes:
+        return pb.Empty().SerializeToString()
+
+    def _handle_shutdown(self, payload: bytes) -> bytes:
+        log.info("runtime is shutting down")
+        self._shutdown.set()
+        return pb.Empty().SerializeToString()
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def _build_server(self, conn) -> TtrpcServer:
+        server = TtrpcServer(conn)
+        for method, handler in [
+            ("Configure", self._configure),
+            ("Synchronize", self._synchronize),
+            ("Shutdown", self._handle_shutdown),
+            ("CreateContainer", self._create_container),
+            ("StopContainer", self._stop_container),
+            ("StateChange", self._state_change),
+        ]:
+            server.register(PLUGIN_SERVICE, method, handler)
+        return server
+
+    def run_on_socket(self, sock) -> None:
+        """Serve one connected trunk socket until it closes or the runtime
+        announces Shutdown (test seam)."""
+        m = nri_mux.Mux(sock)
+        server = self._build_server(m.open(nri_mux.PLUGIN_SERVICE_CONN))
+        client = TtrpcClient(m.open(nri_mux.RUNTIME_SERVICE_CONN))
+        m.start_reader()
+
+        serve_thread = threading.Thread(
+            target=server.serve, daemon=True, name="nri-plugin-server"
+        )
+        serve_thread.start()
+
+        client.call(
+            RUNTIME_SERVICE, "RegisterPlugin",
+            pb.RegisterPluginRequest(
+                plugin_name=self.plugin_name, plugin_idx=self.plugin_idx
+            ).SerializeToString(),
+        )
+        log.info("registered NRI plugin %s (idx %s)",
+                 self.plugin_name, self.plugin_idx)
+        # Serve until the connection drops, or Shutdown arrives — then close
+        # the trunk ourselves to unblock the serve loop.
+        while serve_thread.is_alive():
+            if self._shutdown.wait(timeout=0.2):
+                # Give the serve loop a beat to flush the Shutdown response
+                # before tearing down the trunk under it.
+                time.sleep(0.2)
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                serve_thread.join(timeout=5)
+                break
+
+    def run(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(self.socket_path)
+        try:
+            self.run_on_socket(sock)
+        finally:
+            sock.close()

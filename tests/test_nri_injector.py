@@ -1,0 +1,238 @@
+"""NRI device injector tests.
+
+Pure-logic tests for annotation parsing / device stat (mirroring
+nri_device_injector_test.go:25-190 but root-free: FIFOs exercise the
+real-lstat path, char/block devices use the lstat seam), plus a
+protocol-level test: a fake containerd runtime speaks real mux+ttrpc
+frames to the plugin over a socketpair.
+"""
+
+import os
+import socket
+import stat
+import threading
+
+import pytest
+
+from container_engine_accelerators_tpu.nri import injector
+from container_engine_accelerators_tpu.nri import mux as nri_mux
+from container_engine_accelerators_tpu.nri import nri_v1alpha1_pb2 as pb
+from container_engine_accelerators_tpu.nri.plugin import (
+    PLUGIN_SERVICE,
+    RUNTIME_SERVICE,
+    DeviceInjectorPlugin,
+    event_mask,
+)
+from container_engine_accelerators_tpu.nri.ttrpc import (
+    TtrpcClient,
+    TtrpcError,
+    TtrpcServer,
+)
+
+
+# ---- annotation parsing ----------------------------------------------------
+
+
+def ann(ctr, value):
+    return {injector.CTR_DEVICE_KEY_PREFIX + ctr: value}
+
+
+def test_get_devices_parses_yaml_list():
+    devices = injector.get_devices("tpu", ann("tpu", """
+- path: /dev/accel0
+- path: /dev/accel1
+  file_mode: 0o660
+"""))
+    assert [d["path"] for d in devices] == ["/dev/accel0", "/dev/accel1"]
+
+
+def test_get_devices_json_is_valid_yaml():
+    devices = injector.get_devices(
+        "c", ann("c", '[{"path": "/dev/vfio/0"}]'))
+    assert devices == [{"path": "/dev/vfio/0"}]
+
+
+def test_get_devices_dedupes_by_path_keeping_first():
+    devices = injector.get_devices("c", ann("c", """
+- path: /dev/accel0
+  uid: 1
+- path: /dev/accel0
+  uid: 2
+"""))
+    assert len(devices) == 1
+    assert devices[0]["uid"] == 1
+
+
+def test_get_devices_ignores_other_containers_and_absent():
+    assert injector.get_devices("other", ann("c", "- path: /dev/x")) == []
+    assert injector.get_devices("c", {}) == []
+    assert injector.get_devices("c", None) == []
+
+
+@pytest.mark.parametrize("bad", ["{not yaml: [", "just-a-string",
+                                 "- type: c\n  major: 1"])
+def test_get_devices_invalid_annotation_raises(bad):
+    with pytest.raises(ValueError):
+        injector.get_devices("c", ann("c", bad))
+
+
+# ---- device stat -----------------------------------------------------------
+
+
+def test_to_linux_device_fifo_real_lstat(tmp_path):
+    path = str(tmp_path / "fifo")
+    os.mkfifo(path)
+    device = injector.to_linux_device({"path": path})
+    assert device.type == "p"
+    assert device.path == path
+
+
+def test_to_linux_device_char_via_seam():
+    class St:
+        st_mode = stat.S_IFCHR | 0o600
+        st_rdev = os.makedev(245, 3)
+    device = injector.to_linux_device(
+        {"path": "/dev/accel0", "file_mode": 0o660, "uid": 7, "gid": 8},
+        lstat=lambda p: St(),
+    )
+    assert (device.type, device.major, device.minor) == ("c", 245, 3)
+    assert device.file_mode.value == 0o660
+    assert device.uid.value == 7
+    assert device.gid.value == 8
+
+
+def test_to_linux_device_missing_path_raises():
+    with pytest.raises(ValueError):
+        injector.to_linux_device({"path": "/nonexistent/device"})
+
+
+def test_to_linux_device_regular_file_rejected(tmp_path):
+    path = str(tmp_path / "plain")
+    open(path, "w").close()
+    with pytest.raises(ValueError, match="invalid device type"):
+        injector.to_linux_device({"path": path})
+
+
+# ---- protocol-level: fake containerd runtime -------------------------------
+
+
+class FakeRuntime:
+    """The containerd side of the NRI socket: mux trunk + ttrpc both ways."""
+
+    def __init__(self, sock):
+        self.mux = nri_mux.Mux(sock)
+        self.registered = threading.Event()
+        self.register_req = None
+        server = TtrpcServer(self.mux.open(nri_mux.RUNTIME_SERVICE_CONN))
+        server.register(RUNTIME_SERVICE, "RegisterPlugin", self._register)
+        self.client = TtrpcClient(self.mux.open(nri_mux.PLUGIN_SERVICE_CONN))
+        self.mux.start_reader()
+        threading.Thread(target=server.serve, daemon=True).start()
+
+    def _register(self, payload):
+        self.register_req = pb.RegisterPluginRequest.FromString(payload)
+        self.registered.set()
+        return pb.Empty().SerializeToString()
+
+    def configure(self):
+        raw = self.client.call(
+            PLUGIN_SERVICE, "Configure",
+            pb.ConfigureRequest(runtime_name="containerd",
+                                runtime_version="2.0").SerializeToString())
+        return pb.ConfigureResponse.FromString(raw)
+
+    def create_container(self, pod_annotations, ctr_name):
+        req = pb.CreateContainerRequest(
+            pod=pb.PodSandbox(name="pod", namespace="ns",
+                              annotations=pod_annotations),
+            container=pb.Container(name=ctr_name),
+        )
+        raw = self.client.call(PLUGIN_SERVICE, "CreateContainer",
+                               req.SerializeToString())
+        return pb.CreateContainerResponse.FromString(raw)
+
+
+@pytest.fixture
+def rig(tmp_path):
+    runtime_sock, plugin_sock = socket.socketpair()
+    plugin = DeviceInjectorPlugin()
+    t = threading.Thread(target=plugin.run_on_socket, args=(plugin_sock,),
+                         daemon=True)
+    t.start()
+    runtime = FakeRuntime(runtime_sock)
+    yield runtime
+    runtime_sock.close()
+    plugin_sock.close()
+
+
+def test_plugin_registers_and_subscribes_create_container(rig):
+    assert rig.registered.wait(5)
+    assert rig.register_req.plugin_name == "device_injector_nri"
+    assert rig.register_req.plugin_idx == "10"
+    resp = rig.configure()
+    assert resp.events == event_mask(pb.CREATE_CONTAINER)
+
+
+def test_create_container_injects_annotated_devices(rig, tmp_path):
+    assert rig.registered.wait(5)
+    fifo = str(tmp_path / "accel-fifo")
+    os.mkfifo(fifo)
+    resp = rig.create_container(ann("tpu-ctr", f"- path: {fifo}"), "tpu-ctr")
+    assert len(resp.adjust.linux.devices) == 1
+    device = resp.adjust.linux.devices[0]
+    assert device.path == fifo
+    assert device.type == "p"
+
+
+def test_create_container_without_annotation_is_empty_adjustment(rig):
+    assert rig.registered.wait(5)
+    resp = rig.create_container({}, "plain-ctr")
+    assert len(resp.adjust.linux.devices) == 0
+
+
+def test_create_container_bad_annotation_errors(rig):
+    assert rig.registered.wait(5)
+    with pytest.raises(TtrpcError):
+        rig.create_container(ann("c", "- major: 1"), "c")
+
+
+def test_file_mode_string_forms(tmp_path):
+    # PyYAML leaves '0o660' as a string; YAML 1.1 '0660' parses as octal
+    # int; both must reach the wire as 0o660 = 432.
+    fifo = str(tmp_path / "f")
+    os.mkfifo(fifo)
+    for raw in [f"- path: {fifo}\n  file_mode: 0o660",
+                f"- path: {fifo}\n  file_mode: 0660",
+                f"- path: {fifo}\n  file_mode: 432"]:
+        devices = injector.get_devices("c", ann("c", raw))
+        d = injector.to_linux_device(devices[0])
+        assert d.file_mode.value == 0o660, raw
+
+
+def test_shutdown_terminates_plugin(tmp_path):
+    runtime_sock, plugin_sock = socket.socketpair()
+    plugin = DeviceInjectorPlugin()
+    t = threading.Thread(target=plugin.run_on_socket, args=(plugin_sock,),
+                         daemon=True)
+    t.start()
+    runtime = FakeRuntime(runtime_sock)
+    assert runtime.registered.wait(5)
+    runtime.client.call(PLUGIN_SERVICE, "Shutdown",
+                        pb.Empty().SerializeToString())
+    t.join(timeout=5)
+    assert not t.is_alive()
+    runtime_sock.close()
+    plugin_sock.close()
+
+
+def test_mux_rejects_oversized_frame():
+    import struct
+    a, b = socket.socketpair()
+    mux = nri_mux.Mux(b)
+    conn = mux.open(1)
+    mux.start_reader()
+    a.sendall(struct.pack(">II", 1, 0xFFFFFFFF))  # corrupt length
+    with pytest.raises(EOFError):
+        conn.read_exact(1)
+    a.close()
+    b.close()
