@@ -3,17 +3,23 @@
 
 PY := python3
 NATIVE_BUILD := native/tpushim/build
+DCNXFERD_BUILD := native/dcnxferd/build
 
 .PHONY: all native test presubmit proto clean
 
 all: native
 
-native: $(NATIVE_BUILD)/libtpushim.so
+native: $(NATIVE_BUILD)/libtpushim.so $(DCNXFERD_BUILD)/dcnxferd
 
 $(NATIVE_BUILD)/libtpushim.so: native/tpushim/tpushim.cc native/tpushim/tpushim.h
 	mkdir -p $(NATIVE_BUILD)
 	g++ -std=c++17 -O2 -Wall -Wextra -fPIC -shared \
 	    -o $(NATIVE_BUILD)/libtpushim.so native/tpushim/tpushim.cc
+
+$(DCNXFERD_BUILD)/dcnxferd: native/dcnxferd/dcnxferd.cc
+	mkdir -p $(DCNXFERD_BUILD)
+	g++ -std=c++17 -O2 -Wall -Wextra \
+	    -o $(DCNXFERD_BUILD)/dcnxferd native/dcnxferd/dcnxferd.cc
 
 test: native
 	$(PY) -m pytest tests/ -x -q
@@ -30,6 +36,12 @@ proto:
 	protoc -Iprotos/podresources/v1 \
 	    --python_out=container_engine_accelerators_tpu/metrics \
 	    protos/podresources/v1/podresources_v1.proto
+	protoc -Iprotos/nri/v1alpha1 \
+	    --python_out=container_engine_accelerators_tpu/nri \
+	    protos/nri/v1alpha1/nri_v1alpha1.proto
+	protoc -Iprotos/ttrpc \
+	    --python_out=container_engine_accelerators_tpu/nri \
+	    protos/ttrpc/ttrpc.proto
 
 clean:
-	rm -rf $(NATIVE_BUILD)
+	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD)

@@ -27,13 +27,24 @@ CHAR_DEVICE = "c"
 FIFO_DEVICE = "p"
 
 
+class _NoAliasSafeLoader(yaml.SafeLoader):
+    """SafeLoader that rejects anchors/aliases: annotations are untrusted
+    pod input, and PyYAML expands aliases without limit (billion-laughs);
+    device lists never legitimately need them."""
+
+    def compose_node(self, parent, index):
+        if self.check_event(yaml.events.AliasEvent):
+            raise yaml.YAMLError("YAML aliases are not allowed")
+        return super().compose_node(parent, index)
+
+
 def get_devices(ctr_name: str, pod_annotations: Dict[str, str]) -> List[dict]:
     """Parse the container's device annotation; [] when absent."""
     raw = (pod_annotations or {}).get(CTR_DEVICE_KEY_PREFIX + ctr_name)
     if raw is None:
         return []
     try:
-        parsed = yaml.safe_load(raw)
+        parsed = yaml.load(raw, Loader=_NoAliasSafeLoader)
     except yaml.YAMLError as e:
         raise ValueError(f"invalid device annotation for {ctr_name!r}: {e}")
     if parsed is None:

@@ -1,0 +1,85 @@
+"""Client for the dcnxferd DCN transfer daemon (native/dcnxferd/).
+
+The role the NCCL GPUDirect plugin plays against tcpgpudmarxd's UDS
+control socket (SURVEY.md §2.2): workers doing cross-slice DCN transfers
+register flows with the per-node daemon, which owns the pinned staging
+buffers; accounting rides the same socket.  Newline-delimited JSON.
+"""
+
+import json
+import socket
+from typing import Optional
+
+DEFAULT_UDS_DIR = "/run/tpu-dcn"
+SOCKET_NAME = "xferd.sock"
+
+
+class DcnXferError(Exception):
+    pass
+
+
+class DcnXferClient:
+    def __init__(self, uds_dir: str = DEFAULT_UDS_DIR, timeout_s: float = 10.0):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.settimeout(timeout_s)
+        self._sock.connect(f"{uds_dir.rstrip('/')}/{SOCKET_NAME}")
+        self._rfile = self._sock.makefile("r")
+        self._broken = False
+
+    def close(self) -> None:
+        """Closing releases every flow this client registered (the daemon
+        ties buffer lifetime to the connection, like rxdm)."""
+        self._rfile.close()
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _call(self, **req) -> dict:
+        if self._broken:
+            raise DcnXferError(
+                "connection broken by earlier timeout; reconnect"
+            )
+        try:
+            self._sock.sendall((json.dumps(req) + "\n").encode())
+            line = self._rfile.readline()
+        except (socket.timeout, OSError) as e:
+            # After a timeout the buffered reader may hold a partial line;
+            # any retry would consume a stale response.  Poison the client.
+            self._broken = True
+            raise DcnXferError(f"daemon connection failed: {e}")
+        if not line:
+            self._broken = True
+            raise DcnXferError("daemon closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise DcnXferError(resp.get("error", "unknown daemon error"))
+        return resp
+
+    # ---- operations --------------------------------------------------------
+
+    def version(self) -> str:
+        return self._call(op="version")["version"]
+
+    def ping(self) -> None:
+        self._call(op="ping")
+
+    def register_flow(self, flow: str, peer: str = "",
+                      bytes: Optional[int] = None) -> dict:
+        req = {"op": "register_flow", "flow": flow, "peer": peer}
+        if bytes is not None:
+            req["bytes"] = bytes
+        return self._call(**req)
+
+    def record_transfer(self, flow: str, nbytes: int) -> int:
+        return self._call(op="record_transfer", flow=flow,
+                          bytes=nbytes)["flow_bytes"]
+
+    def release_flow(self, flow: str) -> None:
+        self._call(op="release_flow", flow=flow)
+
+    def stats(self) -> dict:
+        return self._call(op="stats")

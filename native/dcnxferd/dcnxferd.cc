@@ -1,0 +1,527 @@
+// dcnxferd — per-node DCN transfer daemon (native C++).
+//
+// TPU-native analog of the reference's tcpgpudmarxd RX-datapath manager
+// (SURVEY.md §2.2; ref: gpudirect-tcpx/nccl-test.yaml:29-52 runs it as a
+// privileged sidecar owning flow-steering state and GPU-memory RX buffers,
+// with a UDS control socket under /run/tcpx).  Here the daemon owns the
+// node's cross-slice DCN transfer state: workers register flows, the daemon
+// allocates pinned staging buffers from a bounded pool (mmap'd, mlock
+// best-effort), accounts transferred bytes, and releases a client's flows
+// when its connection drops — the same client-lifetime contract rxdm gives
+// the NCCL plugin.
+//
+// Control protocol: newline-delimited JSON over a UNIX stream socket
+// (<uds_path>/xferd.sock).  Requests are flat objects:
+//   {"op":"version"}
+//   {"op":"register_flow","flow":"g0","peer":"slice1-h0","bytes":4194304}
+//   {"op":"record_transfer","flow":"g0","bytes":1048576}
+//   {"op":"release_flow","flow":"g0"}
+//   {"op":"stats"}
+// Responses: {"ok":true,...} or {"ok":false,"error":"..."}.
+//
+// Build: make native  (g++ -std=c++17, no external deps).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+int g_verbose = 0;
+volatile sig_atomic_t g_stop = 0;
+
+void logf(int level, const char* fmt, ...) {
+  if (level > g_verbose) return;
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "dcnxferd: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+}
+
+void on_signal(int) { g_stop = 1; }
+
+// ---- minimal flat-JSON request parsing -------------------------------------
+// Requests are single-level objects with string or integer values; anything
+// else is a protocol error.  (Responses are emitted with snprintf.)
+
+bool ParseFlatJson(const std::string& line,
+                   std::map<std::string, std::string>* out) {
+  size_t i = 0;
+  auto skip_ws = [&] {
+    while (i < line.size() && isspace((unsigned char)line[i])) i++;
+  };
+  auto parse_string = [&](std::string* s) -> bool {
+    if (line[i] != '"') return false;
+    i++;
+    s->clear();
+    while (i < line.size() && line[i] != '"') {
+      if (line[i] == '\\' && i + 1 < line.size()) i++;  // unescape next
+      s->push_back(line[i++]);
+    }
+    if (i >= line.size()) return false;
+    i++;  // closing quote
+    return true;
+  };
+  skip_ws();
+  if (i >= line.size() || line[i] != '{') return false;
+  i++;
+  skip_ws();
+  if (i < line.size() && line[i] == '}') return true;  // empty object
+  while (i < line.size()) {
+    skip_ws();
+    std::string key, value;
+    if (!parse_string(&key)) return false;
+    skip_ws();
+    if (i >= line.size() || line[i] != ':') return false;
+    i++;
+    skip_ws();
+    if (i >= line.size()) return false;
+    if (line[i] == '"') {
+      if (!parse_string(&value)) return false;
+    } else {  // bare token: number / true / false / null
+      size_t start = i;
+      while (i < line.size() && line[i] != ',' && line[i] != '}' &&
+             !isspace((unsigned char)line[i]))
+        i++;
+      value = line.substr(start, i - start);
+    }
+    (*out)[key] = value;
+    skip_ws();
+    if (i < line.size() && line[i] == ',') {
+      i++;
+      continue;
+    }
+    if (i < line.size() && line[i] == '}') return true;
+    return false;
+  }
+  return false;
+}
+
+// Flow and peer names are operator/workload-supplied; constraining them
+// keeps every response JSON well-formed without an escaper and bounds the
+// fixed-size response buffers.
+constexpr size_t kMaxNameLen = 64;
+bool IsValidName(const std::string& s) {
+  if (s.empty() || s.size() > kMaxNameLen) return false;
+  for (char ch : s) {
+    if (!isalnum((unsigned char)ch) && ch != '-' && ch != '_' && ch != '.' &&
+        ch != ':' && ch != '/')
+      return false;
+  }
+  return true;
+}
+
+std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char ch : s) {
+    if (ch == '"' || ch == '\\') {
+      out.push_back('\\');
+      out.push_back(ch);
+    } else if ((unsigned char)ch < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", ch);
+      out += buf;
+    } else {
+      out.push_back(ch);
+    }
+  }
+  return out;
+}
+
+// ---- flow / buffer-pool state ----------------------------------------------
+
+struct Flow {
+  std::string name;
+  std::string peer;
+  int owner_fd = -1;
+  size_t buffer_bytes = 0;
+  void* buffer = nullptr;
+  unsigned long long transferred = 0;
+};
+
+class Daemon {
+ public:
+  Daemon(size_t pool_bytes, size_t max_flows)
+      : pool_bytes_(pool_bytes), max_flows_(max_flows) {}
+
+  std::string Handle(int fd, const std::map<std::string, std::string>& req) {
+    auto it = req.find("op");
+    if (it == req.end()) return Err("missing op");
+    const std::string& op = it->second;
+    if (op == "version") return Ok("\"version\":\"dcnxferd/1.0\"");
+    if (op == "ping") return Ok("");
+    if (op == "register_flow") return RegisterFlow(fd, req);
+    if (op == "record_transfer") return RecordTransfer(fd, req);
+    if (op == "release_flow") return ReleaseFlow(fd, req);
+    if (op == "stats") return Stats();
+    return Err("unknown op '" + op + "'");
+  }
+
+  void ReleaseClient(int fd) {
+    for (auto it = flows_.begin(); it != flows_.end();) {
+      if (it->second.owner_fd == fd) {
+        logf(1, "releasing flow '%s' (client fd %d gone)",
+             it->first.c_str(), fd);
+        FreeFlow(&it->second);
+        it = flows_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  ~Daemon() {
+    for (auto& kv : flows_) FreeFlow(&kv.second);
+  }
+
+ private:
+  static std::string Ok(const std::string& extra) {
+    return extra.empty() ? "{\"ok\":true}"
+                         : "{\"ok\":true," + extra + "}";
+  }
+  static std::string Err(const std::string& msg) {
+    return "{\"ok\":false,\"error\":\"" + msg + "\"}";
+  }
+
+  std::string RegisterFlow(int fd,
+                           const std::map<std::string, std::string>& req) {
+    auto fit = req.find("flow");
+    if (fit == req.end() || fit->second.empty())
+      return Err("register_flow needs 'flow'");
+    const std::string& name = fit->second;
+    if (!IsValidName(name))
+      return Err("invalid flow name (max 64 chars of [A-Za-z0-9._:/-])");
+    if (flows_.count(name))
+      return Err("flow '" + JsonEscape(name) + "' already exists");
+    if (flows_.size() >= max_flows_) return Err("max flows reached");
+
+    size_t bytes = 4 << 20;  // default 4 MiB staging buffer
+    auto bit = req.find("bytes");
+    if (bit != req.end()) {
+      if (bit->second.empty() || !isdigit((unsigned char)bit->second[0]))
+        return Err("invalid 'bytes'");
+      char* end = nullptr;
+      unsigned long long v = strtoull(bit->second.c_str(), &end, 10);
+      if (end == bit->second.c_str() || *end != '\0' || v == 0 ||
+          v > (1ull << 40))
+        return Err("invalid 'bytes'");
+      bytes = (size_t)v;
+    }
+    // Page-align; enforce the pool bound.
+    size_t page = (size_t)sysconf(_SC_PAGESIZE);
+    bytes = (bytes + page - 1) / page * page;
+    if (pool_used_ + bytes > pool_bytes_)
+      return Err("buffer pool exhausted");
+
+    void* buf = mmap(nullptr, bytes, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (buf == MAP_FAILED) return Err("mmap failed");
+    // Pin best-effort: staging buffers should not page out mid-transfer.
+    // Unprivileged runs (tests) may exceed RLIMIT_MEMLOCK; that is fine.
+    if (mlock(buf, bytes) != 0)
+      logf(2, "mlock(%zu) failed: %s (continuing unpinned)", bytes,
+           strerror(errno));
+
+    Flow f;
+    f.name = name;
+    auto pit = req.find("peer");
+    if (pit != req.end()) {
+      if (!pit->second.empty() && !IsValidName(pit->second))
+        return Err("invalid peer name (max 64 chars of [A-Za-z0-9._:/-])");
+      f.peer = pit->second;
+    }
+    f.owner_fd = fd;
+    f.buffer_bytes = bytes;
+    f.buffer = buf;
+    pool_used_ += bytes;
+    flows_[name] = f;
+    logf(1, "registered flow '%s' peer='%s' buffer=%zu", name.c_str(),
+         f.peer.c_str(), bytes);
+
+    char extra[160];
+    snprintf(extra, sizeof(extra),
+             "\"flow\":\"%s\",\"buffer_bytes\":%zu,\"pool_used\":%zu",
+             name.c_str(), bytes, pool_used_);
+    return Ok(extra);
+  }
+
+  std::string RecordTransfer(int fd,
+                             const std::map<std::string, std::string>& req) {
+    auto fit = req.find("flow");
+    if (fit == req.end()) return Err("record_transfer needs 'flow'");
+    auto it = flows_.find(fit->second);
+    if (it == flows_.end())
+      return Err("unknown flow '" + JsonEscape(fit->second) + "'");
+    if (it->second.owner_fd != fd) return Err("flow owned by another client");
+    auto bit = req.find("bytes");
+    if (bit == req.end()) return Err("record_transfer needs 'bytes'");
+    // Reject signs and garbage; strtoull would silently wrap "-1" to 2^64-1.
+    if (bit->second.empty() || !isdigit((unsigned char)bit->second[0]))
+      return Err("invalid 'bytes'");
+    char* end = nullptr;
+    unsigned long long v = strtoull(bit->second.c_str(), &end, 10);
+    if (end == bit->second.c_str() || *end != '\0' || v > (1ull << 62))
+      return Err("invalid 'bytes'");
+    it->second.transferred += v;
+    total_transferred_ += v;
+    char extra[96];
+    snprintf(extra, sizeof(extra), "\"flow_bytes\":%llu",
+             it->second.transferred);
+    return Ok(extra);
+  }
+
+  std::string ReleaseFlow(int fd,
+                          const std::map<std::string, std::string>& req) {
+    auto fit = req.find("flow");
+    if (fit == req.end()) return Err("release_flow needs 'flow'");
+    auto it = flows_.find(fit->second);
+    if (it == flows_.end())
+      return Err("unknown flow '" + JsonEscape(fit->second) + "'");
+    if (it->second.owner_fd != fd) return Err("flow owned by another client");
+    FreeFlow(&it->second);
+    flows_.erase(it);
+    return Ok("");
+  }
+
+  std::string Stats() {
+    std::string detail = "[";
+    bool first = true;
+    for (const auto& kv : flows_) {
+      char item[320];  // names are <=64 chars (IsValidName), so this fits
+      snprintf(item, sizeof(item),
+               "%s{\"flow\":\"%s\",\"peer\":\"%s\",\"buffer_bytes\":%zu,"
+               "\"transferred\":%llu}",
+               first ? "" : ",", kv.second.name.c_str(),
+               kv.second.peer.c_str(), kv.second.buffer_bytes,
+               kv.second.transferred);
+      detail += item;
+      first = false;
+    }
+    detail += "]";
+    char extra[256];
+    snprintf(extra, sizeof(extra),
+             "\"pool_bytes\":%zu,\"pool_used\":%zu,\"active_flows\":%zu,"
+             "\"total_transferred\":%llu,\"flows\":",
+             pool_bytes_, pool_used_, flows_.size(), total_transferred_);
+    return Ok(extra + detail);
+  }
+
+  void FreeFlow(Flow* f) {
+    if (f->buffer) {
+      munlock(f->buffer, f->buffer_bytes);
+      munmap(f->buffer, f->buffer_bytes);
+      f->buffer = nullptr;
+    }
+    pool_used_ -= f->buffer_bytes;
+  }
+
+  size_t pool_bytes_;
+  size_t max_flows_;
+  size_t pool_used_ = 0;
+  unsigned long long total_transferred_ = 0;
+  std::map<std::string, Flow> flows_;
+};
+
+// ---- event loop ------------------------------------------------------------
+
+struct Client {
+  int fd;
+  std::string inbuf;
+  std::string outbuf;  // pending response bytes (client slow to read)
+};
+
+// A client that won't drain 1 MiB of pending responses is broken or
+// malicious; drop it rather than buffer without bound.
+constexpr size_t kMaxOutbuf = 1 << 20;
+constexpr size_t kMaxInbuf = 1 << 16;
+
+// Returns false when the connection is dead.  Writes what it can now and
+// leaves the rest in outbuf for POLLOUT — one stuck client must never
+// block the event loop (fds are non-blocking).
+bool FlushClient(Client* c) {
+  while (!c->outbuf.empty()) {
+    ssize_t put = write(c->fd, c->outbuf.data(), c->outbuf.size());
+    if (put > 0) {
+      c->outbuf.erase(0, (size_t)put);
+    } else if (put < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      return true;  // try again on POLLOUT
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+int MakeListener(const std::string& sock_path) {
+  int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    perror("socket");
+    return -1;
+  }
+  unlink(sock_path.c_str());
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (sock_path.size() >= sizeof(addr.sun_path)) {
+    fprintf(stderr, "dcnxferd: socket path too long: %s\n", sock_path.c_str());
+    close(fd);
+    return -1;
+  }
+  strncpy(addr.sun_path, sock_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    close(fd);
+    return -1;
+  }
+  chmod(sock_path.c_str(), 0666);  // workload pods connect unprivileged
+  if (listen(fd, 64) != 0) {
+    perror("listen");
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int Serve(const std::string& sock_path, Daemon* daemon) {
+  int listener = MakeListener(sock_path);
+  if (listener < 0) return 1;
+  logf(0, "listening on %s", sock_path.c_str());
+
+  std::vector<Client> clients;
+  while (!g_stop) {
+    std::vector<pollfd> fds;
+    fds.push_back({listener, POLLIN, 0});
+    for (const auto& c : clients) {
+      short events = POLLIN;
+      if (!c.outbuf.empty()) events |= POLLOUT;
+      fds.push_back({c.fd, events, 0});
+    }
+    int n = poll(fds.data(), fds.size(), 500);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      perror("poll");
+      break;
+    }
+    // Only the clients present when poll() ran have valid revents; a
+    // freshly-accepted client is picked up on the next loop iteration.
+    size_t polled = fds.size() - 1;
+    for (size_t ci = 0; ci < polled;) {
+      Client& c = clients[ci];
+      pollfd& p = fds[1 + ci];
+      bool drop = false;
+      if (p.revents & POLLOUT) {
+        if (!FlushClient(&c)) drop = true;
+      }
+      if (!drop && (p.revents & (POLLIN | POLLHUP | POLLERR))) {
+        char buf[4096];
+        ssize_t got = read(c.fd, buf, sizeof(buf));
+        if (got == 0 || (got < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+          drop = true;
+        } else if (got > 0) {
+          c.inbuf.append(buf, (size_t)got);
+          size_t nl;
+          while ((nl = c.inbuf.find('\n')) != std::string::npos) {
+            std::string line = c.inbuf.substr(0, nl);
+            c.inbuf.erase(0, nl + 1);
+            if (line.empty()) continue;
+            std::map<std::string, std::string> req;
+            std::string resp = ParseFlatJson(line, &req)
+                                   ? daemon->Handle(c.fd, req)
+                                   : "{\"ok\":false,\"error\":\"bad json\"}";
+            c.outbuf += resp + "\n";
+          }
+          // Input lines are bounded; a client streaming garbage without
+          // newlines (or not draining responses) must not grow buffers
+          // forever.
+          if (c.inbuf.size() > kMaxInbuf || c.outbuf.size() > kMaxOutbuf)
+            drop = true;
+          if (!drop && !FlushClient(&c)) drop = true;
+        }
+      }
+      if (drop) {
+        daemon->ReleaseClient(c.fd);
+        close(c.fd);
+        logf(1, "client fd %d disconnected", c.fd);
+        clients.erase(clients.begin() + ci);
+        fds.erase(fds.begin() + 1 + ci);
+        polled--;
+      } else {
+        ++ci;
+      }
+    }
+    if (fds[0].revents & POLLIN) {
+      int cfd = accept4(listener, nullptr, nullptr,
+                        SOCK_CLOEXEC | SOCK_NONBLOCK);
+      if (cfd >= 0) {
+        clients.push_back({cfd, "", ""});
+        logf(1, "client fd %d connected", cfd);
+      }
+    }
+  }
+  for (auto& c : clients) {
+    daemon->ReleaseClient(c.fd);
+    close(c.fd);
+  }
+  close(listener);
+  unlink(sock_path.c_str());
+  logf(0, "shut down");
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string uds_path = "/run/tpu-dcn";
+  size_t pool_bytes = 256ull << 20;
+  size_t max_flows = 256;
+
+  for (int i = 1; i < argc; i++) {
+    std::string arg = argv[i];
+    auto next = [&]() -> const char* {
+      return (i + 1 < argc) ? argv[++i] : nullptr;
+    };
+    if (arg == "--uds_path" || arg == "--uds-path") {
+      const char* v = next();
+      if (v) uds_path = v;
+    } else if (arg == "--pool_bytes" || arg == "--pool-bytes") {
+      const char* v = next();
+      if (v) pool_bytes = strtoull(v, nullptr, 10);
+    } else if (arg == "--max_flows" || arg == "--max-flows") {
+      const char* v = next();
+      if (v) max_flows = strtoull(v, nullptr, 10);
+    } else if (arg == "--verbose" || arg == "-v") {
+      const char* v = next();
+      if (v) g_verbose = atoi(v);
+    } else if (arg == "--help" || arg == "-h") {
+      printf("usage: dcnxferd [--uds_path DIR] [--pool_bytes N] "
+             "[--max_flows N] [--verbose LEVEL]\n");
+      return 0;
+    } else {
+      fprintf(stderr, "dcnxferd: unknown flag %s\n", arg.c_str());
+      return 2;
+    }
+  }
+
+  mkdir(uds_path.c_str(), 0755);
+  signal(SIGINT, on_signal);
+  signal(SIGTERM, on_signal);
+  signal(SIGPIPE, SIG_IGN);
+
+  Daemon daemon(pool_bytes, max_flows);
+  return Serve(uds_path + "/xferd.sock", &daemon);
+}

@@ -1,0 +1,135 @@
+"""dcnxferd daemon tests: spawn the real native binary, drive the real
+UDS protocol (the role nccl-test pods play against tcpgpudmarxd)."""
+
+import os
+import signal
+import socket
+import subprocess
+import time
+
+import pytest
+
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    DcnXferClient,
+    DcnXferError,
+)
+
+BIN = os.path.join(os.path.dirname(__file__), "..",
+                   "native", "dcnxferd", "build", "dcnxferd")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BIN), reason="dcnxferd not built (run `make native`)"
+)
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    uds = str(tmp_path / "tpu-dcn")
+    proc = subprocess.Popen(
+        [BIN, "--uds_path", uds, "--pool_bytes", str(8 << 20),
+         "--max_flows", "4", "--verbose", "2"],
+        stderr=subprocess.PIPE, text=True,
+    )
+    sock_path = os.path.join(uds, "xferd.sock")
+    deadline = time.time() + 10
+    while not os.path.exists(sock_path):
+        assert proc.poll() is None, proc.stderr.read()
+        assert time.time() < deadline, "daemon never created its socket"
+        time.sleep(0.02)
+    yield uds
+    proc.send_signal(signal.SIGTERM)
+    proc.wait(timeout=10)
+
+
+def test_version_and_ping(daemon):
+    with DcnXferClient(daemon) as c:
+        assert c.version() == "dcnxferd/1.0"
+        c.ping()
+
+
+def test_register_transfer_release_flow(daemon):
+    with DcnXferClient(daemon) as c:
+        resp = c.register_flow("g0", peer="slice1-h0", bytes=1 << 20)
+        assert resp["buffer_bytes"] >= 1 << 20
+        assert c.record_transfer("g0", 4096) == 4096
+        assert c.record_transfer("g0", 4096) == 8192
+        stats = c.stats()
+        assert stats["active_flows"] == 1
+        assert stats["total_transferred"] == 8192
+        assert stats["flows"][0]["peer"] == "slice1-h0"
+        c.release_flow("g0")
+        assert c.stats()["active_flows"] == 0
+        assert c.stats()["pool_used"] == 0
+
+
+def test_pool_exhaustion_and_duplicate_flow(daemon):
+    with DcnXferClient(daemon) as c:
+        c.register_flow("big", bytes=6 << 20)
+        with pytest.raises(DcnXferError, match="pool exhausted"):
+            c.register_flow("too-big", bytes=4 << 20)
+        with pytest.raises(DcnXferError, match="already exists"):
+            c.register_flow("big")
+        # Released memory is reusable.
+        c.release_flow("big")
+        c.register_flow("big2", bytes=6 << 20)
+
+
+def test_max_flows(daemon):
+    with DcnXferClient(daemon) as c:
+        for i in range(4):
+            c.register_flow(f"f{i}", bytes=4096)
+        with pytest.raises(DcnXferError, match="max flows"):
+            c.register_flow("f4", bytes=4096)
+
+
+def test_client_disconnect_releases_its_flows(daemon):
+    c1 = DcnXferClient(daemon)
+    c1.register_flow("orphan", bytes=1 << 20)
+    with DcnXferClient(daemon) as c2:
+        assert c2.stats()["active_flows"] == 1
+        # Another client cannot touch c1's flow.
+        with pytest.raises(DcnXferError, match="another client"):
+            c2.release_flow("orphan")
+        c1.close()
+        deadline = time.time() + 5
+        while c2.stats()["active_flows"] != 0:
+            assert time.time() < deadline, "orphaned flow never released"
+            time.sleep(0.02)
+        assert c2.stats()["pool_used"] == 0
+
+
+def test_rejects_hostile_input(daemon):
+    with DcnXferClient(daemon) as c:
+        with pytest.raises(DcnXferError, match="invalid flow name"):
+            c.register_flow('evil"name')
+        with pytest.raises(DcnXferError, match="invalid flow name"):
+            c.register_flow("x" * 100)
+        c.register_flow("ok", bytes=4096)
+        with pytest.raises(DcnXferError, match="invalid 'bytes'"):
+            c.record_transfer("ok", -1)
+        with pytest.raises(DcnXferError, match="invalid 'bytes'"):
+            c._call(op="record_transfer", flow="ok", bytes="abc")
+        assert c.stats()["total_transferred"] == 0
+
+
+def test_slow_reader_does_not_block_other_clients(daemon):
+    # A client that pipelines requests without reading responses must not
+    # stall the event loop for everyone else.
+    stuck = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    stuck.connect(os.path.join(daemon, "xferd.sock"))
+    stuck.sendall(b'{"op":"stats"}\n' * 2000)  # never reads
+    with DcnXferClient(daemon, timeout_s=5) as c:
+        for i in range(10):
+            c.ping()  # would time out if the daemon were blocked
+    stuck.close()
+
+
+def test_bad_json_and_unknown_op(daemon):
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(os.path.join(daemon, "xferd.sock"))
+    f = sock.makefile("r")
+    sock.sendall(b"this is not json\n")
+    assert '"ok":false' in f.readline()
+    sock.sendall(b'{"op":"frobnicate"}\n')
+    assert "unknown op" in f.readline()
+    sock.close()

@@ -76,6 +76,14 @@ def test_get_devices_invalid_annotation_raises(bad):
         injector.get_devices("c", ann("c", bad))
 
 
+def test_get_devices_rejects_yaml_aliases():
+    # Alias expansion (billion-laughs) must be refused outright: pod
+    # annotations are untrusted input to a node-critical daemon.
+    bomb = "a: &a [x,x,x,x,x]\nb: &b [*a,*a,*a,*a]\nc: [*b,*b,*b,*b]\n"
+    with pytest.raises(ValueError):
+        injector.get_devices("c", ann("c", bomb))
+
+
 # ---- device stat -----------------------------------------------------------
 
 
