@@ -1,0 +1,133 @@
+#!/bin/bash
+# TPU node provisioning: install the TPU kernel driver (gasket/accel) and
+# the libtpu userland on an Ubuntu host, delivering the node contract the
+# device plugin waits on (/dev/accel*, /home/kubernetes/bin/tpu).
+#
+# TPU-native analog of the reference's NVIDIA ubuntu installer
+# (ref: nvidia-driver-installer/ubuntu/entrypoint.sh:33-180): same
+# cache-by-version skip, same host-dir delivery + ld.so.conf update, but
+# the payload is libtpu + the accel char-device driver instead of a
+# vendor .run installer, so no overlayfs redirection is needed — libtpu
+# is a single userland .so with a stable install path.
+
+set -o errexit
+set -o pipefail
+set -u
+
+set -x
+TPU_DRIVER_VERSION="${TPU_DRIVER_VERSION:-1.0.0}"
+LIBTPU_VERSION="${LIBTPU_VERSION:-0.0.11}"
+LIBTPU_DOWNLOAD_URL_DEFAULT="https://storage.googleapis.com/libtpu-releases/libtpu-${LIBTPU_VERSION}.so"
+LIBTPU_DOWNLOAD_URL="${LIBTPU_DOWNLOAD_URL:-$LIBTPU_DOWNLOAD_URL_DEFAULT}"
+TPU_INSTALL_DIR_HOST="${TPU_INSTALL_DIR_HOST:-/home/kubernetes/bin/tpu}"
+TPU_INSTALL_DIR_CONTAINER="${TPU_INSTALL_DIR_CONTAINER:-/usr/local/tpu}"
+ROOT_MOUNT_DIR="${ROOT_MOUNT_DIR:-/root}"
+CACHE_FILE="${TPU_INSTALL_DIR_CONTAINER}/.cache"
+KERNEL_VERSION="$(uname -r)"
+set +x
+
+check_cached_version() {
+  echo "Checking cached TPU install"
+  if [[ ! -f "${CACHE_FILE}" ]]; then
+    echo "Cache file ${CACHE_FILE} not found."
+    return 1
+  fi
+  # shellcheck disable=SC1090
+  . "${CACHE_FILE}"
+  if [[ "${KERNEL_VERSION}" == "${CACHE_KERNEL_VERSION:-}" ]] \
+      && [[ "${TPU_DRIVER_VERSION}" == "${CACHE_TPU_DRIVER_VERSION:-}" ]] \
+      && [[ "${LIBTPU_VERSION}" == "${CACHE_LIBTPU_VERSION:-}" ]]; then
+    echo "Found existing install for kernel ${KERNEL_VERSION}," \
+         "driver ${TPU_DRIVER_VERSION}, libtpu ${LIBTPU_VERSION}."
+    return 0
+  fi
+  echo "Cache file ${CACHE_FILE} found but versions didn't match."
+  return 1
+}
+
+update_cached_version() {
+  cat >"${CACHE_FILE}"<<__EOF__
+CACHE_KERNEL_VERSION=${KERNEL_VERSION}
+CACHE_TPU_DRIVER_VERSION=${TPU_DRIVER_VERSION}
+CACHE_LIBTPU_VERSION=${LIBTPU_VERSION}
+__EOF__
+  echo "Updated cache:"
+  cat "${CACHE_FILE}"
+}
+
+configure_install_dirs() {
+  echo "Configuring installation directories..."
+  mkdir -p "${TPU_INSTALL_DIR_CONTAINER}/lib64" \
+           "${TPU_INSTALL_DIR_CONTAINER}/bin"
+}
+
+install_kernel_driver() {
+  # TPU VM images ship the accel driver in-tree; on stock Ubuntu the
+  # gasket-dkms package provides it.  Either way the contract is the
+  # module being loaded and /dev/accel* appearing.
+  if lsmod | grep -qE '^(gasket|accel|tpu_common)'; then
+    echo "TPU kernel driver already loaded; skipping module install."
+    return 0
+  fi
+  echo "Installing TPU kernel driver..."
+  apt-get update
+  apt-get install -y "linux-headers-${KERNEL_VERSION}" gasket-dkms || {
+    echo "gasket-dkms unavailable; attempting modprobe of in-tree driver"
+  }
+  modprobe gasket 2>/dev/null || true
+  modprobe accel 2>/dev/null || true
+  echo "Installing TPU kernel driver... DONE."
+}
+
+download_libtpu() {
+  echo "Downloading libtpu ${LIBTPU_VERSION}..."
+  curl -L -S -f "${LIBTPU_DOWNLOAD_URL}" \
+      -o "${TPU_INSTALL_DIR_CONTAINER}/lib64/libtpu.so"
+  chmod 755 "${TPU_INSTALL_DIR_CONTAINER}/lib64/libtpu.so"
+  echo "Downloading libtpu... DONE."
+}
+
+update_host_ld_cache() {
+  echo "Updating host's ld cache..."
+  echo "${TPU_INSTALL_DIR_HOST}/lib64" \
+      >> "${ROOT_MOUNT_DIR}/etc/ld.so.conf.d/tpu.conf"
+  ldconfig -r "${ROOT_MOUNT_DIR}"
+  echo "Updating host's ld cache... DONE."
+}
+
+prepare_event_dir() {
+  # Health-event queue consumed by the device plugin's health checker
+  # (tpulib sysfs contract: /var/run/tpu/events).
+  mkdir -p "${ROOT_MOUNT_DIR}/var/run/tpu/events"
+}
+
+verify_installation() {
+  echo "Verifying TPU installation..."
+  local chips
+  chips="$(ls /dev/accel* 2>/dev/null | wc -l)"
+  if [[ "${chips}" -eq 0 ]]; then
+    echo "Verification failed: no /dev/accel* device nodes present." >&2
+    exit 1
+  fi
+  if [[ ! -s "${TPU_INSTALL_DIR_CONTAINER}/lib64/libtpu.so" ]]; then
+    echo "Verification failed: libtpu.so missing or empty." >&2
+    exit 1
+  fi
+  echo "Verified ${chips} TPU chip device node(s)."
+}
+
+main() {
+  if check_cached_version && lsmod | grep -qE '^(gasket|accel|tpu_common)'; then
+    echo "TPU already installed; nothing to do."
+    exit 0
+  fi
+  configure_install_dirs
+  install_kernel_driver
+  download_libtpu
+  update_host_ld_cache
+  prepare_event_dir
+  verify_installation
+  update_cached_version
+}
+
+main "$@"
