@@ -122,6 +122,64 @@ def test_device_plugin_manifest_mounts_required_paths():
         assert required in mounts, f"device plugin DS missing mount {required}"
 
 
+def test_collectives_configmap_flags_accepted_by_bench():
+    """run-collective.sh must invoke bench.py with flags its parser knows."""
+    import re
+
+    from container_engine_accelerators_tpu.collectives import bench
+
+    path = os.path.join(REPO, "ici-collectives", "xla-collectives-config.yaml")
+    (doc,) = _docs(path)
+    script = doc["data"]["run-collective.sh"]
+    used = set(re.findall(r"(--[a-z][a-z0-9_-]+)", script))
+    # Flags inside LIBTPU_INIT_ARGS belong to libtpu, not the bench CLI.
+    used = {f for f in used if not f.startswith("--xla")}
+
+    # bench builds its parser inside main(); recover the known option
+    # strings from a --help invocation.
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.suppress(SystemExit), contextlib.redirect_stdout(buf):
+        bench.main(["--help"])
+    helptext = buf.getvalue()
+    for flag in used:
+        assert flag in helptext, f"configmap uses unknown bench flag {flag}"
+
+
+def test_collectives_test_pods_symmetric_and_wired():
+    """Both rig variants: worker ids 0/1, shared coordinator, dcnxferd
+    flags accepted by the native binary's parser."""
+    for fname in (
+        "xla-collectives-test.yaml",
+        "xla-collectives-test-unprivileged-without-hostnetwork.yaml",
+    ):
+        path = os.path.join(REPO, "ici-collectives", fname)
+        pods = [d for d in _docs(path) if d["kind"] == "Pod"]
+        assert len(pods) == 2, f"{fname}: expected 2 pods"
+        ids = set()
+        for pod in pods:
+            test_c = next(
+                c for c in pod["spec"]["containers"]
+                if c["name"] == "xla-collectives-test"
+            )
+            env = {e["name"]: e.get("value") for e in test_c["env"]}
+            ids.add(env["TPU_WORKER_ID"])
+            assert env["TPU_WORKER_COUNT"] == "2"
+            assert env["TPU_COORDINATOR_ADDR"].startswith(
+                "xla-collectives-host-1"
+            )
+            daemon = next(
+                c for c in pod["spec"]["containers"] if c["name"] == "dcn-daemon"
+            )
+            flags = [a for a in daemon["command"] if a.startswith("--")]
+            for f in flags:
+                assert f in ("--uds_path", "--pool_bytes", "--max_flows",
+                             "--verbose"), f"{fname}: unknown dcnxferd flag {f}"
+        assert ids == {"0", "1"}, f"{fname}: worker ids {ids}"
+
+
 def test_installer_entrypoint_is_executable_bash():
     path = os.path.join(REPO, "libtpu-installer", "ubuntu", "entrypoint.sh")
     with open(path) as f:
