@@ -4,12 +4,20 @@
 PY := python3
 NATIVE_BUILD := native/tpushim/build
 DCNXFERD_BUILD := native/dcnxferd/build
+DCNFASTSOCK_BUILD := native/dcnfastsock/build
 
 .PHONY: all native test presubmit proto clean
 
 all: native
 
-native: $(NATIVE_BUILD)/libtpushim.so $(DCNXFERD_BUILD)/dcnxferd
+native: $(NATIVE_BUILD)/libtpushim.so $(DCNXFERD_BUILD)/dcnxferd \
+	$(DCNFASTSOCK_BUILD)/libdcnfastsock.so
+
+$(DCNFASTSOCK_BUILD)/libdcnfastsock.so: native/dcnfastsock/dcnfastsock.cc
+	mkdir -p $(DCNFASTSOCK_BUILD)
+	g++ -std=c++17 -O2 -Wall -Wextra -fPIC -shared \
+	    -o $(DCNFASTSOCK_BUILD)/libdcnfastsock.so \
+	    native/dcnfastsock/dcnfastsock.cc -ldl
 
 $(NATIVE_BUILD)/libtpushim.so: native/tpushim/tpushim.cc native/tpushim/tpushim.h
 	mkdir -p $(NATIVE_BUILD)
@@ -44,4 +52,4 @@ proto:
 	    protos/ttrpc/ttrpc.proto
 
 clean:
-	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD)
+	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD) $(DCNFASTSOCK_BUILD)

@@ -1,0 +1,123 @@
+// libdcnfastsock.so — DCN TCP transport tuning layer.
+//
+// TPU-native analog of the reference's NCCL fast-socket plugin
+// (ref: fast-socket-installer/image/Dockerfile:6-7, consumed as a
+// prebuilt libnccl-net.so).  NCCL loads its transport plugin through a
+// plugin ABI; JAX/XLA's DCN path uses plain sockets, so the idiomatic
+// delivery here is an LD_PRELOAD interposer that applies the same class
+// of tuning the fast-socket plugin applied inside NCCL:
+//
+//   * large SO_SNDBUF/SO_RCVBUF (DCN has a high bandwidth-delay product)
+//   * TCP_NODELAY (latency-sensitive control traffic)
+//   * optional SO_ZEROCOPY and SO_BUSY_POLL
+//
+// Tunables via env, all optional:
+//   DCN_FASTSOCK_SNDBUF / DCN_FASTSOCK_RCVBUF  (bytes, default 64 MiB)
+//   DCN_FASTSOCK_BUSY_POLL                     (µs, default off)
+//   DCN_FASTSOCK_ZEROCOPY=1                    (default off)
+//   DCN_FASTSOCK_VERBOSE=1                     (log each tuned socket)
+//
+// Only AF_INET/AF_INET6 SOCK_STREAM sockets are touched; unix sockets
+// (kubelet gRPC, dcnxferd control) pass through untouched.
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#ifndef SO_ZEROCOPY
+#define SO_ZEROCOPY 60
+#endif
+#ifndef SO_BUSY_POLL
+#define SO_BUSY_POLL 46
+#endif
+
+namespace {
+
+using socket_fn = int (*)(int, int, int);
+
+long env_long(const char* name, long fallback) {
+  const char* v = getenv(name);
+  if (!v || !*v) return fallback;
+  char* end = nullptr;
+  long out = strtol(v, &end, 10);
+  return (end && *end == '\0') ? out : fallback;
+}
+
+bool verbose() { return env_long("DCN_FASTSOCK_VERBOSE", 0) != 0; }
+
+void tune(int fd, int domain, int type) {
+  if (domain != AF_INET && domain != AF_INET6) return;
+  if ((type & 0xff) != SOCK_STREAM) return;
+
+  long sndbuf = env_long("DCN_FASTSOCK_SNDBUF", 64L << 20);
+  long rcvbuf = env_long("DCN_FASTSOCK_RCVBUF", 64L << 20);
+  long busy_poll = env_long("DCN_FASTSOCK_BUSY_POLL", 0);
+  long zerocopy = env_long("DCN_FASTSOCK_ZEROCOPY", 0);
+
+  int one = 1;
+  if (sndbuf > 0) {
+    int v = static_cast<int>(sndbuf);
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &v, sizeof(v));
+  }
+  if (rcvbuf > 0) {
+    int v = static_cast<int>(rcvbuf);
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &v, sizeof(v));
+  }
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  if (busy_poll > 0) {
+    int v = static_cast<int>(busy_poll);
+    setsockopt(fd, SOL_SOCKET, SO_BUSY_POLL, &v, sizeof(v));
+  }
+  if (zerocopy) {
+    setsockopt(fd, SOL_SOCKET, SO_ZEROCOPY, &one, sizeof(one));
+  }
+  if (verbose()) {
+    fprintf(stderr,
+            "[dcnfastsock] tuned fd=%d sndbuf=%ld rcvbuf=%ld busy_poll=%ld "
+            "zerocopy=%ld\n",
+            fd, sndbuf, rcvbuf, busy_poll, zerocopy);
+  }
+}
+
+}  // namespace
+
+extern "C" int socket(int domain, int type, int protocol) {
+  static socket_fn real = reinterpret_cast<socket_fn>(
+      dlsym(RTLD_NEXT, "socket"));
+  if (!real) {
+    errno = ENOSYS;
+    return -1;
+  }
+  int fd = real(domain, type, protocol);
+  if (fd >= 0) tune(fd, domain, type);
+  return fd;
+}
+
+// accept()ed sockets inherit buffer sizes from the listener on Linux,
+// but TCP_NODELAY does not propagate from all paths — interpose both.
+extern "C" int accept4(int sockfd, struct sockaddr* addr, socklen_t* addrlen,
+                       int flags) {
+  using accept4_fn =
+      int (*)(int, struct sockaddr*, socklen_t*, int);
+  static accept4_fn real = reinterpret_cast<accept4_fn>(
+      dlsym(RTLD_NEXT, "accept4"));
+  if (!real) {
+    errno = ENOSYS;
+    return -1;
+  }
+  int fd = real(sockfd, addr, addrlen, flags);
+  if (fd >= 0) {
+    struct sockaddr_storage ss;
+    socklen_t slen = sizeof(ss);
+    if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&ss), &slen) == 0) {
+      tune(fd, ss.ss_family, SOCK_STREAM);
+    }
+  }
+  return fd;
+}

@@ -1,0 +1,85 @@
+"""libdcnfastsock LD_PRELOAD tuning tests (fast-socket analog).
+
+The reference's fast-socket plugin is a prebuilt .so exercised only on
+clusters; ours is in-repo C++ so it gets real tests: preload the lib
+into a child interpreter and verify TCP sockets (both socket() and
+accept4() paths) pick up the tuned buffer sizes while unix sockets are
+left alone.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "native", "dcnfastsock", "build", "libdcnfastsock.so")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(LIB) or sys.platform != "linux",
+    reason="libdcnfastsock.so not built (run `make native`)",
+)
+
+SNDBUF = 4 * 1024 * 1024
+
+
+def _run_preloaded(code: str, **extra_env) -> str:
+    env = dict(
+        os.environ,
+        LD_PRELOAD=LIB,
+        DCN_FASTSOCK_SNDBUF=str(SNDBUF),
+        DCN_FASTSOCK_RCVBUF=str(SNDBUF),
+        **extra_env,
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(code)],
+        env=env, capture_output=True, text=True, timeout=30,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def _default_sndbuf() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.getsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF)
+
+
+def test_tcp_socket_tuned():
+    out = _run_preloaded("""
+        import socket
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        print(s.getsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF))
+        print(s.getsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY))
+    """)
+    sndbuf, nodelay = out.split()
+    assert int(sndbuf) >= SNDBUF
+    assert int(nodelay) == 1
+
+
+def test_unix_socket_untouched():
+    out = _run_preloaded("""
+        import socket
+        u = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        print(u.getsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF))
+    """)
+    assert int(out.strip()) < SNDBUF
+
+
+def test_accepted_socket_tuned():
+    out = _run_preloaded("""
+        import socket, threading
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        port = srv.getsockname()[1]
+        cli = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        t = threading.Thread(target=cli.connect, args=(("127.0.0.1", port),))
+        t.start()
+        conn, _ = srv.accept()
+        t.join()
+        print(conn.getsockopt(6, socket.TCP_NODELAY))
+    """)
+    assert int(out.strip()) == 1
