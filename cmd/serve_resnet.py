@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Minimal ResNet inference server — the serving-demo workload.
+
+Analog of the reference's TF-Serving deployment payload
+(ref: demo/serving/tensorflow-serving.yaml): a model server whose
+accelerator duty cycle drives the HPA.  Stdlib HTTP only (the demo image
+carries no serving framework):
+
+    POST /predict   {"batch": N} or {"inputs": [[...HWC floats...], ...]}
+                    -> {"predictions": [class_id, ...], "latency_ms": t}
+    GET  /healthz   -> ok
+
+Loads params from --model-dir if present (cmd/train_resnet.py's output),
+otherwise serves randomly-initialized weights (good enough to generate
+device load for the autoscaling demo).
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+log = logging.getLogger("serve-resnet")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="JAX ResNet serving demo")
+    p.add_argument("--port", type=int, default=9000)
+    p.add_argument("--resnet-depth", type=int, default=50)
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--model-dir", default=None,
+                   help="directory holding params.msgpack from training")
+    return p.parse_args(argv)
+
+
+def build_forward(args):
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import resnet
+
+    model = resnet(depth=args.resnet_depth, num_classes=args.num_classes)
+    rng = jax.random.PRNGKey(0)
+    sample = jnp.ones((1, args.image_size, args.image_size, 3), jnp.float32)
+    variables = model.init(rng, sample, train=False)
+
+    params_path = (os.path.join(args.model_dir, "params.msgpack")
+                   if args.model_dir else None)
+    if params_path and os.path.exists(params_path):
+        from flax import serialization
+
+        with open(params_path, "rb") as f:
+            restored = serialization.from_bytes(variables["params"], f.read())
+        variables = {**variables, "params": restored}
+        log.info("loaded params from %s", params_path)
+    else:
+        log.info("serving randomly-initialized params (demo mode)")
+
+    @jax.jit
+    def forward(x):
+        return jnp.argmax(model.apply(variables, x, train=False), axis=-1)
+
+    # Warm the compile cache for the common batch shapes.
+    for b in (1, 8):
+        forward(jnp.zeros((b, args.image_size, args.image_size, 3),
+                          jnp.float32)).block_until_ready()
+    return forward
+
+
+def make_handler(forward, args):
+    import jax.numpy as jnp
+    import numpy as np
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"status": "ok"})
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/predict":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                if "inputs" in req:
+                    x = np.asarray(req["inputs"], dtype=np.float32)
+                else:
+                    batch = int(req.get("batch", 1))
+                    x = np.random.default_rng(0).standard_normal(
+                        (batch, args.image_size, args.image_size, 3)
+                    ).astype(np.float32)
+                t0 = time.perf_counter()
+                preds = np.asarray(forward(jnp.asarray(x)))
+                dt = (time.perf_counter() - t0) * 1e3
+                self._reply(200, {"predictions": preds.tolist(),
+                                  "latency_ms": round(dt, 3)})
+            except Exception as e:  # demo server: report, don't die
+                self._reply(400, {"error": str(e)})
+
+        def log_message(self, fmt, *a):
+            log.debug(fmt, *a)
+
+    return Handler
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    args = parse_args(argv)
+    forward = build_forward(args)
+    srv = ThreadingHTTPServer(("0.0.0.0", args.port),
+                              make_handler(forward, args))
+    log.info("serving ResNet-%d on :%d", args.resnet_depth, args.port)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

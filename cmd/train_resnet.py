@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""ResNet training driver — the demo workload binary.
+
+The reference's training demo runs an external TF image with a flag
+sweep (ref: demo/gpu-training/generate_job.sh:54-70: resnet_main.py
+--train_batch_size/--resnet_depth/--base_learning_rate/--train_steps);
+this is the in-tree JAX equivalent consumed by demo/tpu-training/.
+Multi-host: rendezvous via the K8s env contract (parallel/dcn.py), then
+train data-parallel (optionally tensor-parallel) over the slice mesh.
+
+Data is synthetic by default so the demo has no dataset dependency; the
+step/throughput accounting matches bench.py.
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+log = logging.getLogger("train-resnet")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="JAX ResNet training demo")
+    p.add_argument("--resnet-depth", type=int, default=50,
+                   help="ResNet depth (34/50/101/152, like the demo sweep)")
+    p.add_argument("--train-batch-size", type=int, default=128,
+                   help="GLOBAL batch size across all chips")
+    p.add_argument("--base-learning-rate", type=float, default=0.1)
+    p.add_argument("--train-steps", type=int, default=200)
+    p.add_argument("--steps-per-eval", type=int, default=50,
+                   help="metric log interval (steps)")
+    p.add_argument("--num-classes", type=int, default=1000)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--model-par", type=int, default=1,
+                   help="tensor-parallel degree of the mesh")
+    p.add_argument("--model-dir", default=None,
+                   help="directory for final params (flax msgpack)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    args = parse_args(argv)
+
+    from container_engine_accelerators_tpu.parallel import dcn
+
+    num_procs, pid = dcn.initialize()
+
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import resnet
+    from container_engine_accelerators_tpu.models.train import (
+        cosine_sgd,
+        create_train_state,
+        make_sharded_train_step,
+    )
+    from container_engine_accelerators_tpu.parallel import create_mesh
+    from container_engine_accelerators_tpu.parallel.mesh import batch_sharding
+
+    n_dev = jax.device_count()
+    if args.train_batch_size % n_dev:
+        raise SystemExit(
+            f"--train-batch-size {args.train_batch_size} not divisible by "
+            f"{n_dev} devices"
+        )
+    mesh = create_mesh(model=args.model_par)
+    log.info("process %d/%d, %d devices, mesh %s",
+             pid, num_procs, n_dev, dict(zip(mesh.axis_names,
+                                             mesh.devices.shape)))
+
+    model = resnet(depth=args.resnet_depth, num_classes=args.num_classes)
+    rng = jax.random.PRNGKey(0)
+    local_batch = args.train_batch_size // num_procs
+    sample = jnp.ones((local_batch, args.image_size, args.image_size, 3),
+                      jnp.float32)
+    state = create_train_state(
+        model, rng, sample,
+        tx=cosine_sgd(base_lr=args.base_learning_rate,
+                      total_steps=args.train_steps,
+                      warmup_steps=min(500, max(1, args.train_steps // 10))),
+    )
+    step_fn, state = make_sharded_train_step(mesh, state)
+
+    # Synthetic input pipeline: distinct device-resident batches, rotated
+    # so execution caches can't short-circuit the step (see bench.py).
+    # Multi-host: each process contributes its local shard of the global
+    # batch (the reference leaned on MPI ranks for the same split).
+    import numpy as np
+
+    n_batches = 4
+    data_sh = batch_sharding(mesh)
+
+    def globalize(local):
+        if num_procs == 1:
+            return jax.device_put(jnp.asarray(local), data_sh)
+        return jax.make_array_from_process_local_data(data_sh, local)
+
+    np_rng = np.random.default_rng(pid)
+    xs = [globalize(np_rng.standard_normal(sample.shape, dtype=np.float32))
+          for _ in range(n_batches)]
+    ys = [globalize(np_rng.integers(0, args.num_classes, (local_batch,),
+                                    dtype=np.int32))
+          for _ in range(n_batches)]
+
+    t0 = time.perf_counter()
+    metrics = {}
+    for step in range(args.train_steps):
+        state, metrics = step_fn(state, xs[step % n_batches],
+                                 ys[step % n_batches])
+        if (step + 1) % args.steps_per_eval == 0:
+            m = jax.device_get(metrics)
+            dt = time.perf_counter() - t0
+            log.info(
+                "step %d loss=%.4f acc=%.4f images/sec=%.1f",
+                step + 1, float(m["loss"]), float(m["accuracy"]),
+                (step + 1) * args.train_batch_size / dt,
+            )
+    jax.block_until_ready(state.params)
+    total = time.perf_counter() - t0
+    log.info("done: %d steps, %.1f images/sec overall",
+             args.train_steps,
+             args.train_steps * args.train_batch_size / total)
+
+    if args.model_dir and pid == 0:
+        from flax import serialization
+
+        os.makedirs(args.model_dir, exist_ok=True)
+        path = os.path.join(args.model_dir, "params.msgpack")
+        with open(path, "wb") as f:
+            f.write(serialization.to_bytes(jax.device_get(state.params)))
+        log.info("wrote final params to %s", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python3
+"""TPU error injector — the Xid-31 fault-injection demo, TPU-native.
+
+The reference exercises its health checker with a CUDA kernel that does
+an out-of-bounds write, producing Xid 31 in the driver's event stream
+(ref: demo/gpu-error/illegal-memory-access/vectorAdd.cu:29-35).  TPUs
+have no user-triggerable equivalent of a poisoned kernel, but the health
+contract is the event queue /var/run/tpu/events (tpulib/sysfs.py): this
+tool drops a critical-error event file there, which the device plugin's
+health checker consumes and uses to mark the device Unhealthy — the same
+end-to-end flow the CUDA demo validates.
+
+Optionally (--real-oom) it instead provokes a genuine device error by
+allocating past HBM capacity on the attached chip.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+DEFAULT_EVENTS_DIR = "/var/run/tpu/events"
+
+
+def inject(events_dir: str, code: int, device: str, message: str) -> str:
+    """Atomically drop one event file into the queue; returns its path."""
+    os.makedirs(events_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=events_dir, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        json.dump({"code": code, "device": device or None,
+                   "message": message}, f)
+    final = os.path.join(events_dir, f"{time.monotonic_ns()}.json")
+    os.rename(tmp, final)
+    return final
+
+
+def real_oom():
+    """Allocate past HBM capacity — a genuine device error, the closest
+    TPU analog of the CUDA OOB write."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    stats = dev.memory_stats() or {}
+    limit = stats.get("bytes_limit", 16 * 2**30)
+    n = int(limit * 2) // 4  # 2x HBM in f32
+    print(f"allocating {n * 4 / 2**30:.1f} GiB on {dev} "
+          f"(limit {limit / 2**30:.1f} GiB) ...")
+    x = jnp.ones((n,), jnp.float32)
+    x.block_until_ready()  # expected to raise RESOURCE_EXHAUSTED
+    print("allocation unexpectedly succeeded")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="Inject a TPU error event")
+    p.add_argument("--events-dir", default=DEFAULT_EVENTS_DIR)
+    p.add_argument("--code", type=int, default=48,
+                   help="error code (48 = double-bit ECC, the default "
+                        "critical code, manager config analog)")
+    p.add_argument("--device", default="accel0",
+                   help="device name, or empty for a whole-node event")
+    p.add_argument("--message", default="injected by demo/tpu-error")
+    p.add_argument("--real-oom", action="store_true",
+                   help="provoke a genuine HBM OOM instead of injecting")
+    args = p.parse_args(argv)
+
+    if args.real_oom:
+        real_oom()
+        return
+    path = inject(args.events_dir, args.code, args.device, args.message)
+    print(f"injected event code={args.code} device={args.device!r} -> {path}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,73 @@
+#!/bin/bash
+# Hyperparameter-sweep job generator for the TPU ResNet demo.
+#
+# Analog of the reference's GPU sweep generator
+# (ref: demo/gpu-training/generate_job.sh:17-77): same sweep axes
+# (learning rate x batch size x depth), same 90-epoch/1.28M-image step
+# accounting, but the workload is the in-tree JAX driver
+# (cmd/train_resnet.py) on a google.com/tpu node instead of an external
+# TF image on nvidia.com/gpu.
+
+EXPERIMENT_ID="resnet-tpu-$(date "+%y-%m-%d-%H-%M-%S")"
+
+BASE_LEARNING_RATES=(0.001 0.01 0.1 0.05)
+BATCH_SIZES=(256 512)
+DEPTH_CHOICES=(34 50 101 152)
+
+EPOCHS=90
+NUM_IMAGES=1281167
+
+echo "Experiment number ${EXPERIMENT_ID}"
+rm -rf "$EXPERIMENT_ID"
+mkdir "$EXPERIMENT_ID"
+
+for DEPTH in "${DEPTH_CHOICES[@]}"; do
+  for BATCH_SIZE in "${BATCH_SIZES[@]}"; do
+    for BASE_LEARNING_RATE in "${BASE_LEARNING_RATES[@]}"; do
+      JOB_ID=${EXPERIMENT_ID}-${BATCH_SIZE}-${DEPTH}-${BASE_LEARNING_RATE}
+      TRAIN_STEPS=$((EPOCHS * NUM_IMAGES / BATCH_SIZE))
+      cat >"$EXPERIMENT_ID/$JOB_ID.yaml" <<EOF
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: ${JOB_ID}
+  labels:
+    experiment-id: ${EXPERIMENT_ID}
+spec:
+  template:
+    metadata:
+      labels:
+        experiment-id: ${EXPERIMENT_ID}
+    spec:
+      restartPolicy: Never
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: tpu-v5-lite-podslice
+      tolerations:
+      - key: google.com/tpu
+        operator: Exists
+        effect: NoSchedule
+      containers:
+      - name: resnet-tpu
+        image: gcr.io/gke-release/tpu-device-plugin:latest
+        command:
+          - python3
+          - /app/cmd/train_resnet.py
+          - --resnet-depth=${DEPTH}
+          - --train-batch-size=${BATCH_SIZE}
+          - --base-learning-rate=${BASE_LEARNING_RATE}
+          - --train-steps=${TRAIN_STEPS}
+          - --steps-per-eval=25000
+          - --model-dir=/models/${EXPERIMENT_ID}/${BATCH_SIZE}-${BASE_LEARNING_RATE}-${DEPTH}
+        env:
+        - name: EXPERIMENT_ID
+          valueFrom:
+            fieldRef:
+              fieldPath: metadata.labels['experiment-id']
+        resources:
+          limits:
+            google.com/tpu: 8
+EOF
+    done
+  done
+done
+echo "Generated $(ls "$EXPERIMENT_ID" | wc -l) job manifests under $EXPERIMENT_ID/"

@@ -1,0 +1,49 @@
+#!/usr/bin/env python3
+"""Verify the TPU-sharing env contract inside a container.
+
+Analog of the reference's CUDA MPS check
+(ref: example/cuda-mps/cuda_mem_and_sm_count.c — prints visible SM count
+and memory so operators can confirm CUDA_MPS_ACTIVE_THREAD_PERCENTAGE /
+CUDA_MPS_PINNED_DEVICE_MEM_LIMIT took effect).  The TPU sharing contract
+(sharing/sharing.py, manager.Envs analog) is:
+
+    TPU_CORE_PERCENTAGE   — TensorCore fraction granted to this client
+    TPU_HBM_LIMIT_BYTES   — HBM cap for this client
+
+This prints the granted contract plus what the runtime actually sees,
+and exits non-zero when a declared HBM cap is not being enforced.
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    core_pct = os.environ.get("TPU_CORE_PERCENTAGE")
+    hbm_limit = os.environ.get("TPU_HBM_LIMIT_BYTES")
+    print(f"TPU_CORE_PERCENTAGE = {core_pct or '<unset>'}")
+    print(f"TPU_HBM_LIMIT_BYTES = {hbm_limit or '<unset>'}")
+
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception as e:
+        print(f"could not initialize JAX: {e}")
+        return 1
+
+    print(f"visible devices: {len(devices)}")
+    ok = True
+    for d in devices:
+        stats = d.memory_stats() or {}
+        limit = stats.get("bytes_limit")
+        print(f"  {d.device_kind} id={d.id} bytes_limit={limit}")
+        if hbm_limit and limit and limit > int(hbm_limit):
+            print(f"  ERROR: runtime limit {limit} exceeds granted "
+                  f"{hbm_limit}")
+            ok = False
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
