@@ -1,0 +1,34 @@
+# Release image for the TPU accelerator stack (ref shape: Dockerfile —
+# builder stage + minimal runtime).  One image serves every component:
+# device plugin, partitioner, scheduler daemons, NRI injector, demos —
+# each selected by command in its manifest.
+FROM python:3.12-slim-bookworm AS builder
+
+RUN apt-get update && \
+    apt-get install -y --no-install-recommends g++ make && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY Makefile ./
+COPY native/ native/
+RUN make native
+
+FROM python:3.12-slim-bookworm
+
+WORKDIR /app
+COPY container_engine_accelerators_tpu/ container_engine_accelerators_tpu/
+COPY cmd/ cmd/
+COPY demo/ demo/
+COPY example/ example/
+COPY --from=builder /src/native/tpushim/build/libtpushim.so \
+    /usr/local/lib/libtpushim.so
+COPY --from=builder /src/native/dcnxferd/build/dcnxferd \
+    /usr/local/bin/dcnxferd
+COPY --from=builder /src/native/dcnfastsock/build/libdcnfastsock.so \
+    /usr/local/lib/libdcnfastsock.so
+
+ENV PYTHONPATH=/app
+CMD ["python3", "/app/cmd/tpu_device_plugin.py"]
+# To expose container-level TPU metrics + health monitoring, use:
+# CMD ["python3", "/app/cmd/tpu_device_plugin.py", \
+#      "--enable-container-tpu-metrics", "--enable-health-monitoring"]

@@ -34,6 +34,8 @@ test: native
 
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests
+	bash build/check_boilerplate.sh
+	bash build/check_shell.sh
 
 # Regenerate protobuf message modules (grpc_tools absent: bare protoc only;
 # service stubs are hand-written in deviceplugin/api.py).

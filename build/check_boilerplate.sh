@@ -1,0 +1,26 @@
+#!/bin/bash
+# Presubmit: every first-party Python/C++ source must open with a
+# docstring/comment (the reference enforces license boilerplate the same
+# way, build/check_boilerplate.sh; here the bar is a documented header
+# citing intent).
+set -o errexit
+set -o nounset
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+fail=0
+while IFS= read -r f; do
+  first="$(head -c 400 "$f" | sed -e 's/^#!.*$//' -e '/^$/d' | head -1)"
+  case "$first" in
+    '"""'*|'# '*|'//'*|'/*'*) ;;
+    *)
+      echo "missing header comment/docstring: $f"
+      fail=1
+      ;;
+  esac
+done < <(find container_engine_accelerators_tpu cmd native tests \
+           -name '*.py' -o -name '*.cc' -o -name '*.h' | \
+         grep -v '_pb2.py$' | grep -v '/build/')
+
+exit $fail

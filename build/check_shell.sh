@@ -1,0 +1,18 @@
+#!/bin/bash
+# Presubmit: bash -n every shell script (the reference's gofmt-check
+# analog for our shell surface).
+set -o errexit
+set -o nounset
+set -o pipefail
+
+cd "$(dirname "$0")/.."
+
+fail=0
+while IFS= read -r f; do
+  if ! bash -n "$f"; then
+    echo "shell syntax error: $f"
+    fail=1
+  fi
+done < <(find . -name '*.sh' -not -path './.git/*' -not -path '*/build/*')
+
+exit $fail

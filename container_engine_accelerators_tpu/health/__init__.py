@@ -1,3 +1,4 @@
+"""Public re-exports for the health package."""
 from container_engine_accelerators_tpu.health.health_checker import (
     TpuHealthChecker,
     DEFAULT_CRITICAL_CODES,

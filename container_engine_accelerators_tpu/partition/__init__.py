@@ -1,3 +1,4 @@
+"""Public re-exports for the partition package."""
 from container_engine_accelerators_tpu.partition.subslice import (
     SubsliceDeviceManager,
     compute_subslices,

@@ -1,3 +1,4 @@
+"""Public re-exports for the sharing package."""
 from container_engine_accelerators_tpu.sharing.sharing import (
     SharingStrategy,
     is_virtual_device_id,

@@ -1,3 +1,4 @@
+"""Public re-exports for the utils package."""
 from container_engine_accelerators_tpu.utils.devname import (
     device_name_from_path,
     device_path_from_name,
