@@ -1,3 +1,4 @@
+"""Public re-exports for the collectives package."""
 from container_engine_accelerators_tpu.collectives.bench import (
     CollectiveResult,
     run_sweep,

@@ -1,3 +1,4 @@
+"""Public re-exports for the deviceplugin package."""
 from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
 
 __all__ = ["TpuManager"]

@@ -1,3 +1,4 @@
+"""Public re-exports for the metrics package."""
 from container_engine_accelerators_tpu.metrics.metrics import MetricServer
 
 __all__ = ["MetricServer"]

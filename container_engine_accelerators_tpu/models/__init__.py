@@ -1,3 +1,4 @@
+"""Public re-exports for the models package."""
 from container_engine_accelerators_tpu.models.resnet import ResNet, resnet
 
 __all__ = ["ResNet", "resnet"]

@@ -1,3 +1,4 @@
+"""Public re-exports for the parallel package."""
 from container_engine_accelerators_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
