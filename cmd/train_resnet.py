@@ -25,6 +25,10 @@ log = logging.getLogger("train-resnet")
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description="JAX ResNet training demo")
+    p.add_argument("--model", default="resnet",
+                   choices=("resnet", "inception-v3"),
+                   help="model family (the reference demo ships both, "
+                        "demo/tpu-training/{resnet,inception-v3}-tpu.yaml)")
     p.add_argument("--resnet-depth", type=int, default=50,
                    help="ResNet depth (34/50/101/152, like the demo sweep)")
     p.add_argument("--train-batch-size", type=int, default=128,
@@ -54,7 +58,7 @@ def main(argv=None):
     import jax
     import jax.numpy as jnp
 
-    from container_engine_accelerators_tpu.models import resnet
+    from container_engine_accelerators_tpu.models import inception_v3, resnet
     from container_engine_accelerators_tpu.models.train import (
         cosine_sgd,
         create_train_state,
@@ -74,7 +78,10 @@ def main(argv=None):
              pid, num_procs, n_dev, dict(zip(mesh.axis_names,
                                              mesh.devices.shape)))
 
-    model = resnet(depth=args.resnet_depth, num_classes=args.num_classes)
+    if args.model == "inception-v3":
+        model = inception_v3(num_classes=args.num_classes)
+    else:
+        model = resnet(depth=args.resnet_depth, num_classes=args.num_classes)
     rng = jax.random.PRNGKey(0)
     local_batch = args.train_batch_size // num_procs
     sample = jnp.ones((local_batch, args.image_size, args.image_size, 3),

@@ -1,4 +1,8 @@
 """Public re-exports for the models package."""
+from container_engine_accelerators_tpu.models.inception import (
+    InceptionV3,
+    inception_v3,
+)
 from container_engine_accelerators_tpu.models.resnet import ResNet, resnet
 
-__all__ = ["ResNet", "resnet"]
+__all__ = ["InceptionV3", "ResNet", "inception_v3", "resnet"]

@@ -1,0 +1,189 @@
+"""Inception-v3 in Flax — the reference demo's second TPU model family.
+
+The reference's TPU demo runs Inception-v3 alongside ResNet
+(ref: demo/tpu-training/inception-v3-tpu.yaml:66-73, a TF 1.x TPU models
+job on cloud-tpus.google.com/v2).  TPU-native re-design matching
+models/resnet.py: Flax + XLA, bfloat16 compute / float32 params, NHWC,
+static control flow so every mixed block fuses onto the MXU.
+
+Architecture follows the standard Inception-v3 channel plan (stem →
+3×InceptionA → InceptionB → 4×InceptionC → InceptionD → 2×InceptionE →
+global pool → head); aux head omitted (inference/demo parity does not
+need it and it would complicate the shared train_step).
+"""
+
+import functools
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+
+class ConvBNAct(nn.Module):
+    """Conv + BatchNorm + ReLU, the Inception primitive."""
+
+    features: int
+    kernel: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+
+    @nn.compact
+    def __call__(self, x):
+        x = self.conv(self.features, self.kernel, self.strides,
+                      padding=self.padding)(x)
+        x = self.norm()(x)
+        return nn.relu(x)
+
+
+def _pool(x, window, strides, kind="avg"):
+    fn = nn.avg_pool if kind == "avg" else nn.max_pool
+    return fn(x, (window, window), strides=(strides, strides),
+              padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    cba: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.cba(64, (1, 1))(x)
+        b5 = self.cba(48, (1, 1))(x)
+        b5 = self.cba(64, (5, 5))(b5)
+        b3 = self.cba(64, (1, 1))(x)
+        b3 = self.cba(96, (3, 3))(b3)
+        b3 = self.cba(96, (3, 3))(b3)
+        bp = _pool(x, 3, 1)
+        bp = self.cba(self.pool_features, (1, 1))(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+
+    cba: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.cba(384, (3, 3), strides=(2, 2))(x)
+        bd = self.cba(64, (1, 1))(x)
+        bd = self.cba(96, (3, 3))(bd)
+        bd = self.cba(96, (3, 3), strides=(2, 2))(bd)
+        bp = _pool(x, 3, 2, "max")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 block."""
+
+    channels_7x7: int
+    cba: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        c7 = self.channels_7x7
+        b1 = self.cba(192, (1, 1))(x)
+        b7 = self.cba(c7, (1, 1))(x)
+        b7 = self.cba(c7, (1, 7))(b7)
+        b7 = self.cba(192, (7, 1))(b7)
+        bd = self.cba(c7, (1, 1))(x)
+        bd = self.cba(c7, (7, 1))(bd)
+        bd = self.cba(c7, (1, 7))(bd)
+        bd = self.cba(c7, (7, 1))(bd)
+        bd = self.cba(192, (1, 7))(bd)
+        bp = _pool(x, 3, 1)
+        bp = self.cba(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+
+    cba: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b3 = self.cba(192, (1, 1))(x)
+        b3 = self.cba(320, (3, 3), strides=(2, 2))(b3)
+        b7 = self.cba(192, (1, 1))(x)
+        b7 = self.cba(192, (1, 7))(b7)
+        b7 = self.cba(192, (7, 1))(b7)
+        b7 = self.cba(192, (3, 3), strides=(2, 2))(b7)
+        bp = _pool(x, 3, 2, "max")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank output block."""
+
+    cba: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        b1 = self.cba(320, (1, 1))(x)
+        b3 = self.cba(384, (1, 1))(x)
+        b3 = jnp.concatenate(
+            [self.cba(384, (1, 3))(b3), self.cba(384, (3, 1))(b3)], axis=-1)
+        bd = self.cba(448, (1, 1))(x)
+        bd = self.cba(384, (3, 3))(bd)
+        bd = jnp.concatenate(
+            [self.cba(384, (1, 3))(bd), self.cba(384, (3, 1))(bd)], axis=-1)
+        bp = _pool(x, 3, 1)
+        bp = self.cba(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    """Inception-v3 with the standard channel plan."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-3,
+            dtype=self.dtype,
+            axis_name=None,
+        )
+        cba = functools.partial(ConvBNAct, conv=conv, norm=norm)
+
+        x = jnp.asarray(x, self.dtype)
+        # Stem: 299x299x3 -> 35x35x192 ("VALID" pads dropped for SAME —
+        # keeps shapes power-of-two-friendly for XLA tiling).
+        x = cba(32, (3, 3), strides=(2, 2))(x)
+        x = cba(32, (3, 3))(x)
+        x = cba(64, (3, 3))(x)
+        x = _pool(x, 3, 2, "max")
+        x = cba(80, (1, 1))(x)
+        x = cba(192, (3, 3))(x)
+        x = _pool(x, 3, 2, "max")
+
+        x = InceptionA(32, cba=cba)(x)
+        x = InceptionA(64, cba=cba)(x)
+        x = InceptionA(64, cba=cba)(x)
+        x = InceptionB(cba=cba)(x)
+        x = InceptionC(128, cba=cba)(x)
+        x = InceptionC(160, cba=cba)(x)
+        x = InceptionC(160, cba=cba)(x)
+        x = InceptionC(192, cba=cba)(x)
+        x = InceptionD(cba=cba)(x)
+        x = InceptionE(cba=cba)(x)
+        x = InceptionE(cba=cba)(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return jnp.asarray(x, jnp.float32)
+
+
+def inception_v3(**kwargs) -> InceptionV3:
+    """Build Inception-v3 (the demo's second model family)."""
+    return InceptionV3(**kwargs)

@@ -109,3 +109,53 @@ def test_sharded_matches_single_device_loss():
         float(local_metrics["loss"]), float(sharded_metrics["loss"]),
         rtol=2e-2,
     )
+
+
+class TestInceptionV3:
+    """Second demo model family (demo/tpu-training/inception-v3-tpu.yaml
+    analog): forward shape, dtype policy, and a sharded train step."""
+
+    def test_forward_shape_and_dtype(self):
+        import jax
+        import jax.numpy as jnp
+
+        from container_engine_accelerators_tpu.models import inception_v3
+
+        model = inception_v3(num_classes=10)
+        x = jnp.ones((2, 75, 75, 3), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        logits = model.apply(variables, x, train=False)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == jnp.float32
+        # Compute path is bf16: conv kernels stored f32 (param precision).
+        kernel = jax.tree_util.tree_leaves(variables["params"])[0]
+        assert kernel.dtype == jnp.float32
+
+    def test_train_step_decreases_loss(self):
+        import jax
+        import jax.numpy as jnp
+
+        from container_engine_accelerators_tpu.models import inception_v3
+        from container_engine_accelerators_tpu.models.train import (
+            cosine_sgd,
+            create_train_state,
+            train_step,
+        )
+
+        model = inception_v3(num_classes=8)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 75, 75, 3))
+        y = jax.random.randint(jax.random.PRNGKey(2), (4,), 0, 8)
+        state = create_train_state(
+            model, jax.random.PRNGKey(0), x,
+            tx=cosine_sgd(base_lr=0.01, total_steps=10, warmup_steps=1),
+        )
+        step = jax.jit(train_step, donate_argnums=(0,))
+        _, m0 = step(state, x, y)
+        state2, _ = step(create_train_state(
+            model, jax.random.PRNGKey(0), x,
+            tx=cosine_sgd(base_lr=0.01, total_steps=10, warmup_steps=1)), x, y)
+        losses = [float(m0["loss"])]
+        for _ in range(3):
+            state2, m = step(state2, x, y)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
