@@ -37,6 +37,25 @@ presubmit:
 	bash build/check_boilerplate.sh
 	bash build/check_shell.sh
 
+# Container images (ref: Makefile:44-60's four image targets).
+REGISTRY ?= gcr.io/gke-release
+VERSION ?= $(shell cat VERSION)
+
+.PHONY: device-plugin-image fastsock-image installer-image images
+
+device-plugin-image:
+	docker build -t $(REGISTRY)/tpu-device-plugin:$(VERSION) .
+
+fastsock-image:
+	docker build -t $(REGISTRY)/dcn-fastsock-installer:$(VERSION) \
+	    -f dcn-socket-installer/image/Dockerfile .
+
+installer-image:
+	docker build -t $(REGISTRY)/libtpu-installer-ubuntu:$(VERSION) \
+	    libtpu-installer/ubuntu
+
+images: device-plugin-image fastsock-image installer-image
+
 # Regenerate protobuf message modules (grpc_tools absent: bare protoc only;
 # service stubs are hand-written in deviceplugin/api.py).
 proto:
