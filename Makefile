@@ -37,6 +37,25 @@ presubmit:
 	bash build/check_boilerplate.sh
 	bash build/check_shell.sh
 
+# Sanitizer build + test of the native daemon — the `go test -race`
+# analog for our C++ surface (ref: Makefile:20-22 runs the unit suite
+# under the race detector on every CI run).
+ASAN_BUILD := native/dcnxferd/build-asan
+
+.PHONY: native-asan test-asan
+
+native-asan: $(ASAN_BUILD)/dcnxferd
+
+$(ASAN_BUILD)/dcnxferd: native/dcnxferd/dcnxferd.cc
+	mkdir -p $(ASAN_BUILD)
+	g++ -std=c++17 -O1 -g -Wall -Wextra \
+	    -fsanitize=address,undefined -fno-omit-frame-pointer \
+	    -o $(ASAN_BUILD)/dcnxferd native/dcnxferd/dcnxferd.cc
+
+test-asan: native-asan
+	DCNXFERD_BIN=$(ASAN_BUILD)/dcnxferd \
+	    $(PY) -m pytest tests/test_dcnxferd.py -x -q
+
 # Container images (ref: Makefile:44-60's four image targets).
 REGISTRY ?= gcr.io/gke-release
 VERSION ?= $(shell cat VERSION)

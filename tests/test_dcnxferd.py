@@ -16,6 +16,9 @@ from container_engine_accelerators_tpu.parallel.dcn_client import (
 
 BIN = os.path.join(os.path.dirname(__file__), "..",
                    "native", "dcnxferd", "build", "dcnxferd")
+# Sanitizer builds point DCNXFERD_BIN at the instrumented binary
+# (make test-asan), the `go test -race` analog for our native surface.
+BIN = os.environ.get("DCNXFERD_BIN", BIN)
 
 pytestmark = pytest.mark.skipif(
     not os.path.exists(BIN), reason="dcnxferd not built (run `make native`)"
