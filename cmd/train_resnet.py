@@ -43,6 +43,11 @@ def parse_args(argv=None):
                    help="tensor-parallel degree of the mesh")
     p.add_argument("--model-dir", default=None,
                    help="directory for final params (flax msgpack)")
+    p.add_argument("--profile-dir", default=None,
+                   help="write an XLA profiler trace of steps 10-20 here "
+                        "(the reference's tracing story is glog -v=10 + "
+                        "NCCL_DEBUG; the TPU-idiomatic tool is the XLA "
+                        "profiler, SURVEY.md §5)")
     return p.parse_args(argv)
 
 
@@ -118,8 +123,14 @@ def main(argv=None):
     t0 = time.perf_counter()
     metrics = {}
     for step in range(args.train_steps):
+        if args.profile_dir and step == min(10, args.train_steps - 1):
+            jax.profiler.start_trace(args.profile_dir)
         state, metrics = step_fn(state, xs[step % n_batches],
                                  ys[step % n_batches])
+        if args.profile_dir and step == min(20, args.train_steps - 1):
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
+            log.info("wrote XLA profile to %s", args.profile_dir)
         if (step + 1) % args.steps_per_eval == 0:
             m = jax.device_get(metrics)
             dt = time.perf_counter() - t0

@@ -128,3 +128,16 @@ def test_generate_job_sh_produces_valid_jobs(tmp_path):
             if a.startswith("--")]
     args = train.parse_args(argv)
     assert args.resnet_depth in (34, 50, 101, 152)
+
+
+def test_train_resnet_profile_trace(tmp_path):
+    train = _load("train_resnet_prof", "cmd", "train_resnet.py")
+    prof = tmp_path / "prof"
+    train.main([
+        "--resnet-depth", "34", "--train-batch-size", "8",
+        "--train-steps", "2", "--steps-per-eval", "5",
+        "--image-size", "32", "--num-classes", "10",
+        "--profile-dir", str(prof),
+    ])
+    traces = list(prof.rglob("*"))
+    assert traces, "profiler produced no trace files"
