@@ -81,5 +81,22 @@ class DcnXferClient:
     def release_flow(self, flow: str) -> None:
         self._call(op="release_flow", flow=flow)
 
+    def data_port(self) -> int:
+        """TCP port of the daemon's data-plane listener."""
+        return int(self._call(op="data_port")["port"])
+
+    def send(self, flow: str, host: str, port: int,
+             nbytes: Optional[int] = None) -> dict:
+        """Stream the flow's staging buffer to a peer daemon's data port.
+
+        Returns {bytes, micros, gbps}.  This is the DCN data path the
+        reference drives through its NCCL plugin; here the daemon itself
+        moves the bytes and reports achieved throughput.
+        """
+        req = {"op": "send", "flow": flow, "host": host, "port": str(port)}
+        if nbytes is not None:
+            req["bytes"] = nbytes
+        return self._call(**req)
+
     def stats(self) -> dict:
         return self._call(op="stats")

@@ -16,23 +16,39 @@
 //   {"op":"register_flow","flow":"g0","peer":"slice1-h0","bytes":4194304}
 //   {"op":"record_transfer","flow":"g0","bytes":1048576}
 //   {"op":"release_flow","flow":"g0"}
+//   {"op":"data_port"}
+//   {"op":"send","host":"10.0.0.2","port":"7474","flow":"g0","bytes":N}
 //   {"op":"stats"}
 // Responses: {"ok":true,...} or {"ok":false,"error":"..."}.
 //
+// Data plane: a TCP listener (--data_port, 0 = ephemeral) receives
+// framed transfers from peer daemons into the registered flow's staging
+// buffer — the in-repo stand-in for the devmem-TCP RX datapath rxdm
+// programs on GPUs; over real DCN the frames ride the inter-pod fabric.
+// Frame: "DXF1" magic, u32 LE flow-name length, u64 LE payload length,
+// then the name and payload.  The "send" control op streams a flow's
+// staging buffer to a peer daemon and reports achieved throughput.
+//
 // Build: make native  (g++ -std=c++17, no external deps).
 
+#include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <poll.h>
 #include <signal.h>
 #include <stdarg.h>
+#include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/time.h>
 #include <sys/un.h>
+#include <time.h>
 #include <unistd.h>
 
 #include <map>
@@ -152,8 +168,19 @@ struct Flow {
   int owner_fd = -1;
   size_t buffer_bytes = 0;
   void* buffer = nullptr;
-  unsigned long long transferred = 0;
+  unsigned long long transferred = 0;  // bytes sent / recorded by owner
+  unsigned long long rx_bytes = 0;     // bytes landed via the data plane
 };
+
+// Data-plane frame header: magic + flow-name length + payload length.
+constexpr char kFrameMagic[4] = {'D', 'X', 'F', '1'};
+constexpr size_t kFrameHdrLen = 16;  // 4 magic + 4 name_len + 8 payload_len
+
+unsigned long long NowMicros() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (unsigned long long)ts.tv_sec * 1000000ull + ts.tv_nsec / 1000;
+}
 
 class Daemon {
  public:
@@ -164,13 +191,38 @@ class Daemon {
     auto it = req.find("op");
     if (it == req.end()) return Err("missing op");
     const std::string& op = it->second;
-    if (op == "version") return Ok("\"version\":\"dcnxferd/1.0\"");
+    if (op == "version") return Ok("\"version\":\"dcnxferd/1.1\"");
     if (op == "ping") return Ok("");
     if (op == "register_flow") return RegisterFlow(fd, req);
     if (op == "record_transfer") return RecordTransfer(fd, req);
     if (op == "release_flow") return ReleaseFlow(fd, req);
+    if (op == "data_port") return DataPort();
+    if (op == "send") return Send(fd, req);
     if (op == "stats") return Stats();
     return Err("unknown op '" + op + "'");
+  }
+
+  void set_data_port(int port) { data_port_ = port; }
+
+  // Data-plane landing: account a received chunk against its flow (or
+  // the unmatched counter when no local flow has that name).
+  void RecordRx(const std::string& flow, size_t n) {
+    total_rx_ += n;
+    auto it = flows_.find(flow);
+    if (it != flows_.end()) {
+      it->second.rx_bytes += n;
+    } else {
+      rx_unmatched_ += n;
+    }
+  }
+
+  // Staging buffer a data connection lands payloads into; null when the
+  // flow is unknown (payload is then drained and only counted).
+  char* RxBuffer(const std::string& flow, size_t* cap) {
+    auto it = flows_.find(flow);
+    if (it == flows_.end()) return nullptr;
+    *cap = it->second.buffer_bytes;
+    return (char*)it->second.buffer;
   }
 
   void ReleaseClient(int fd) {
@@ -299,27 +351,134 @@ class Daemon {
     return Ok("");
   }
 
+  std::string DataPort() {
+    if (data_port_ < 0) return Err("data plane disabled");
+    char extra[48];
+    snprintf(extra, sizeof(extra), "\"port\":%d", data_port_);
+    return Ok(extra);
+  }
+
+  // Stream a flow's staging buffer to a peer daemon's data port.  This
+  // blocks the control loop for the duration of the transfer (bounded by
+  // SO_SNDTIMEO); benchmark-issued sends are the expected caller, matching
+  // the reference rig where nccl-tests drives the datapath directly.
+  std::string Send(int fd, const std::map<std::string, std::string>& req) {
+    auto fit = req.find("flow");
+    if (fit == req.end()) return Err("send needs 'flow'");
+    auto it = flows_.find(fit->second);
+    if (it == flows_.end())
+      return Err("unknown flow '" + JsonEscape(fit->second) + "'");
+    if (it->second.owner_fd != fd) return Err("flow owned by another client");
+    auto hit = req.find("host");
+    if (hit == req.end() || hit->second.empty())
+      return Err("send needs 'host'");
+    auto pit = req.find("port");
+    if (pit == req.end()) return Err("send needs 'port'");
+    int port = atoi(pit->second.c_str());
+    if (port <= 0 || port > 65535) return Err("invalid 'port'");
+
+    unsigned long long nbytes = it->second.buffer_bytes;
+    auto bit = req.find("bytes");
+    if (bit != req.end()) {
+      if (bit->second.empty() || !isdigit((unsigned char)bit->second[0]))
+        return Err("invalid 'bytes'");
+      char* end = nullptr;
+      nbytes = strtoull(bit->second.c_str(), &end, 10);
+      if (end == bit->second.c_str() || *end != '\0' || nbytes == 0 ||
+          nbytes > (1ull << 40))
+        return Err("invalid 'bytes'");
+    }
+
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    if (inet_pton(AF_INET, hit->second.c_str(), &addr.sin_addr) != 1)
+      return Err("invalid 'host' (IPv4 literal required)");
+
+    int sfd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+    if (sfd < 0) return Err(std::string("socket: ") + strerror(errno));
+    timeval tv{30, 0};
+    setsockopt(sfd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    int one = 1;
+    setsockopt(sfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (connect(sfd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      std::string e = strerror(errno);
+      close(sfd);
+      return Err("connect: " + e);
+    }
+
+    // Frame header.
+    const std::string& name = it->second.name;
+    char hdr[kFrameHdrLen];
+    memcpy(hdr, kFrameMagic, 4);
+    uint32_t nl = (uint32_t)name.size();
+    uint64_t pl = nbytes;
+    memcpy(hdr + 4, &nl, 4);
+    memcpy(hdr + 8, &pl, 8);
+    unsigned long long t0 = NowMicros();
+    bool okay = WriteAll(sfd, hdr, sizeof(hdr)) &&
+                WriteAll(sfd, name.data(), name.size());
+    // Payload: the staging buffer, repeated to cover nbytes.
+    unsigned long long left = nbytes;
+    const char* buf = (const char*)it->second.buffer;
+    size_t cap = it->second.buffer_bytes;
+    while (okay && left > 0) {
+      size_t chunk = (size_t)(left < cap ? left : cap);
+      okay = WriteAll(sfd, buf, chunk);
+      left -= chunk;
+    }
+    close(sfd);
+    if (!okay) return Err("send failed mid-stream");
+    unsigned long long micros = NowMicros() - t0;
+    if (micros == 0) micros = 1;
+    it->second.transferred += nbytes;
+    total_transferred_ += nbytes;
+    double gbps = (double)nbytes / 1e9 / ((double)micros / 1e6);
+    char extra[160];
+    snprintf(extra, sizeof(extra),
+             "\"bytes\":%llu,\"micros\":%llu,\"gbps\":%.3f", nbytes, micros,
+             gbps);
+    return Ok(extra);
+  }
+
   std::string Stats() {
     std::string detail = "[";
     bool first = true;
     for (const auto& kv : flows_) {
-      char item[320];  // names are <=64 chars (IsValidName), so this fits
+      char item[384];  // names are <=64 chars (IsValidName), so this fits
       snprintf(item, sizeof(item),
                "%s{\"flow\":\"%s\",\"peer\":\"%s\",\"buffer_bytes\":%zu,"
-               "\"transferred\":%llu}",
+               "\"transferred\":%llu,\"rx_bytes\":%llu}",
                first ? "" : ",", kv.second.name.c_str(),
                kv.second.peer.c_str(), kv.second.buffer_bytes,
-               kv.second.transferred);
+               kv.second.transferred, kv.second.rx_bytes);
       detail += item;
       first = false;
     }
     detail += "]";
-    char extra[256];
+    char extra[320];
     snprintf(extra, sizeof(extra),
              "\"pool_bytes\":%zu,\"pool_used\":%zu,\"active_flows\":%zu,"
-             "\"total_transferred\":%llu,\"flows\":",
-             pool_bytes_, pool_used_, flows_.size(), total_transferred_);
+             "\"total_transferred\":%llu,\"total_rx\":%llu,"
+             "\"rx_unmatched\":%llu,\"flows\":",
+             pool_bytes_, pool_used_, flows_.size(), total_transferred_,
+             total_rx_, rx_unmatched_);
     return Ok(extra + detail);
+  }
+
+  static bool WriteAll(int fd, const void* data, size_t n) {
+    const char* p = (const char*)data;
+    while (n > 0) {
+      ssize_t put = write(fd, p, n);
+      if (put < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      if (put == 0) return false;
+      p += put;
+      n -= (size_t)put;
+    }
+    return true;
   }
 
   void FreeFlow(Flow* f) {
@@ -334,7 +493,10 @@ class Daemon {
   size_t pool_bytes_;
   size_t max_flows_;
   size_t pool_used_ = 0;
+  int data_port_ = -1;
   unsigned long long total_transferred_ = 0;
+  unsigned long long total_rx_ = 0;
+  unsigned long long rx_unmatched_ = 0;
   std::map<std::string, Flow> flows_;
 };
 
@@ -368,6 +530,107 @@ bool FlushClient(Client* c) {
   return true;
 }
 
+// A peer-daemon data connection, advanced incrementally by the poll
+// loop: header -> flow name -> payload (landed into the flow's staging
+// buffer), then back to header for the next frame.
+struct DataConn {
+  int fd;
+  enum { HDR, NAME, PAYLOAD } state = HDR;
+  std::string acc;                 // header/name accumulator
+  uint32_t name_len = 0;
+  unsigned long long remaining = 0;
+  std::string flow;
+  unsigned long long t0 = 0;       // frame start (throughput log)
+};
+
+// Advance one data connection; returns false when it should be closed.
+bool PumpDataConn(DataConn* dc, Daemon* daemon) {
+  char tmp[64 << 10];
+  for (;;) {
+    if (dc->state == DataConn::PAYLOAD) {
+      size_t cap = 0;
+      char* flow_buf = daemon->RxBuffer(dc->flow, &cap);
+      size_t want = sizeof(tmp);
+      char* dst = tmp;
+      if (flow_buf && cap > 0) {
+        dst = flow_buf;
+        want = cap;
+      }
+      if ((unsigned long long)want > dc->remaining)
+        want = (size_t)dc->remaining;
+      ssize_t got = read(dc->fd, dst, want);
+      if (got < 0)
+        return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+      if (got == 0) return false;
+      daemon->RecordRx(dc->flow, (size_t)got);
+      dc->remaining -= (unsigned long long)got;
+      if (dc->remaining == 0) {
+        unsigned long long micros = NowMicros() - dc->t0;
+        logf(1, "frame complete: flow '%s' in %llu us", dc->flow.c_str(),
+             micros ? micros : 1);
+        dc->state = DataConn::HDR;
+        dc->acc.clear();
+      }
+      continue;
+    }
+    // Header / name bytes.
+    size_t need = (dc->state == DataConn::HDR)
+                      ? kFrameHdrLen - dc->acc.size()
+                      : dc->name_len - dc->acc.size();
+    if (need == 0 && dc->state == DataConn::NAME) {
+      dc->flow = dc->acc;
+      dc->acc.clear();
+      dc->state = DataConn::PAYLOAD;
+      continue;
+    }
+    ssize_t got = read(dc->fd, tmp, need < sizeof(tmp) ? need : sizeof(tmp));
+    if (got < 0)
+      return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+    if (got == 0) return false;
+    dc->acc.append(tmp, (size_t)got);
+    if (dc->state == DataConn::HDR && dc->acc.size() == kFrameHdrLen) {
+      if (memcmp(dc->acc.data(), kFrameMagic, 4) != 0) {
+        logf(0, "data conn fd %d: bad frame magic", dc->fd);
+        return false;
+      }
+      memcpy(&dc->name_len, dc->acc.data() + 4, 4);
+      memcpy(&dc->remaining, dc->acc.data() + 8, 8);
+      if (dc->name_len == 0 || dc->name_len > kMaxNameLen ||
+          dc->remaining > (1ull << 40)) {
+        logf(0, "data conn fd %d: bad frame header", dc->fd);
+        return false;
+      }
+      dc->acc.clear();
+      dc->state = DataConn::NAME;
+      dc->t0 = NowMicros();
+    }
+  }
+}
+
+int MakeTcpListener(int port, int* bound_port) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) {
+    perror("tcp socket");
+    return -1;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    perror("tcp bind/listen");
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  if (getsockname(fd, (sockaddr*)&addr, &alen) == 0)
+    *bound_port = ntohs(addr.sin_port);
+  return fd;
+}
+
 int MakeListener(const std::string& sock_path) {
   int fd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
   if (fd < 0) {
@@ -397,12 +660,22 @@ int MakeListener(const std::string& sock_path) {
   return fd;
 }
 
-int Serve(const std::string& sock_path, Daemon* daemon) {
+int Serve(const std::string& sock_path, Daemon* daemon, int data_port) {
   int listener = MakeListener(sock_path);
   if (listener < 0) return 1;
   logf(0, "listening on %s", sock_path.c_str());
 
+  int tcp_listener = -1;
+  if (data_port >= 0) {
+    int bound = -1;
+    tcp_listener = MakeTcpListener(data_port, &bound);
+    if (tcp_listener < 0) return 1;
+    daemon->set_data_port(bound);
+    logf(0, "data plane listening on tcp :%d", bound);
+  }
+
   std::vector<Client> clients;
+  std::vector<DataConn> dconns;
   while (!g_stop) {
     std::vector<pollfd> fds;
     fds.push_back({listener, POLLIN, 0});
@@ -411,15 +684,51 @@ int Serve(const std::string& sock_path, Daemon* daemon) {
       if (!c.outbuf.empty()) events |= POLLOUT;
       fds.push_back({c.fd, events, 0});
     }
+    // Data-plane fds trail the control fds; their revents are handled
+    // after the control clients below.
+    size_t data_base = fds.size();
+    if (tcp_listener >= 0) fds.push_back({tcp_listener, POLLIN, 0});
+    for (const auto& dc : dconns) fds.push_back({dc.fd, POLLIN, 0});
     int n = poll(fds.data(), fds.size(), 500);
     if (n < 0) {
       if (errno == EINTR) continue;
       perror("poll");
       break;
     }
+    // Data plane first: its pollfd indices are invalidated by the
+    // control-client erase logic below.
+    if (tcp_listener >= 0) {
+      if (fds[data_base].revents & POLLIN) {
+        int dfd = accept4(tcp_listener, nullptr, nullptr,
+                          SOCK_CLOEXEC | SOCK_NONBLOCK);
+        if (dfd >= 0) {
+          DataConn dc;
+          dc.fd = dfd;
+          dconns.push_back(dc);
+          logf(1, "data conn fd %d connected", dfd);
+        }
+      }
+      size_t dpolled = fds.size() - (data_base + 1);
+      for (size_t di = 0; di < dpolled;) {
+        pollfd& p = fds[data_base + 1 + di];
+        bool drop = false;
+        if (p.revents & (POLLIN | POLLHUP | POLLERR)) {
+          if (!PumpDataConn(&dconns[di], daemon)) drop = true;
+        }
+        if (drop) {
+          logf(1, "data conn fd %d closed", dconns[di].fd);
+          close(dconns[di].fd);
+          dconns.erase(dconns.begin() + di);
+          fds.erase(fds.begin() + data_base + 1 + di);
+          dpolled--;
+        } else {
+          ++di;
+        }
+      }
+    }
     // Only the clients present when poll() ran have valid revents; a
     // freshly-accepted client is picked up on the next loop iteration.
-    size_t polled = fds.size() - 1;
+    size_t polled = data_base - 1;
     for (size_t ci = 0; ci < polled;) {
       Client& c = clients[ci];
       pollfd& p = fds[1 + ci];
@@ -477,6 +786,8 @@ int Serve(const std::string& sock_path, Daemon* daemon) {
     daemon->ReleaseClient(c.fd);
     close(c.fd);
   }
+  for (auto& dc : dconns) close(dc.fd);
+  if (tcp_listener >= 0) close(tcp_listener);
   close(listener);
   unlink(sock_path.c_str());
   logf(0, "shut down");
@@ -489,6 +800,7 @@ int main(int argc, char** argv) {
   std::string uds_path = "/run/tpu-dcn";
   size_t pool_bytes = 256ull << 20;
   size_t max_flows = 256;
+  int data_port = 0;  // 0 = ephemeral; -1 disables the data plane
 
   for (int i = 1; i < argc; i++) {
     std::string arg = argv[i];
@@ -504,12 +816,15 @@ int main(int argc, char** argv) {
     } else if (arg == "--max_flows" || arg == "--max-flows") {
       const char* v = next();
       if (v) max_flows = strtoull(v, nullptr, 10);
+    } else if (arg == "--data_port" || arg == "--data-port") {
+      const char* v = next();
+      if (v) data_port = atoi(v);
     } else if (arg == "--verbose" || arg == "-v") {
       const char* v = next();
       if (v) g_verbose = atoi(v);
     } else if (arg == "--help" || arg == "-h") {
       printf("usage: dcnxferd [--uds_path DIR] [--pool_bytes N] "
-             "[--max_flows N] [--verbose LEVEL]\n");
+             "[--max_flows N] [--data_port P|-1] [--verbose LEVEL]\n");
       return 0;
     } else {
       fprintf(stderr, "dcnxferd: unknown flag %s\n", arg.c_str());
@@ -523,5 +838,5 @@ int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
 
   Daemon daemon(pool_bytes, max_flows);
-  return Serve(uds_path + "/xferd.sock", &daemon);
+  return Serve(uds_path + "/xferd.sock", &daemon, data_port);
 }

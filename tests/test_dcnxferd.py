@@ -46,7 +46,7 @@ def daemon(tmp_path):
 
 def test_version_and_ping(daemon):
     with DcnXferClient(daemon) as c:
-        assert c.version() == "dcnxferd/1.0"
+        assert c.version() == "dcnxferd/1.1"
         c.ping()
 
 
@@ -136,3 +136,95 @@ def test_bad_json_and_unknown_op(daemon):
     sock.sendall(b'{"op":"frobnicate"}\n')
     assert "unknown op" in f.readline()
     sock.close()
+
+
+@pytest.fixture
+def daemon_pair(tmp_path):
+    """Two daemons on one host — the two-node DCN data-plane rig."""
+    procs, dirs = [], []
+    for name in ("a", "b"):
+        uds = str(tmp_path / f"dcn-{name}")
+        proc = subprocess.Popen(
+            [BIN, "--uds_path", uds, "--pool_bytes", str(16 << 20),
+             "--max_flows", "4", "--data_port", "0", "--verbose", "2"],
+            stderr=subprocess.PIPE, text=True,
+        )
+        procs.append(proc)
+        dirs.append(uds)
+    for proc, uds in zip(procs, dirs):
+        sock_path = os.path.join(uds, "xferd.sock")
+        deadline = time.time() + 10
+        while not os.path.exists(sock_path):
+            assert proc.poll() is None, proc.stderr.read()
+            assert time.time() < deadline
+            time.sleep(0.02)
+    yield dirs
+    for proc in procs:
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=10)
+
+
+class TestDataPlane:
+    """Cross-daemon TCP transfers (the rxdm RX-datapath analog)."""
+
+    def test_data_port_reported(self, daemon_pair):
+        with DcnXferClient(daemon_pair[0]) as c:
+            assert 0 < c.data_port() < 65536
+
+    def test_send_lands_in_peer_flow(self, daemon_pair):
+        uds_a, uds_b = daemon_pair
+        nbytes = 6 << 20
+        with DcnXferClient(uds_a) as a, DcnXferClient(uds_b) as b:
+            a.register_flow("g0", peer="b", bytes=1 << 20)
+            b.register_flow("g0", peer="a", bytes=1 << 20)
+            port = b.data_port()
+            res = a.send("g0", "127.0.0.1", port, nbytes)
+            assert res["bytes"] == nbytes
+            assert res["gbps"] > 0
+
+            # Receive side accounts asynchronously; poll for arrival.
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                stats = b.stats()
+                if stats["total_rx"] >= nbytes:
+                    break
+                time.sleep(0.05)
+            assert stats["total_rx"] == nbytes
+            flow = next(f for f in stats["flows"] if f["flow"] == "g0")
+            assert flow["rx_bytes"] == nbytes
+            assert stats["rx_unmatched"] == 0
+            # Sender accounted the transfer on its own flow too.
+            a_flow = next(f for f in a.stats()["flows"] if f["flow"] == "g0")
+            assert a_flow["transferred"] == nbytes
+
+    def test_send_to_unregistered_peer_flow_counts_unmatched(
+            self, daemon_pair):
+        uds_a, uds_b = daemon_pair
+        with DcnXferClient(uds_a) as a, DcnXferClient(uds_b) as b:
+            a.register_flow("lonely", bytes=1 << 20)
+            port = b.data_port()
+            a.send("lonely", "127.0.0.1", port, 1 << 20)
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                stats = b.stats()
+                if stats["rx_unmatched"] >= (1 << 20):
+                    break
+                time.sleep(0.05)
+            assert stats["rx_unmatched"] == 1 << 20
+
+    def test_send_unknown_flow_rejected(self, daemon_pair):
+        with DcnXferClient(daemon_pair[0]) as a:
+            with pytest.raises(DcnXferError, match="unknown flow"):
+                a.send("nope", "127.0.0.1", 1, 1)
+
+    def test_send_connect_refused_reported(self, daemon_pair):
+        with DcnXferClient(daemon_pair[0]) as a:
+            a.register_flow("g1", bytes=1 << 20)
+            with pytest.raises(DcnXferError, match="connect"):
+                a.send("g1", "127.0.0.1", 1, 1 << 20)
+
+    def test_default_data_port_is_ephemeral(self, daemon):
+        # The plain fixture passes no --data_port; the default (0) binds
+        # an ephemeral port rather than disabling the data plane.
+        with DcnXferClient(daemon) as c:
+            assert 0 < c.data_port() < 65536
