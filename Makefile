@@ -5,13 +5,21 @@ PY := python3
 NATIVE_BUILD := native/tpushim/build
 DCNXFERD_BUILD := native/dcnxferd/build
 DCNFASTSOCK_BUILD := native/dcnfastsock/build
+DCNCOLLPERF_BUILD := native/dcncollperf/build
 
 .PHONY: all native test presubmit proto clean
 
 all: native
 
 native: $(NATIVE_BUILD)/libtpushim.so $(DCNXFERD_BUILD)/dcnxferd \
-	$(DCNFASTSOCK_BUILD)/libdcnfastsock.so
+	$(DCNFASTSOCK_BUILD)/libdcnfastsock.so \
+	$(DCNCOLLPERF_BUILD)/dcn_collectives_perf
+
+$(DCNCOLLPERF_BUILD)/dcn_collectives_perf: native/dcncollperf/dcn_collectives_perf.cc
+	mkdir -p $(DCNCOLLPERF_BUILD)
+	g++ -std=c++17 -O2 -Wall -Wextra \
+	    -o $(DCNCOLLPERF_BUILD)/dcn_collectives_perf \
+	    native/dcncollperf/dcn_collectives_perf.cc
 
 $(DCNFASTSOCK_BUILD)/libdcnfastsock.so: native/dcnfastsock/dcnfastsock.cc
 	mkdir -p $(DCNFASTSOCK_BUILD)
@@ -92,4 +100,5 @@ proto:
 	    protos/ttrpc/ttrpc.proto
 
 clean:
-	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD) $(DCNFASTSOCK_BUILD)
+	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD) $(DCNFASTSOCK_BUILD) \
+	    $(DCNCOLLPERF_BUILD) $(ASAN_BUILD)

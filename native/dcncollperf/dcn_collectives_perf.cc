@@ -1,0 +1,432 @@
+// dcn_collectives_perf — native DCN collective-bandwidth benchmark.
+//
+// TPU-native analog of nccl-tests' all_gather_perf/all_reduce_perf (SURVEY.md
+// §2.2; ref: gpudirect-tcpxo/nccl-test.yaml:62 runs `all_gather_perf` via MPI;
+// gpudirect-tcpx/nccl-config.yaml:60-63 sweeps 1M→512M, ×2/step, 100 iters,
+// warmup 5, -c 0).  In-slice collectives ride ICI through XLA and are
+// benchmarked by the JAX sweep (container_engine_accelerators_tpu/collectives/
+// bench.py); this binary benchmarks the *DCN* path — the cross-slice fabric
+// the reference drives with NCCL+TCPX — with a ring algorithm over TCP
+// sockets, so `LD_PRELOAD=libdcnfastsock.so` tuning applies to it exactly the
+// way the fast-socket plugin applies to nccl-tests.
+//
+// CLI (nccl-tests semantics):
+//   dcn_collectives_perf --op all_reduce|all_gather
+//     --rank R --hosts h0:p0,h1:p1,...   (rank r binds hosts[r], ring order)
+//     [-b 1M] [-e 512M] [-f 2] [-n 100] [-w 5] [-c 0|1]
+//
+// Ring wiring: rank r accepts one connection from rank r-1 and connects to
+// rank r+1 (mod N) with retry, so start order doesn't matter.  All ranks
+// print the nccl-tests-style table (size, count, time, algbw, busbw, #wrong);
+// rank 0 also prints one machine-readable JSON summary line at the end (the
+// shape the xla-collectives rigs emit for the driver).
+//
+// Bus-bandwidth factors match nccl-tests' definitions:
+//   all_reduce: busbw = algbw * 2*(N-1)/N      (size = per-rank buffer)
+//   all_gather: busbw = algbw * (N-1)/N        (size = total output buffer)
+//
+// Build: make native  (g++ -std=c++17, no external deps).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <string>
+#include <vector>
+
+namespace {
+
+double NowSec() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+[[noreturn]] void Die(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "dcn_collectives_perf: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(1);
+}
+
+// ---- size parsing (nccl-tests accepts 1M / 512M / 1G) ----------------------
+
+int64_t ParseBytes(const char* s) {
+  char* end = nullptr;
+  double v = strtod(s, &end);
+  if (end == s) Die("bad size %s", s);
+  switch (*end) {
+    case 'G': case 'g': v *= 1 << 30; break;
+    case 'M': case 'm': v *= 1 << 20; break;
+    case 'K': case 'k': v *= 1 << 10; break;
+    case '\0': break;
+    default: Die("bad size suffix in %s", s);
+  }
+  return static_cast<int64_t>(v);
+}
+
+// ---- ring wiring -----------------------------------------------------------
+
+struct HostPort {
+  std::string host;
+  int port;
+};
+
+std::vector<HostPort> ParseHosts(const std::string& arg) {
+  std::vector<HostPort> out;
+  size_t pos = 0;
+  while (pos < arg.size()) {
+    size_t comma = arg.find(',', pos);
+    if (comma == std::string::npos) comma = arg.size();
+    std::string item = arg.substr(pos, comma - pos);
+    size_t colon = item.rfind(':');
+    if (colon == std::string::npos) Die("bad host:port %s", item.c_str());
+    out.push_back({item.substr(0, colon), atoi(item.c_str() + colon + 1)});
+    pos = comma + 1;
+  }
+  return out;
+}
+
+void SetSockOpts(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+int ListenOn(const HostPort& hp) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) Die("socket: %s", strerror(errno));
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(hp.port));
+  addr.sin_addr.s_addr = INADDR_ANY;  // bind all: pod IP vs localhost
+  if (bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) < 0)
+    Die("bind %d: %s", hp.port, strerror(errno));
+  if (listen(fd, 1) < 0) Die("listen: %s", strerror(errno));
+  return fd;
+}
+
+int ConnectTo(const HostPort& hp, double timeout_sec) {
+  double deadline = NowSec() + timeout_sec;
+  for (;;) {
+    struct addrinfo hints, *res = nullptr;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    char portstr[16];
+    snprintf(portstr, sizeof(portstr), "%d", hp.port);
+    if (getaddrinfo(hp.host.c_str(), portstr, &hints, &res) == 0 && res) {
+      int fd = socket(AF_INET, SOCK_STREAM, 0);
+      if (fd >= 0 &&
+          connect(fd, res->ai_addr, res->ai_addrlen) == 0) {
+        freeaddrinfo(res);
+        SetSockOpts(fd);
+        return fd;
+      }
+      if (fd >= 0) close(fd);
+    }
+    if (res) freeaddrinfo(res);
+    if (NowSec() > deadline)
+      Die("connect %s:%d timed out", hp.host.c_str(), hp.port);
+    usleep(100 * 1000);
+  }
+}
+
+// ---- full-duplex progress engine -------------------------------------------
+// Every ring step sends one chunk to next while receiving one from prev.  A
+// blocking send of a chunk larger than the socket buffer would deadlock the
+// ring (all ranks blocked in send(), nobody draining), so both directions are
+// progressed from one poll() loop over nonblocking sockets.
+
+void SetNonBlocking(int fd, bool on) {
+  int flags = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, on ? (flags | O_NONBLOCK) : (flags & ~O_NONBLOCK));
+}
+
+void SendRecv(int send_fd, const char* send_buf, size_t send_len,
+              int recv_fd, char* recv_buf, size_t recv_len) {
+  size_t sent = 0, rcvd = 0;
+  int stalls = 0;
+  while (sent < send_len || rcvd < recv_len) {
+    struct pollfd pfds[2];
+    int n = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sent < send_len) {
+      pfds[n] = {send_fd, POLLOUT, 0};
+      send_idx = n++;
+    }
+    if (rcvd < recv_len) {
+      pfds[n] = {recv_fd, POLLIN, 0};
+      recv_idx = n++;
+    }
+    int ready = poll(pfds, n, 10000);
+    if (ready < 0) {
+      if (errno == EINTR) continue;
+      Die("poll: %s", strerror(errno));
+    }
+    if (ready == 0) {
+      // A stalled peer (partition without RST, paused pod) must fail the
+      // benchmark, not wedge the Job forever.
+      if (++stalls >= 6) Die("peer stalled for 60s mid-collective");
+      continue;
+    }
+    stalls = 0;
+    if (send_idx >= 0 && (pfds[send_idx].revents & (POLLOUT | POLLERR))) {
+      ssize_t k = send(send_fd, send_buf + sent, send_len - sent,
+                       MSG_NOSIGNAL);
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+        Die("send: %s", strerror(errno));
+      if (k > 0) sent += static_cast<size_t>(k);
+    }
+    if (recv_idx >= 0 &&
+        (pfds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = recv(recv_fd, recv_buf + rcvd, recv_len - rcvd, 0);
+      if (k == 0) Die("peer closed mid-collective");
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+        Die("recv: %s", strerror(errno));
+      if (k > 0) rcvd += static_cast<size_t>(k);
+    }
+  }
+}
+
+// ---- collectives -----------------------------------------------------------
+// Chunk layout: the element buffer is split into nranks equal chunks (counts
+// padded so nelem % nranks == 0 is guaranteed by the sweep generator).
+
+struct Ring {
+  int rank = 0;
+  int nranks = 0;
+  int next_fd = -1;  // we send to rank+1
+  int prev_fd = -1;  // we receive from rank-1
+};
+
+// Ring all-reduce (sum, float32): N-1 reduce-scatter steps then N-1
+// all-gather steps.  data holds nelem floats in place.
+void RingAllReduce(const Ring& ring, float* data, size_t nelem,
+                   std::vector<float>* scratch) {
+  int n = ring.nranks;
+  size_t chunk = nelem / n;
+  scratch->resize(chunk);
+  // Reduce-scatter: in step s, send chunk (rank - s) and receive + accumulate
+  // chunk (rank - s - 1).
+  for (int s = 0; s < n - 1; ++s) {
+    int send_c = ((ring.rank - s) % n + n) % n;
+    int recv_c = ((ring.rank - s - 1) % n + n) % n;
+    SendRecv(ring.next_fd,
+             reinterpret_cast<const char*>(data + send_c * chunk),
+             chunk * sizeof(float), ring.prev_fd,
+             reinterpret_cast<char*>(scratch->data()),
+             chunk * sizeof(float));
+    float* dst = data + recv_c * chunk;
+    const float* src = scratch->data();
+    for (size_t i = 0; i < chunk; ++i) dst[i] += src[i];
+  }
+  // All-gather the reduced chunks: in step s, send chunk (rank + 1 - s) and
+  // receive chunk (rank - s).
+  for (int s = 0; s < n - 1; ++s) {
+    int send_c = ((ring.rank + 1 - s) % n + n) % n;
+    int recv_c = ((ring.rank - s) % n + n) % n;
+    SendRecv(ring.next_fd,
+             reinterpret_cast<const char*>(data + send_c * chunk),
+             chunk * sizeof(float), ring.prev_fd,
+             reinterpret_cast<char*>(data + recv_c * chunk),
+             chunk * sizeof(float));
+  }
+}
+
+// Ring all-gather: rank r owns chunk r on entry; N-1 forwarding steps.
+void RingAllGather(const Ring& ring, float* data, size_t nelem) {
+  int n = ring.nranks;
+  size_t chunk = nelem / n;
+  for (int s = 0; s < n - 1; ++s) {
+    int send_c = ((ring.rank - s) % n + n) % n;
+    int recv_c = ((ring.rank - s - 1) % n + n) % n;
+    SendRecv(ring.next_fd,
+             reinterpret_cast<const char*>(data + send_c * chunk),
+             chunk * sizeof(float), ring.prev_fd,
+             reinterpret_cast<char*>(data + recv_c * chunk),
+             chunk * sizeof(float));
+  }
+}
+
+// Any-payload barrier so timing starts aligned: one-byte token around the
+// ring twice (the second lap guarantees everyone saw the first).
+void RingBarrier(const Ring& ring) {
+  char t = 0;
+  for (int lap = 0; lap < 2; ++lap)
+    SendRecv(ring.next_fd, &t, 1, ring.prev_fd, &t, 1);
+}
+
+float Pattern(int rank, size_t i) {
+  // Small integers: float32 summation over ranks stays exact.
+  return static_cast<float>((rank + 1) * ((i % 97) + 1) % 1013);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string op = "all_reduce";
+  int64_t minbytes = 1 << 20, maxbytes = 512 << 20;
+  int factor = 2, iters = 100, warmup = 5, check = 0;
+  int rank = -1;
+  std::string hosts_arg;
+  double connect_timeout = 60.0;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* {
+      if (i + 1 >= argc) Die("missing value for %s", a.c_str());
+      return argv[++i];
+    };
+    if (a == "--op") op = next();
+    else if (a == "-b" || a == "--minbytes") minbytes = ParseBytes(next());
+    else if (a == "-e" || a == "--maxbytes") maxbytes = ParseBytes(next());
+    else if (a == "-f" || a == "--stepfactor") factor = atoi(next());
+    else if (a == "-n" || a == "--iters") iters = atoi(next());
+    else if (a == "-w" || a == "--warmup_iters") warmup = atoi(next());
+    else if (a == "-c" || a == "--check") check = atoi(next());
+    else if (a == "--rank") rank = atoi(next());
+    else if (a == "--hosts") hosts_arg = next();
+    else if (a == "--connect_timeout") connect_timeout = atof(next());
+    else Die("unknown flag %s", a.c_str());
+  }
+  if (op != "all_reduce" && op != "all_gather")
+    Die("--op must be all_reduce or all_gather");
+  if (rank < 0 || hosts_arg.empty()) Die("--rank and --hosts are required");
+  std::vector<HostPort> hosts = ParseHosts(hosts_arg);
+  int nranks = static_cast<int>(hosts.size());
+  if (nranks < 2) Die("need >= 2 ranks");
+  if (rank >= nranks) Die("--rank %d out of range", rank);
+  if (minbytes <= 0 || maxbytes <= 0 || minbytes > maxbytes)
+    Die("need 0 < minbytes <= maxbytes (got -b %ld -e %ld)",
+        static_cast<long>(minbytes), static_cast<long>(maxbytes));
+  if (iters < 1 || warmup < 0) Die("need -n >= 1 and -w >= 0");
+  if (factor < 2) factor = 2;
+
+  signal(SIGPIPE, SIG_IGN);
+
+  // Wire the ring: listen first, then connect to next with retry, then
+  // accept from prev — no start-order requirement.
+  Ring ring;
+  ring.rank = rank;
+  ring.nranks = nranks;
+  int lfd = ListenOn(hosts[rank]);
+  ring.next_fd = ConnectTo(hosts[(rank + 1) % nranks], connect_timeout);
+  ring.prev_fd = accept(lfd, nullptr, nullptr);
+  if (ring.prev_fd < 0) Die("accept: %s", strerror(errno));
+  SetSockOpts(ring.prev_fd);
+  close(lfd);
+  SetNonBlocking(ring.next_fd, true);
+  SetNonBlocking(ring.prev_fd, true);
+
+  if (rank == 0) {
+    printf("# dcn_collectives_perf op=%s nranks=%d minbytes=%ld "
+           "maxbytes=%ld factor=%d iters=%d warmup=%d check=%d\n",
+           op.c_str(), nranks, static_cast<long>(minbytes),
+           static_cast<long>(maxbytes), factor, iters, warmup, check);
+    printf("# %12s %12s %8s %12s %10s %10s %8s\n", "size(B)", "count",
+           "type", "time(us)", "algbw(GB/s)", "busbw(GB/s)", "#wrong");
+  }
+
+  double max_busbw = 0, sum_busbw = 0;
+  int rows = 0;
+  std::vector<float> scratch;
+  for (int64_t size = minbytes; size <= maxbytes; size *= factor) {
+    // nelem divisible by nranks so chunks are equal (nccl-tests rounds the
+    // same way); "size" follows nccl-tests conventions per op.
+    size_t nelem =
+        (static_cast<size_t>(size) / sizeof(float) / nranks) * nranks;
+    if (nelem == 0) continue;
+    std::vector<float> data(nelem);
+    size_t chunk = nelem / nranks;
+
+    auto reset = [&]() {
+      if (op == "all_reduce") {
+        for (size_t i = 0; i < nelem; ++i) data[i] = Pattern(rank, i);
+      } else {
+        // all-gather input: only our chunk is defined.
+        for (size_t i = 0; i < chunk; ++i)
+          data[rank * chunk + i] = Pattern(rank, i);
+      }
+    };
+    auto run_once = [&]() {
+      if (op == "all_reduce")
+        RingAllReduce(ring, data.data(), nelem, &scratch);
+      else
+        RingAllGather(ring, data.data(), nelem);
+    };
+
+    long wrong = -1;
+    if (check) {
+      reset();
+      run_once();
+      wrong = 0;
+      if (op == "all_reduce") {
+        for (size_t i = 0; i < nelem; ++i) {
+          float want = 0;
+          for (int r = 0; r < nranks; ++r) want += Pattern(r, i);
+          if (data[i] != want) ++wrong;
+        }
+      } else {
+        for (int r = 0; r < nranks; ++r)
+          for (size_t i = 0; i < chunk; ++i)
+            if (data[r * chunk + i] != Pattern(r, i)) ++wrong;
+      }
+    }
+
+    reset();
+    for (int it = 0; it < warmup; ++it) run_once();
+    RingBarrier(ring);
+    double t0 = NowSec();
+    for (int it = 0; it < iters; ++it) run_once();
+    RingBarrier(ring);
+    double dt = (NowSec() - t0) / iters;
+
+    double bytes = static_cast<double>(nelem) * sizeof(float);
+    double algbw = bytes / dt / 1e9;
+    double busbw = op == "all_reduce"
+                       ? algbw * 2.0 * (nranks - 1) / nranks
+                       : algbw * (nranks - 1) / nranks;
+    max_busbw = std::max(max_busbw, busbw);
+    sum_busbw += busbw;
+    ++rows;
+    if (rank == 0) {
+      char wrongs[24];
+      if (wrong < 0) snprintf(wrongs, sizeof(wrongs), "N/A");
+      else snprintf(wrongs, sizeof(wrongs), "%ld", wrong);
+      printf("  %12zu %12zu %8s %12.1f %10.3f %10.3f %8s\n",
+             static_cast<size_t>(bytes), nelem, "float", dt * 1e6, algbw,
+             busbw, wrongs);
+      fflush(stdout);
+    }
+    if (wrong > 0) Die("data check failed: %ld wrong elements", wrong);
+  }
+
+  if (rank == 0 && rows > 0) {
+    printf("{\"metric\": \"dcn_%s_busbw_gbps\", \"value\": %.3f, "
+           "\"unit\": \"GB/s\", \"nranks\": %d, \"avg_busbw_gbps\": %.3f}\n",
+           op.c_str(), max_busbw, nranks, sum_busbw / rows);
+  }
+  close(ring.next_fd);
+  close(ring.prev_fd);
+  return 0;
+}

@@ -1,0 +1,106 @@
+"""dcn_collectives_perf tests: run the real native benchmark binary as a
+multi-rank ring on localhost — the role the nccl-tests pods play against
+`all_gather_perf`/`all_reduce_perf` (SURVEY.md §2.2; ref:
+gpudirect-tcpxo/nccl-test.yaml:62, gpudirect-tcpx/nccl-config.yaml:60-63)."""
+
+import json
+import os
+import socket
+import subprocess
+
+import pytest
+
+BIN = os.path.join(os.path.dirname(__file__), "..",
+                   "native", "dcncollperf", "build", "dcn_collectives_perf")
+BIN = os.environ.get("DCNCOLLPERF_BIN", BIN)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BIN),
+    reason="dcn_collectives_perf not built (run `make native`)",
+)
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _run_ring(op, nranks, extra=()):
+    hosts = ",".join(f"127.0.0.1:{p}" for p in _free_ports(nranks))
+    procs = []
+    for r in range(nranks):
+        procs.append(subprocess.Popen(
+            [BIN, "--op", op, "--rank", str(r), "--hosts", hosts,
+             "-b", "4K", "-e", "64K", "-n", "5", "-w", "1", "-c", "1",
+             "--connect_timeout", "20", *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, f"rank failed: {err}\n{out}"
+        outs.append(out)
+    return outs
+
+
+@pytest.mark.parametrize("op", ["all_reduce", "all_gather"])
+def test_ring_correctness_and_report(op):
+    outs = _run_ring(op, nranks=3)
+    # Rank 0 prints the sweep table with zero wrong elements per row and a
+    # final machine-readable JSON summary line.
+    rank0 = outs[0]
+    rows = [l for l in rank0.splitlines()
+            if l.startswith("  ") and l.strip()[0].isdigit()]
+    assert len(rows) == 5  # 4K..64K x2 per step
+    for row in rows:
+        assert row.split()[-1] == "0"  # #wrong
+    summary = json.loads(rank0.splitlines()[-1])
+    assert summary["metric"] == f"dcn_{op}_busbw_gbps"
+    assert summary["nranks"] == 3
+    assert summary["value"] > 0
+    # Non-root ranks stay quiet (MPI-style single reporter).
+    assert outs[1] == "" and outs[2] == ""
+
+
+def test_two_rank_ring():
+    outs = _run_ring("all_reduce", nranks=2)
+    summary = json.loads(outs[0].splitlines()[-1])
+    assert summary["nranks"] == 2 and summary["value"] > 0
+
+
+def test_rejects_bad_flags():
+    proc = subprocess.run([BIN, "--op", "broadcast"], capture_output=True,
+                          text=True)
+    assert proc.returncode != 0
+    assert "all_reduce or all_gather" in proc.stderr
+
+    proc = subprocess.run([BIN, "--op", "all_reduce"], capture_output=True,
+                          text=True)
+    assert proc.returncode != 0
+    assert "--rank and --hosts" in proc.stderr
+
+
+def test_preload_dcnfastsock_compatible():
+    """The fast-socket analog applies to this benchmark via LD_PRELOAD the
+    way the NCCL fast-socket plugin applies to nccl-tests."""
+    lib = os.path.join(os.path.dirname(__file__), "..", "native",
+                       "dcnfastsock", "build", "libdcnfastsock.so")
+    if not os.path.exists(lib):
+        pytest.skip("libdcnfastsock not built")
+    env = dict(os.environ, LD_PRELOAD=os.path.abspath(lib))
+    hosts = ",".join(f"127.0.0.1:{p}" for p in _free_ports(2))
+    procs = [subprocess.Popen(
+        [BIN, "--op", "all_gather", "--rank", str(r), "--hosts", hosts,
+         "-b", "4K", "-e", "4K", "-n", "2", "-w", "0", "-c", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for r in range(2)]
+    for p in procs:
+        out, err = p.communicate(timeout=60)
+        assert p.returncode == 0, err
