@@ -43,6 +43,14 @@ def parse_args(argv=None):
                    help="tensor-parallel degree of the mesh")
     p.add_argument("--model-dir", default=None,
                    help="directory for final params (flax msgpack)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="orbax checkpoint directory; when set, the newest "
+                        "checkpoint is restored at startup so a rescheduled "
+                        "pod resumes instead of restarting from step 0 "
+                        "(recovery in the reference stack is bare K8s "
+                        "restart semantics, SURVEY.md §5)")
+    p.add_argument("--checkpoint-interval", type=int, default=100,
+                   help="steps between checkpoints (>= 1)")
     p.add_argument("--profile-dir", default=None,
                    help="write an XLA profiler trace of steps 10-20 here "
                         "(the reference's tracing story is glog -v=10 + "
@@ -55,6 +63,8 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
     args = parse_args(argv)
+    if args.checkpoint_interval < 1:
+        raise SystemExit("--checkpoint-interval must be >= 1")
 
     from container_engine_accelerators_tpu.parallel import dcn
 
@@ -99,6 +109,19 @@ def main(argv=None):
     )
     step_fn, state = make_sharded_train_step(mesh, state)
 
+    checkpointer = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from container_engine_accelerators_tpu.models.checkpoint import (
+            TrainCheckpointer,
+        )
+
+        checkpointer = TrainCheckpointer(os.path.abspath(args.checkpoint_dir))
+        state, restored_step = checkpointer.restore_latest(state)
+        if restored_step is not None:
+            start_step = restored_step
+            log.info("resuming from checkpoint at step %d", start_step)
+
     # Synthetic input pipeline: distinct device-resident batches, rotated
     # so execution caches can't short-circuit the step (see bench.py).
     # Multi-host: each process contributes its local shard of the global
@@ -122,14 +145,18 @@ def main(argv=None):
 
     t0 = time.perf_counter()
     metrics = {}
-    for step in range(args.train_steps):
-        if args.profile_dir and step == min(10, args.train_steps - 1):
+    profiling = False
+    for step in range(start_step, args.train_steps):
+        if args.profile_dir and step == max(start_step,
+                                            min(10, args.train_steps - 1)):
             jax.profiler.start_trace(args.profile_dir)
+            profiling = True
         state, metrics = step_fn(state, xs[step % n_batches],
                                  ys[step % n_batches])
-        if args.profile_dir and step == min(20, args.train_steps - 1):
+        if profiling and step >= min(20, args.train_steps - 1):
             jax.block_until_ready(state.params)
             jax.profiler.stop_trace()
+            profiling = False
             log.info("wrote XLA profile to %s", args.profile_dir)
         if (step + 1) % args.steps_per_eval == 0:
             m = jax.device_get(metrics)
@@ -137,13 +164,19 @@ def main(argv=None):
             log.info(
                 "step %d loss=%.4f acc=%.4f images/sec=%.1f",
                 step + 1, float(m["loss"]), float(m["accuracy"]),
-                (step + 1) * args.train_batch_size / dt,
+                (step + 1 - start_step) * args.train_batch_size / dt,
             )
+        if checkpointer and (step + 1) % args.checkpoint_interval == 0:
+            checkpointer.save(state)
     jax.block_until_ready(state.params)
     total = time.perf_counter() - t0
-    log.info("done: %d steps, %.1f images/sec overall",
-             args.train_steps,
-             args.train_steps * args.train_batch_size / total)
+    steps_run = args.train_steps - start_step
+    log.info("done: %d steps, %.1f images/sec overall", steps_run,
+             steps_run * args.train_batch_size / max(total, 1e-9))
+    if checkpointer:
+        if steps_run > 0:
+            checkpointer.save(state)
+        checkpointer.close()
 
     if args.model_dir and pid == 0:
         from flax import serialization

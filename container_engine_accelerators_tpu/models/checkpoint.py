@@ -1,0 +1,84 @@
+"""Checkpoint/resume for the training demos (orbax).
+
+The reference delegates checkpointing to its external demo images
+(``--model_dir`` to GCS, ref: demo/gpu-training/generate_job.sh:62) and
+recovery to Kubernetes restart semantics (SURVEY.md §5).  A restarted
+training pod therefore needs in-tree save/restore to actually resume:
+this module wraps orbax so the driver checkpoints the full train state
+(step, params, batch_stats, opt_state) and a rescheduled pod continues
+from the last saved step instead of epoch 0.
+
+Orbax is sharding-aware: saves stream each host's shards of a GSPMD
+array, and restores lay shards out to match the target state's
+shardings — so the same checkpoint round-trips across restarts of a
+multi-host mesh with no gather through host 0.
+"""
+
+import logging
+from typing import Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from container_engine_accelerators_tpu.models.train import TrainState
+
+log = logging.getLogger(__name__)
+
+
+class TrainCheckpointer:
+    """Save/restore TrainState under ``directory`` keyed by step."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def _tree(self, state: TrainState):
+        # tx/apply_fn are static (pytree_node=False) and not serialized.
+        return {
+            "step": state.step,
+            "params": state.params,
+            "batch_stats": state.batch_stats,
+            "opt_state": state.opt_state,
+        }
+
+    def save(self, state: TrainState, wait: bool = False) -> None:
+        step = int(jax.device_get(state.step))
+        self.manager.save(step, args=ocp.args.StandardSave(self._tree(state)))
+        if wait:
+            self.manager.wait_until_finished()
+
+    def restore_latest(
+        self, state: TrainState
+    ) -> Tuple[TrainState, Optional[int]]:
+        """Restore the newest checkpoint onto ``state``'s shardings.
+
+        Returns (state, step) — unchanged state and None when there is no
+        checkpoint yet (first boot of the Job).
+        """
+        step = self.manager.latest_step()
+        if step is None:
+            return state, None
+        target = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, self._tree(state)
+        )
+        restored = self.manager.restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        log.info("restored checkpoint at step %d", step)
+        return (
+            state.replace(
+                step=restored["step"],
+                params=restored["params"],
+                batch_stats=restored["batch_stats"],
+                opt_state=restored["opt_state"],
+            ),
+            step,
+        )
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()
