@@ -9,6 +9,11 @@ from container_engine_accelerators_tpu.parallel.mesh import (
     shard_params,
 )
 from container_engine_accelerators_tpu.parallel import dcn
+from container_engine_accelerators_tpu.parallel.seq import (
+    make_sequence_parallel_attention,
+    ring_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -16,7 +21,10 @@ __all__ = [
     "batch_sharding",
     "create_hybrid_mesh",
     "create_mesh",
+    "make_sequence_parallel_attention",
     "replicated",
+    "ring_attention",
     "shard_params",
+    "ulysses_attention",
     "dcn",
 ]

@@ -1,0 +1,204 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Long-context scaling over the slice fabric.  The reference has no model
+code at this altitude — its sequence-length-scaling analog is bandwidth
+scaling via multi-NIC GPUDirect + topology packing (SURVEY.md §5
+"Long-context / sequence parallelism") — so these are the TPU-native
+first-class equivalents: the sequence axis is sharded across devices and
+the attention collectives ride ICI.
+
+Two standard schemes, both jittable under ``shard_map`` over an existing
+mesh axis (no new infrastructure):
+
+- :func:`ring_attention` — K/V blocks rotate around the ring with
+  ``lax.ppermute`` while each device accumulates flash-style online
+  softmax statistics for its resident Q block.  Per-step traffic is one
+  K/V block to the ICI neighbor, overlapping compute and transfer the
+  way the scaling-book recipe prescribes; memory per device is
+  O(seq/n_devices).
+- :func:`ulysses_attention` — ``lax.all_to_all`` reshuffles the
+  sequence shard into a head shard so each device runs *dense* attention
+  over the full sequence for heads/n_devices heads, then shuffles back.
+  Cheaper compute pattern for moderate sequence lengths; requires
+  num_heads % axis_size == 0.
+
+Both are numerically equivalent to single-device attention (see
+tests/test_seq_parallel.py for the replicated-reference check).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _pvary(x, axis_name):
+    """Mark ``x`` as varying over ``axis_name`` (shard_map type system).
+
+    ``lax.pcast(..., to="varying")`` replaced ``lax.pvary``; support both
+    so the module imports on the JAX range pyproject allows.
+    """
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))
+
+
+def _block_attend(q, k, v, m, l, o, causal_mask=None):
+    """One flash-attention accumulation step against a K/V block.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; m/l running max/denominator
+    [B, H, Tq]; o unnormalized output accumulator [B, Tq, H, D].
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k)  # logits
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # Rescale previous accumulator to the new max, then add this block.
+    correction = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v
+    )
+    return m_new, l_new, o_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring self-attention over a sequence-sharded axis.
+
+    Call inside ``shard_map``; q/k/v are the per-device sequence shards
+    ``[batch, seq/n, heads, head_dim]``.  K/V rotate n-1 times via
+    ``ppermute`` to the next ring neighbor; a ``lax.scan`` over ring
+    steps keeps the jitted program free of Python-level unrolling.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    q = q * scale
+
+    b, tq, h, _ = q.shape
+    tk = k.shape[1]
+    # Mark the running stats as varying over the ring axis up front: the
+    # scan carry must keep one type, and the outputs vary (they depend on
+    # this device's Q block and ring position).
+    m0 = _pvary(jnp.full((b, h, tq), NEG_INF, q.dtype), axis_name)
+    l0 = _pvary(jnp.zeros((b, h, tq), q.dtype), axis_name)
+    o0 = jnp.zeros_like(q)
+
+    q_pos = idx * tq + jnp.arange(tq)  # global positions of resident Q
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, step_idx):
+        m, l, o, k_blk, v_blk = carry
+        # The K/V block currently resident arrived from rank (idx - step).
+        src = (idx - step_idx) % n
+        if causal:
+            k_pos = src * tk + jnp.arange(tk)
+            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+            mask = mask[None, None, :, :]
+        else:
+            mask = None
+        m, l, o = _block_attend(q, k_blk, v_blk, m, l, o, mask)
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (m, l, o, k_blk, v_blk), None
+
+    (m, l, o, _, _), _ = lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(n)
+    )
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Inside ``shard_map`` with q/k/v ``[batch, seq/n, heads, head_dim]``:
+    an all-to-all converts the sequence shard into a head shard
+    ``[batch, seq, heads/n, head_dim]``, each device attends densely over
+    the full sequence for its heads, and a reverse all-to-all restores
+    the sequence shard.
+    """
+    n = lax.axis_size(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+
+    def seq_to_heads(x):
+        # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs num_heads ({q.shape[2]}) divisible by the "
+            f"sequence-parallel degree ({n})"
+        )
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh * scale, kh)
+    if causal:
+        t = qh.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    return heads_to_seq(oh)
+
+
+def make_sequence_parallel_attention(
+    mesh: Mesh,
+    kind: str = "ring",
+    causal: bool = False,
+    axis_name: str = "data",
+):
+    """Jit a sequence-parallel attention over ``mesh``.
+
+    Returns ``fn(q, k, v) -> out`` taking GLOBAL ``[B, T, H, D]`` arrays
+    sharded (or shardable) on ``axis_name`` along T; the wrapper applies
+    ``shard_map`` + jit with the sequence axis sharded and batch/heads
+    replicated across that axis.
+    """
+    kinds = {"ring": ring_attention, "ulysses": ulysses_attention}
+    if kind not in kinds:
+        raise ValueError(
+            f"kind must be one of {'|'.join(sorted(kinds))}, got {kind!r}"
+        )
+    inner = kinds[kind]
+    spec = P(None, axis_name, None, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def sharded(q, k, v):
+        return inner(q, k, v, axis_name=axis_name, causal=causal)
+
+    sharding = NamedSharding(mesh, spec)
+    return jax.jit(
+        sharded,
+        in_shardings=(sharding, sharding, sharding),
+        out_shardings=sharding,
+    )
