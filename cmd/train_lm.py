@@ -1,0 +1,171 @@
+#!/usr/bin/env python3
+"""Transformer-LM training driver — the long-context demo workload.
+
+Companion to cmd/train_resnet.py (the reference's demo trainers are
+convolutional only, demo/gpu-training/generate_job.sh:54-70); this
+driver exercises the sequence-parallel fabric: ``--seq-parallel ring``
+shards the SEQUENCE across the mesh's data axis and rotates K/V blocks
+over ICI (parallel/seq.py), so context length scales with slice size
+the way batch size scales for the ResNet demo.
+
+Synthetic token streams by default (no dataset dependency); checkpoints
+and resume via the same orbax path as the ResNet driver.
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+log = logging.getLogger("train-lm")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="JAX transformer-LM demo")
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--num-layers", type=int, default=12)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--mlp-dim", type=int, default=2048)
+    p.add_argument("--seq-len", type=int, default=2048,
+                   help="GLOBAL sequence length (sharded across the mesh "
+                        "under --seq-parallel)")
+    p.add_argument("--train-batch-size", type=int, default=8,
+                   help="GLOBAL batch size")
+    p.add_argument("--seq-parallel", default="none",
+                   choices=("none", "ring", "ulysses"),
+                   help="sequence/context parallelism scheme over the "
+                        "mesh data axis")
+    p.add_argument("--model-par", type=int, default=1,
+                   help="tensor-parallel degree of the mesh (dense mode)")
+    p.add_argument("--learning-rate", type=float, default=3e-4)
+    p.add_argument("--train-steps", type=int, default=100)
+    p.add_argument("--steps-per-eval", type=int, default=20)
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-interval", type=int, default=100)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    args = parse_args(argv)
+    if args.checkpoint_interval < 1:
+        raise SystemExit("--checkpoint-interval must be >= 1")
+
+    from container_engine_accelerators_tpu.parallel import dcn
+
+    num_procs, pid = dcn.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+        make_lm_train_step,
+        next_token_targets,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+    from container_engine_accelerators_tpu.parallel import create_mesh
+
+    seq_parallel = None if args.seq_parallel == "none" else args.seq_parallel
+    n_dev = jax.device_count()
+    if seq_parallel:
+        if args.model_par > 1:
+            raise SystemExit(
+                "--model-par does not compose with --seq-parallel yet: "
+                "the sequence shards occupy the whole data axis and "
+                "params are replicated; drop one of the flags"
+            )
+        # The whole data axis carries the sequence shards.
+        mesh = create_mesh(model=1)
+        if args.seq_len % n_dev:
+            raise SystemExit(
+                f"--seq-len {args.seq_len} not divisible by {n_dev} devices"
+            )
+    else:
+        mesh = create_mesh(model=args.model_par)
+        if args.train_batch_size % n_dev:
+            raise SystemExit(
+                f"--train-batch-size {args.train_batch_size} not divisible "
+                f"by {n_dev} devices"
+            )
+    log.info("process %d/%d, %d devices, mesh %s, seq_parallel=%s",
+             pid, num_procs, n_dev,
+             dict(zip(mesh.axis_names, mesh.devices.shape)), seq_parallel)
+
+    model = transformer_lm(
+        vocab_size=args.vocab_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        head_dim=args.head_dim,
+        mlp_dim=args.mlp_dim,
+        seq_parallel=seq_parallel,
+    )
+    sample = jnp.ones((args.train_batch_size, args.seq_len), jnp.int32)
+    state = create_lm_train_state(
+        model, jax.random.PRNGKey(0), sample,
+        tx=optax.adamw(args.learning_rate, weight_decay=0.1),
+    )
+    step_fn, state = make_lm_train_step(mesh, state, seq_parallel)
+
+    checkpointer = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from container_engine_accelerators_tpu.models.checkpoint import (
+            TrainCheckpointer,
+        )
+
+        checkpointer = TrainCheckpointer(os.path.abspath(args.checkpoint_dir))
+        state, restored_step = checkpointer.restore_latest(state)
+        if restored_step is not None:
+            start_step = restored_step
+            log.info("resuming from checkpoint at step %d", start_step)
+
+    # Rotate distinct synthetic batches (see bench.py on why).
+    np_rng = np.random.default_rng(0)
+    n_batches = 4
+    batches = []
+    for _ in range(n_batches):
+        toks = jnp.asarray(
+            np_rng.integers(0, args.vocab_size,
+                            (args.train_batch_size, args.seq_len)),
+            jnp.int32,
+        )
+        labels, mask = next_token_targets(toks)
+        batches.append((toks, labels, mask))
+
+    t0 = time.perf_counter()
+    tokens_per_batch = args.train_batch_size * args.seq_len
+    for step in range(start_step, args.train_steps):
+        toks, labels, mask = batches[step % n_batches]
+        state, metrics = step_fn(state, toks, labels, mask)
+        if (step + 1) % args.steps_per_eval == 0:
+            dt = time.perf_counter() - t0
+            log.info(
+                "step %d loss=%.4f tokens/sec=%.0f",
+                step + 1, float(jax.device_get(metrics["loss"])),
+                (step + 1 - start_step) * tokens_per_batch / dt,
+            )
+        if checkpointer and (step + 1) % args.checkpoint_interval == 0:
+            checkpointer.save(state)
+    jax.block_until_ready(state.params)
+    total = time.perf_counter() - t0
+    steps_run = args.train_steps - start_step
+    log.info("done: %d steps, %.0f tokens/sec overall", steps_run,
+             steps_run * tokens_per_batch / max(total, 1e-9))
+    if checkpointer:
+        if steps_run > 0:
+            checkpointer.save(state)
+        checkpointer.close()
+
+
+if __name__ == "__main__":
+    main()

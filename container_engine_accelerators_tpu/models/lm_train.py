@@ -1,0 +1,179 @@
+"""Training step for the transformer LM, dense or sequence-parallel.
+
+Dense mode is a plain jitted step (GSPMD shards the batch like the
+ResNet path).  Sequence-parallel mode wraps loss+grad in ``shard_map``
+over the mesh's data axis: tokens/labels arrive sharded along the
+sequence, params replicated; each device computes its shard's loss
+terms and local grads, and one ``psum`` per reduction makes both
+global.  The optimizer then runs outside the shard_map under the same
+jit — XLA keeps params resident and the collectives on ICI.
+
+Next-token labels are built *globally* before sharding (the label of a
+shard's last position lives in the next shard), so the step takes
+(tokens, labels, mask) rather than shifting internally.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from container_engine_accelerators_tpu.models.train import TrainState
+from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS
+
+
+def next_token_targets(
+    tokens: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """(labels, mask) for causal LM: predict token t+1 at position t."""
+    labels = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+    return labels, mask
+
+
+def create_lm_train_state(
+    model, rng, sample_tokens, tx: Optional[optax.GradientTransformation] = None
+) -> TrainState:
+    # Sequence-parallel attention only traces inside shard_map (it needs a
+    # bound mesh axis), but the param structure is identical to dense —
+    # the schemes differ only in attention *math* — so init a dense clone.
+    init_model = model
+    if getattr(model, "seq_parallel", None):
+        init_model = model.clone(seq_parallel=None)
+    # No param depends on sequence length (Embed/Dense/RMSNorm only), so
+    # init on a short dummy sequence: a full-length dense init would
+    # materialize the [B, H, T, T] attention matrix the sequence-parallel
+    # path exists to avoid (e.g. 131072^2 logits at demo scale).
+    init_tokens = sample_tokens[:1, : min(sample_tokens.shape[1], 128)]
+    variables = init_model.init(rng, init_tokens)
+    tx = tx or optax.adamw(3e-4, weight_decay=0.1)
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+        tx=tx,
+        apply_fn=model.apply,
+    )
+
+
+def _loss(apply_fn, params, tokens, labels, mask, positions):
+    logits = apply_fn({"params": params}, tokens, positions)
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return (per_tok * mask).sum(), mask.sum()
+
+
+def make_lm_train_step(
+    mesh: Mesh, state: TrainState, seq_parallel: Optional[str] = None
+):
+    """Jit the LM step over ``mesh``.
+
+    Returns (step_fn, placed_state); ``step_fn(state, tokens, labels,
+    mask) -> (state, metrics)``.  ``seq_parallel`` None shards the batch
+    axis (pure dp); "ring"/"ulysses" shard the sequence axis across
+    DATA_AXIS (the model must have been built with the matching
+    ``seq_parallel=`` so its attention uses the axis).
+    """
+    rep = NamedSharding(mesh, P())
+    apply_fn = state.apply_fn
+    tx = state.tx
+
+    if seq_parallel is None:
+        # Megatron-style tensor parallelism over MODEL_AXIS (same rule as
+        # the ResNet path): params and their same-shaped optimizer
+        # buffers shard the largest divisible weight axis.
+        from container_engine_accelerators_tpu.parallel.mesh import (
+            shard_params,
+        )
+
+        state_sh = TrainState(
+            step=rep,
+            params=shard_params(state.params, mesh),
+            batch_stats=jax.tree_util.tree_map(
+                lambda _: rep, state.batch_stats
+            ),
+            opt_state=shard_params(state.opt_state, mesh),
+            tx=tx,
+            apply_fn=apply_fn,
+        )
+        placed = jax.device_put(state, state_sh)
+        data_sh = NamedSharding(mesh, P(DATA_AXIS))
+
+        def step(s, tokens, labels, mask):
+            def loss_fn(params):
+                num, den = _loss(
+                    apply_fn, params, tokens, labels, mask,
+                    jnp.arange(tokens.shape[1]),
+                )
+                return num / den
+
+            loss, grads = jax.value_and_grad(loss_fn)(s.params)
+            updates, opt_state = tx.update(grads, s.opt_state, s.params)
+            return (
+                s.replace(
+                    step=s.step + 1,
+                    params=optax.apply_updates(s.params, updates),
+                    opt_state=opt_state,
+                ),
+                {"loss": loss},
+            )
+
+        jitted = jax.jit(
+            step,
+            in_shardings=(state_sh, data_sh, data_sh, data_sh),
+            out_shardings=(state_sh, rep),
+            donate_argnums=(0,),
+        )
+        return jitted, placed
+
+    # Sequence parallel: tokens [B, T] sharded along T over DATA_AXIS;
+    # params replicated (shard_map's in_specs P() requires it).
+    state_sh = jax.tree_util.tree_map(lambda _: rep, state)
+    placed = jax.device_put(state, state_sh)
+    seq_spec = P(None, DATA_AXIS)
+    seq_sh = NamedSharding(mesh, seq_spec)
+
+    def shard_loss_grad(params, tokens, labels, mask):
+        tq = tokens.shape[1]
+        positions = lax.axis_index(DATA_AXIS) * tq + jnp.arange(tq)
+
+        def loss_fn(p):
+            num, den = _loss(apply_fn, p, tokens, labels, mask, positions)
+            return lax.psum(num, DATA_AXIS) / lax.psum(den, DATA_AXIS)
+
+        # No explicit grad psum: params enter replicated (in_specs P()),
+        # so shard_map autodiff inserts the cross-device sum as the
+        # transpose of the implicit replication broadcast — an explicit
+        # psum here would multiply every gradient by the axis size.
+        return jax.value_and_grad(loss_fn)(params)
+
+    sharded = jax.shard_map(
+        shard_loss_grad,
+        mesh=mesh,
+        in_specs=(P(), seq_spec, seq_spec, seq_spec),
+        out_specs=(P(), P()),
+    )
+
+    def step(s, tokens, labels, mask):
+        loss, grads = sharded(s.params, tokens, labels, mask)
+        updates, opt_state = tx.update(grads, s.opt_state, s.params)
+        return (
+            s.replace(
+                step=s.step + 1,
+                params=optax.apply_updates(s.params, updates),
+                opt_state=opt_state,
+            ),
+            {"loss": loss},
+        )
+
+    jitted = jax.jit(
+        step,
+        in_shardings=(state_sh, seq_sh, seq_sh, seq_sh),
+        out_shardings=(state_sh, rep),
+        donate_argnums=(0,),
+    )
+    return jitted, placed

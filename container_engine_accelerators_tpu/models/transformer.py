@@ -1,0 +1,191 @@
+"""Decoder-only transformer LM — the long-context demo workload.
+
+The reference demos only convolutional families (TF ResNet sweep,
+demo/gpu-training/generate_job.sh:19-24; TPU ResNet/Inception jobs,
+demo/tpu-training/*.yaml); its long-sequence story is bandwidth
+infrastructure, not model code (SURVEY.md §5).  This model is the
+TPU-native counterpart that makes the sequence-parallel fabric
+(parallel/seq.py) load-bearing: a pre-norm decoder LM whose attention
+can run dense (single device), ring (ppermute over ICI), or Ulysses
+(all_to_all), selected per call.
+
+TPU-first choices: bf16 compute / f32 params, RMSNorm (one fused
+rsqrt, no mean subtraction), SwiGLU MLP (two matmuls feed one
+elementwise gate — MXU-dense), rotary position embeddings computed
+with static shapes, and no data-dependent control flow anywhere, so
+the whole step jits and shards under GSPMD.
+"""
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from container_engine_accelerators_tpu.ops.flash_attention import (
+    flash_attention,
+    supports_flash,
+)
+from container_engine_accelerators_tpu.parallel.seq import (
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def _on_tpu() -> bool:
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Apply RoPE to ``x`` [B, T, H, D] at absolute ``positions`` [T].
+
+    Positions are passed explicitly so sequence-parallel shards rotate
+    with their *global* offsets.
+    """
+    d = x.shape[-1]
+    half = d // 2
+    freq = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
+    theta = positions[:, None].astype(jnp.float32) * freq[None, :]  # [T, half]
+    cos = jnp.cos(theta)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(theta)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        y = x.astype(jnp.float32)
+        y = y * jax.lax.rsqrt(jnp.mean(y * y, -1, keepdims=True) + 1e-6)
+        return (y * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    num_heads: int
+    head_dim: int
+    dtype: Any = jnp.bfloat16
+    seq_parallel: Optional[str] = None  # None | "ring" | "ulysses"
+    seq_axis: str = "data"
+    use_flash: Optional[bool] = None  # None = auto: TPU + tile-aligned
+
+    @nn.compact
+    def __call__(self, x, positions):
+        dense = functools.partial(
+            nn.DenseGeneral, use_bias=False, dtype=self.dtype
+        )
+        features = (self.num_heads, self.head_dim)
+        q = dense(features, name="q")(x)
+        k = dense(features, name="k")(x)
+        v = dense(features, name="v")(x)
+        q = rotary_embedding(q, positions)
+        k = rotary_embedding(k, positions)
+
+        if self.seq_parallel == "ring":
+            o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        elif self.seq_parallel == "ulysses":
+            o = ulysses_attention(
+                q, k, v, axis_name=self.seq_axis, causal=True
+            )
+        else:
+            flash = self.use_flash
+            if flash is None:
+                # Auto only on a SINGLE chip: pallas_call has no GSPMD
+                # partitioning rule, so under a sharded jit it would
+                # gather full q/k/v per chip.  Multi-chip dense mode
+                # keeps XLA attention (which partitions); callers that
+                # wrap the model in shard_map may force use_flash=True.
+                flash = (
+                    _on_tpu()
+                    and jax.device_count() == 1
+                    and supports_flash(q.shape[1], self.head_dim)
+                )
+            if flash:
+                o = flash_attention(q, k, v, True)
+            else:
+                o = dense_attention(q, k, v, causal=True)
+        return dense(
+            x.shape[-1], axis=(-2, -1), name="out"
+        )(o)
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    seq_parallel: Optional[str] = None
+    seq_axis: str = "data"
+    use_flash: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        y = RMSNorm(dtype=self.dtype, name="ln_attn")(x)
+        x = x + Attention(
+            self.num_heads,
+            self.head_dim,
+            self.dtype,
+            self.seq_parallel,
+            self.seq_axis,
+            self.use_flash,
+            name="attn",
+        )(y, positions)
+        y = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
+        dense = functools.partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        gate = dense(self.mlp_dim, name="gate")(y)
+        up = dense(self.mlp_dim, name="up")(y)
+        x = x + dense(x.shape[-1], name="down")(nn.silu(gate) * up)
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM.  ``__call__(tokens [B, T], positions [T]) -> logits``.
+
+    ``positions`` defaults to ``arange(T)``; sequence-parallel callers
+    pass each shard's global positions.
+    """
+
+    vocab_size: int = 32_000
+    num_layers: int = 12
+    num_heads: int = 8
+    head_dim: int = 64
+    mlp_dim: int = 2048
+    dtype: Any = jnp.bfloat16
+    seq_parallel: Optional[str] = None
+    seq_axis: str = "data"
+    use_flash: Optional[bool] = None
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, train: bool = True):
+        del train  # no dropout: demo parity with the reference trainers
+        if positions is None:
+            positions = jnp.arange(tokens.shape[1])
+        emb = nn.Embed(
+            self.vocab_size,
+            self.num_heads * self.head_dim,
+            dtype=self.dtype,
+            name="embed",
+        )
+        x = emb(tokens)
+        for i in range(self.num_layers):
+            x = Block(
+                self.num_heads,
+                self.head_dim,
+                self.mlp_dim,
+                self.dtype,
+                self.seq_parallel,
+                self.seq_axis,
+                self.use_flash,
+                name=f"block_{i}",
+            )(x, positions)
+        x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
+        # Final projection in f32 for a numerically stable softmax loss.
+        return emb.attend(x.astype(jnp.float32))
+
+
+def transformer_lm(**kwargs) -> TransformerLM:
+    return TransformerLM(**kwargs)

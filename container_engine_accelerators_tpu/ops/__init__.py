@@ -1,0 +1,6 @@
+"""Pallas TPU kernels for the hot ops."""
+from container_engine_accelerators_tpu.ops.flash_attention import (
+    flash_attention,
+)
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,158 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of the transformer workload (models/transformer.py).  XLA's
+stock lowering of dense attention materializes the [B, H, T, T] logits
+in HBM; this kernel keeps everything in VMEM with the classic online
+softmax: for each Q block, stream K/V blocks, track running max ``m``,
+denominator ``l`` and unnormalized accumulator in float32, and write
+one normalized [BLOCK_Q, D] tile at the end — O(T) HBM traffic instead
+of O(T^2).
+
+Layout maps straight onto the hardware: the QK^T and PV products are
+MXU matmuls with f32 accumulation (``preferred_element_type``), the
+exp/max/rescale chain runs on the VPU, and the causal path skips K
+blocks entirely above the diagonal (not just masks them), halving work.
+
+The op is differentiable via ``jax.custom_vjp``: the backward pass
+recomputes attention with plain jnp ops (the standard recompute trick —
+nothing is saved but q/k/v) and lets XLA differentiate that; forward
+speed is where the kernel matters for training steps.
+
+Use :func:`flash_attention` directly, or through
+``models/transformer.py`` which selects it automatically on TPU for
+tile-aligned shapes.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+BLOCK_Q = 128
+BLOCK_K = 128
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
+    """One grid step: q block (i) of one batch*head against all K/V."""
+    q_i = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D] — keep the input precision: bf16 operands run
+    bq, d = q.shape  # the MXU at full rate; accumulation is f32 via
+    t = k_ref.shape[1]  # preferred_element_type, and scale applies to the
+    nk = t // block_k  # f32 logits afterwards (exact).
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing: stop at
+        # the block containing this Q tile's last row.
+        last_row = q_i * bq + (bq - 1)
+        nk_run = last_row // block_k + 1
+    else:
+        nk_run = nk
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK] f32
+        if causal:
+            rows = q_i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, nk_run, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, causal, scale, interpret):
+    """Pallas forward on [B, T, H, D] inputs."""
+    b, t, h, d = q.shape
+
+    def to_bh(x):  # [B, T, H, D] -> [B*H, T, D]
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    qf, kf, vf = to_bh(q), to_bh(k), to_bh(v)
+    grid = (b * h, t // BLOCK_Q)
+    kernel = functools.partial(
+        _fa_kernel, causal=causal, scale=scale, block_k=BLOCK_K
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _dense_ref(q, k, v, causal, scale):
+    """Recompute-backward reference: the shared dense_attention numerics
+    (parallel/seq.py is the single source of attention math)."""
+    from container_engine_accelerators_tpu.parallel.seq import (
+        dense_attention,
+    )
+
+    return dense_attention(q, k, v, causal=causal, scale=scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
+    """Flash attention on [B, T, H, D]; T must be a multiple of 128.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter
+    (hardware-free, used by the test suite).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _fa_forward(q, k, v, causal, scale, interpret)
+
+
+def _fa_fwd(q, k, v, causal, scale, interpret):
+    return flash_attention(q, k, v, causal, scale, interpret), (q, k, v)
+
+
+def _fa_bwd(causal, scale, interpret, res, g):
+    q, k, v = res
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(
+        lambda q, k, v: _dense_ref(q, k, v, causal, scale), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def supports_flash(t: int, d: int) -> bool:
+    """Tile-alignment gate used by callers choosing a fast path."""
+    return t % BLOCK_Q == 0 and t >= BLOCK_Q and d in (64, 128, 256)

@@ -1,0 +1,193 @@
+"""Transformer LM tests: the dense model trains, and the
+sequence-parallel (ring / Ulysses) step matches the dense step's loss
+and gradients — proving the long-context path is numerically the same
+model, just sharded along the sequence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from container_engine_accelerators_tpu.models.lm_train import (
+    create_lm_train_state,
+    make_lm_train_step,
+    next_token_targets,
+)
+from container_engine_accelerators_tpu.models.transformer import (
+    transformer_lm,
+)
+from container_engine_accelerators_tpu.parallel import create_mesh
+
+VOCAB, B, T = 97, 4, 32  # batch divisible by the 4-way data axis
+CFG = dict(
+    vocab_size=VOCAB,
+    num_layers=2,
+    num_heads=4,
+    head_dim=8,
+    mlp_dim=64,
+    dtype=jnp.float32,  # f32 so dense vs sharded comparisons are tight
+)
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.integers(0, VOCAB, (B, T)), jnp.int32)
+
+
+def _state(model, tokens):
+    return create_lm_train_state(
+        model, jax.random.PRNGKey(0), tokens,
+        tx=optax.sgd(0.1),  # plain SGD keeps the update linear in grads
+    )
+
+
+def test_dense_lm_trains(tokens):
+    mesh = create_mesh(data=4, model=2)
+    model = transformer_lm(**CFG)
+    state = _state(model, tokens)
+    step_fn, placed = make_lm_train_step(mesh, state)
+    labels, mask = next_token_targets(tokens)
+    losses = []
+    s = placed
+    for _ in range(5):
+        s, m = step_fn(s, tokens, labels, mask)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]  # it learns the synthetic batch
+    assert int(jax.device_get(s.step)) == 5
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+def test_seq_parallel_matches_dense(tokens, kind):
+    mesh = create_mesh(data=4, model=2)
+    labels, mask = next_token_targets(tokens)
+
+    dense_model = transformer_lm(**CFG)
+    dense_state = _state(dense_model, tokens)
+    dense_step, dense_placed = make_lm_train_step(mesh, dense_state)
+    d_state, d_metrics = dense_step(dense_placed, tokens, labels, mask)
+
+    sp_model = transformer_lm(**CFG, seq_parallel=kind)
+    sp_state = _state(sp_model, tokens)
+    sp_step, sp_placed = make_lm_train_step(mesh, sp_state,
+                                            seq_parallel=kind)
+    s_state, s_metrics = sp_step(sp_placed, tokens, labels, mask)
+
+    np.testing.assert_allclose(
+        float(s_metrics["loss"]), float(d_metrics["loss"]),
+        atol=1e-5, rtol=1e-5,
+    )
+    # Post-SGD-step params equal ⇔ gradients equal.
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(d_state.params)),
+        jax.tree_util.tree_leaves(jax.device_get(s_state.params)),
+    ):
+        np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+def test_seq_parallel_matches_dense_bf16(tokens, kind):
+    """Production dtype: softmax statistics run in f32 inside every
+    scheme, so bf16 models agree across dense/ring/ulysses too (looser
+    tolerance — the matmul inputs are still bf16)."""
+    cfg = dict(CFG, dtype=jnp.bfloat16)
+    mesh = create_mesh(data=4, model=2)
+    labels, mask = next_token_targets(tokens)
+
+    dense_state = _state(transformer_lm(**cfg), tokens)
+    dense_step, dense_placed = make_lm_train_step(mesh, dense_state)
+    _, d_metrics = dense_step(dense_placed, tokens, labels, mask)
+
+    sp_state = _state(transformer_lm(**cfg, seq_parallel=kind), tokens)
+    sp_step, sp_placed = make_lm_train_step(mesh, sp_state,
+                                            seq_parallel=kind)
+    _, s_metrics = sp_step(sp_placed, tokens, labels, mask)
+
+    np.testing.assert_allclose(
+        float(s_metrics["loss"]), float(d_metrics["loss"]),
+        atol=2e-2, rtol=2e-3,
+    )
+
+
+def test_dense_mode_tensor_parallel_shards_params(tokens):
+    """--model-par actually shards weights: dense-mode placement uses the
+    Megatron-style rule, not full replication."""
+    mesh = create_mesh(data=4, model=2)
+    state = _state(transformer_lm(**CFG), tokens)
+    _, placed = make_lm_train_step(mesh, state)
+    specs = {
+        str(leaf.sharding.spec)
+        for leaf in jax.tree_util.tree_leaves(placed.params)
+    }
+    assert any("model" in s for s in specs), specs
+
+
+def test_rotary_positions_are_global(tokens):
+    """A sequence-parallel shard must rotate with global offsets: shifting
+    the position base changes the logits (sanity check that positions
+    actually matter and are threaded through)."""
+    model = transformer_lm(**CFG)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    a = model.apply(variables, tokens, jnp.arange(T))
+    b = model.apply(variables, tokens, jnp.arange(T) + 7)
+    assert not np.allclose(jax.device_get(a), jax.device_get(b))
+
+
+def test_lm_driver_ring_resume(tmp_path):
+    """The real LM driver end-to-end with ring sequence parallelism,
+    including checkpoint resume across two invocations."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "train_lm_main", os.path.join(repo, "cmd", "train_lm.py"))
+    train_lm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(train_lm)
+
+    common = [
+        "--vocab-size", "97", "--num-layers", "1", "--num-heads", "4",
+        "--head-dim", "8", "--mlp-dim", "32", "--seq-len", "64",
+        "--train-batch-size", "2", "--seq-parallel", "ring",
+        "--steps-per-eval", "1",
+        "--checkpoint-dir", str(tmp_path / "lm-ck"),
+        "--checkpoint-interval", "2",
+    ]
+    train_lm.main(common + ["--train-steps", "2"])
+    train_lm.main(common + ["--train-steps", "3"])
+
+    from container_engine_accelerators_tpu.models.checkpoint import (
+        TrainCheckpointer,
+    )
+
+    ck = TrainCheckpointer(str(tmp_path / "lm-ck"))
+    assert ck.manager.latest_step() == 3
+    ck.close()
+
+
+def test_checkpoint_roundtrip_lm(tokens, tmp_path):
+    """The LM state checkpoints through the same TrainCheckpointer."""
+    from container_engine_accelerators_tpu.models.checkpoint import (
+        TrainCheckpointer,
+    )
+
+    mesh = create_mesh(data=4, model=2)
+    model = transformer_lm(**CFG)
+    state = _state(model, tokens)
+    step_fn, placed = make_lm_train_step(mesh, state)
+    labels, mask = next_token_targets(tokens)
+    placed, _ = step_fn(placed, tokens, labels, mask)
+
+    ck = TrainCheckpointer(str(tmp_path / "lm"))
+    ck.save(placed, wait=True)
+    fresh = _state(model, tokens)
+    _, fresh_placed = make_lm_train_step(mesh, fresh)
+    restored, step = ck.restore_latest(fresh_placed)
+    ck.close()
+    assert step == 1
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(placed.params)),
+        jax.tree_util.tree_leaves(jax.device_get(restored.params)),
+    ):
+        np.testing.assert_array_equal(a, b)
