@@ -72,6 +72,7 @@ class Attention(nn.Module):
     seq_parallel: Optional[str] = None  # None | "ring" | "ulysses"
     seq_axis: str = "data"
     use_flash: Optional[bool] = None  # None = auto: TPU + tile-aligned
+    decode: bool = False  # autoregressive KV-cache mode
 
     @nn.compact
     def __call__(self, x, positions):
@@ -84,6 +85,16 @@ class Attention(nn.Module):
         v = dense(features, name="v")(x)
         q = rotary_embedding(q, positions)
         k = rotary_embedding(k, positions)
+
+        if self.decode:
+            if self.seq_parallel:
+                raise ValueError(
+                    "decode mode is single-sequence; it does not compose "
+                    "with sequence parallelism"
+                )
+            return dense(x.shape[-1], axis=(-2, -1), name="out")(
+                self._decode_attend(q, k, v, positions)
+            )
 
         if self.seq_parallel == "ring":
             o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
@@ -112,6 +123,55 @@ class Attention(nn.Module):
             x.shape[-1], axis=(-2, -1), name="out"
         )(o)
 
+    def _decode_attend(self, q, k, v, positions):
+        """KV-cache attention: append this call's K/V at the cache cursor
+        and attend the queries over everything cached so far.  The cache
+        length is fixed by the shape used at ``init`` (flax's standard
+        cache-variable pattern), so the decode step jits once and is
+        reused for every token.
+        """
+        b, t, h, d = q.shape
+        cached_k = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, t, h, d), k.dtype),
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, t, h, d), v.dtype),
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if self.is_initializing():
+            # init just shapes the cache to the full target length
+            return jnp.zeros_like(q)
+
+        idx = cache_index.value
+        max_len = cached_k.value.shape[1]
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k, (0, idx, 0, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v, (0, idx, 0, 0)
+        )
+        cache_index.value = idx + t
+
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q * (self.head_dim**-0.5), cached_k.value,
+            preferred_element_type=jnp.float32,
+        )
+        # Key j is visible to query at global position p when j <= p;
+        # queries in this call sit at `positions` (shape [t]).
+        key_pos = jnp.arange(max_len)
+        mask = key_pos[None, :] <= positions[:, None]  # [t, max_len]
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum(
+            "bhqk,bkhd->bqhd", p, cached_v.value,
+            preferred_element_type=jnp.float32,
+        )
+        return o.astype(q.dtype)
+
 
 class Block(nn.Module):
     num_heads: int
@@ -121,6 +181,7 @@ class Block(nn.Module):
     seq_parallel: Optional[str] = None
     seq_axis: str = "data"
     use_flash: Optional[bool] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -132,6 +193,7 @@ class Block(nn.Module):
             self.seq_parallel,
             self.seq_axis,
             self.use_flash,
+            self.decode,
             name="attn",
         )(y, positions)
         y = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
@@ -158,6 +220,7 @@ class TransformerLM(nn.Module):
     seq_parallel: Optional[str] = None
     seq_axis: str = "data"
     use_flash: Optional[bool] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = True):
@@ -180,6 +243,7 @@ class TransformerLM(nn.Module):
                 self.seq_parallel,
                 self.seq_axis,
                 self.use_flash,
+                self.decode,
                 name=f"block_{i}",
             )(x, positions)
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)

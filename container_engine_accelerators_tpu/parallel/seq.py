@@ -48,13 +48,43 @@ def _pvary(x, axis_name):
     return lax.pvary(x, (axis_name,))
 
 
+def dense_attention(q, k, v, causal=False, scale=None):
+    """Scaled-dot-product attention, softmax statistics in float32.
+
+    The single source of attention numerics: the transformer's dense
+    branch and the Ulysses post-all_to_all attention both call this, and
+    the ring path accumulates in f32 to match, so every scheme agrees in
+    bf16 — logits and the exp/sum run in f32 regardless of input dtype,
+    only the two matmuls stay in the input precision.
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q * scale, k,
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v, preferred_element_type=jnp.float32
+    )
+    return o.astype(q.dtype)
+
+
 def _block_attend(q, k, v, m, l, o, causal_mask=None):
     """One flash-attention accumulation step against a K/V block.
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; m/l running max/denominator
-    [B, H, Tq]; o unnormalized output accumulator [B, Tq, H, D].
+    float32 [B, H, Tq]; o unnormalized f32 accumulator [B, Tq, H, D].
+    Statistics run in f32 so the ring result matches
+    :func:`dense_attention` in bf16; the QK/PV matmuls keep the input
+    precision with f32 accumulation (``preferred_element_type``).
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k)  # logits
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
     if causal_mask is not None:
         s = jnp.where(causal_mask, s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
@@ -63,7 +93,8 @@ def _block_attend(q, k, v, m, l, o, causal_mask=None):
     p = jnp.exp(s - m_new[..., None])
     l_new = l * correction + p.sum(axis=-1)
     o_new = o * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
-        "bhqk,bkhd->bqhd", p, v
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
     )
     return m_new, l_new, o_new
 
@@ -88,22 +119,22 @@ def ring_attention(
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     q = q * scale
 
-    b, tq, h, _ = q.shape
+    b, tq, h, d = q.shape
     tk = k.shape[1]
     # Mark the running stats as varying over the ring axis up front: the
     # scan carry must keep one type, and the outputs vary (they depend on
-    # this device's Q block and ring position).
-    m0 = _pvary(jnp.full((b, h, tq), NEG_INF, q.dtype), axis_name)
-    l0 = _pvary(jnp.zeros((b, h, tq), q.dtype), axis_name)
-    o0 = jnp.zeros_like(q)
+    # this device's Q block and ring position).  Statistics are f32 so the
+    # ring matches dense_attention in bf16.
+    m0 = _pvary(jnp.full((b, h, tq), NEG_INF, jnp.float32), axis_name)
+    l0 = _pvary(jnp.zeros((b, h, tq), jnp.float32), axis_name)
+    o0 = _pvary(jnp.zeros((b, tq, h, d), jnp.float32), axis_name)
 
     q_pos = idx * tq + jnp.arange(tq)  # global positions of resident Q
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, step_idx):
-        m, l, o, k_blk, v_blk = carry
-        # The K/V block currently resident arrived from rank (idx - step).
+    def attend(m, l, o, k_blk, v_blk, step_idx):
+        # The K/V block resident at ring step s arrived from rank idx - s.
         src = (idx - step_idx) % n
         if causal:
             k_pos = src * tk + jnp.arange(tk)
@@ -111,15 +142,24 @@ def ring_attention(
             mask = mask[None, None, :, :]
         else:
             mask = None
-        m, l, o = _block_attend(q, k_blk, v_blk, m, l, o, mask)
+        return _block_attend(q, k_blk, v_blk, m, l, o, mask)
+
+    def step(carry, step_idx):
+        m, l, o, k_blk, v_blk = carry
+        m, l, o = attend(m, l, o, k_blk, v_blk, step_idx)
         k_blk = lax.ppermute(k_blk, axis_name, perm)
         v_blk = lax.ppermute(v_blk, axis_name, perm)
         return (m, l, o, k_blk, v_blk), None
 
-    (m, l, o, _, _), _ = lax.scan(
-        step, (m0, l0, o0, k, v), jnp.arange(n)
+    # n-1 rotations: the scan attends+rotates for steps 0..n-2; the last
+    # arriving block is attended outside so its K/V are never forwarded
+    # (a final ppermute would be dead ICI traffic).
+    (m, l, o, k_last, v_last), _ = lax.scan(
+        step, (m0, l0, o0, k, v), jnp.arange(n - 1)
     )
-    return o / l.transpose(0, 2, 1)[..., None]
+    m, l, o = attend(m, l, o, k_last, v_last, n - 1)
+    out = o * (1.0 / l).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
 
 
 def ulysses_attention(
@@ -156,13 +196,7 @@ def ulysses_attention(
             f"sequence-parallel degree ({n})"
         )
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qh * scale, kh)
-    if causal:
-        t = qh.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    oh = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    oh = dense_attention(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(oh)
 
 
