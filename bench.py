@@ -1,35 +1,119 @@
 #!/usr/bin/env python3
-"""Flagship benchmark: ResNet-50 bf16 training throughput on one TPU chip.
+"""Flagship benchmark: bf16 training throughput + MFU on one TPU chip.
 
-The reference's training benchmark harness is the TF ResNet sweep on an
-8-GPU node (demo/gpu-training/generate_job.sh:19-24,73-75); it publishes no
-numbers (BASELINE.md).  The per-accelerator parity bar we measure against
-is the classic published TF benchmarks figure for the demo's GPUs:
-ResNet-50 fp16/bf16 ≈ 383 images/sec per V100 — so ``vs_baseline`` > 1.0
-means one TPU chip under this framework out-trains one GPU of the
-reference demo's node.
+Workloads (``BENCH_WORKLOAD``):
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N/383}
+- ``resnet`` (default) — ResNet-50 train step, the reference demo's
+  workload (demo/gpu-training/generate_job.sh:19-24,73-75).  The
+  reference publishes no numbers (BASELINE.md); the per-accelerator
+  parity bar is the classic published TF figure for the demo's GPUs:
+  ResNet-50 fp16/bf16 ~= 383 images/sec per V100, so ``vs_baseline`` >
+  1.0 means one TPU chip under this framework out-trains one GPU of the
+  reference demo's node.
+- ``lm`` — decoder-only transformer LM train step with the Pallas flash
+  attention kernel (ops/flash_attention.py), reporting tokens/sec.  The
+  reference has no LM benchmark; ``vs_baseline`` is MFU / 0.40 (0.40 ~=
+  strong published LLM-training MFU on TPUs), so > 1.0 beats that bar.
 
-Env knobs: BENCH_BATCH (default 128; auto-shrunk on CPU), BENCH_STEPS,
-BENCH_DEPTH (default 50).
+Both report **MFU**: measured FLOP/s (XLA's compiled cost analysis,
+analytic fallback) over the chip's peak bf16 FLOP/s — judgeable against
+the chip itself, not just GPU folklore.
+
+Environment hardening (VERDICT.md round 1): the TPU backend behind the
+axon tunnel can be transiently UNAVAILABLE; round 1 died on the first
+``jax.devices()`` (BENCH_r01 rc=1).  The orchestrator process retries
+the whole benchmark in fresh subprocesses with backoff — backend-init
+failure state is per-process, so a fresh interpreter is the only clean
+retry — and only after all attempts falls back to a clearly-labeled CPU
+run (set ``BENCH_ALLOW_CPU_FALLBACK=0`` to fail hard instead).
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}
+
+Env knobs: BENCH_WORKLOAD, BENCH_BATCH, BENCH_STEPS, BENCH_DEPTH,
+BENCH_SEQ, BENCH_MAX_ATTEMPTS, BENCH_ATTEMPT_TIMEOUT,
+BENCH_ALLOW_CPU_FALLBACK.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-import jax
-import jax.numpy as jnp
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO_ROOT)
 
 GPU_BASELINE_IMAGES_PER_SEC = 383.0  # V100 TF ResNet-50, per accelerator
+LM_BASELINE_MFU = 0.40  # strong published LLM-training MFU on TPU
+
+# Peak dense bf16 FLOP/s per chip by TPU generation.
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+}
+
+# Ordered patterns against the normalized device_kind ("TPU v5 lite" ->
+# "tpuv5lite", "TPU v5p" -> "tpuv5p", ...).  "lite" forms first so v5p
+# never shadows them.
+_KIND_PATTERNS = (
+    ("v6lit", "v6e"),  # "TPU v6 lite" / "TPU v6e"
+    ("v6e", "v6e"),
+    ("v5lit", "v5e"),  # "TPU v5 lite" / "v5litepod"
+    ("v5e", "v5e"),
+    ("v5p", "v5p"),
+    ("v4", "v4"),
+)
 
 
-def main():
+def _chip_peak_flops(device):
+    """(peak bf16 FLOP/s, source) for the attached chip.
+
+    source is "device_kind" / "env" / "default" — "default" marks a
+    GUESSED v5e peak, surfaced in the JSON so an unmatched chip never
+    carries a confident-but-wrong MFU.
+    """
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    kind = kind.replace(" ", "").replace("-", "").replace("_", "")
+    for pat, gen in _KIND_PATTERNS:
+        if pat in kind:
+            return PEAK_BF16_FLOPS[gen], "device_kind"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    if gen in PEAK_BF16_FLOPS:
+        return PEAK_BF16_FLOPS[gen], "env"
+    return PEAK_BF16_FLOPS["v5e"], "default"
+
+
+def _compile_step(jitted, *args):
+    """AOT-compile once -> (step callable, FLOPs per step).
+
+    The compiled executable is returned and REUSED for the timing loop —
+    compiling via .lower().compile() solely for cost_analysis would
+    compile the step a second time behind the jit cache.  FLOPs is 0.0
+    when the backend exposes no cost analysis.
+    """
+    try:
+        compiled = jitted.lower(*args).compile()
+    except Exception as e:  # noqa: BLE001 — backend-dependent API
+        print(f"bench: AOT compile unavailable ({e!r})", file=sys.stderr)
+        return jitted, 0.0
+    flops = 0.0
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+    except Exception as e:  # noqa: BLE001
+        print(f"bench: cost_analysis unavailable ({e!r})", file=sys.stderr)
+    return compiled, flops
+
+
+def _run_resnet(on_accel: bool):
+    import jax
+    import jax.numpy as jnp
+
     from container_engine_accelerators_tpu.models import resnet
     from container_engine_accelerators_tpu.models.train import (
         cosine_sgd,
@@ -37,8 +121,6 @@ def main():
         train_step,
     )
 
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
     batch = int(os.environ.get("BENCH_BATCH", "128" if on_accel else "16"))
     steps = int(os.environ.get("BENCH_STEPS", "200" if on_accel else "3"))
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
@@ -66,7 +148,13 @@ def main():
     state = create_train_state(
         model, rng, xs[0], tx=cosine_sgd(total_steps=1000)
     )
-    step_fn = jax.jit(train_step, donate_argnums=(0,))
+    step_fn, flops_per_step = _compile_step(
+        jax.jit(train_step, donate_argnums=(0,)), state, xs[0], ys[0]
+    )
+    if not flops_per_step:
+        # Analytic fallback: ResNet-50 fwd ~= 4.09 GMACs/image at 224px,
+        # train step ~= 3x fwd (bwd ~= 2x), 2 FLOPs per MAC.
+        flops_per_step = 3 * 2 * 4.09e9 * batch * (image_size / 224.0) ** 2
 
     # Compile + warmup.
     state, _ = step_fn(state, xs[0], ys[0])
@@ -81,23 +169,258 @@ def main():
     dt = time.perf_counter() - t0
 
     images_per_sec = batch * steps / dt
+    peak, peak_src = _chip_peak_flops(jax.devices()[0])
+    mfu = (flops_per_step * steps / dt) / peak
     # The CPU fallback times 64px images — a different workload; label the
     # metric so the ratio is never mistaken for chip-vs-GPU parity.
     suffix = "" if on_accel else f"_cpufallback_{image_size}px"
-    print(
-        json.dumps(
-            {
-                "metric": f"resnet{depth}_bf16_train_images_per_sec_1chip"
-                + suffix,
-                "value": round(images_per_sec, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(
-                    images_per_sec / GPU_BASELINE_IMAGES_PER_SEC, 3
-                ),
-            }
-        )
+    return {
+        "metric": f"resnet{depth}_bf16_train_images_per_sec_1chip" + suffix,
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        # CPU fallback times a different workload (64px): no V100 ratio.
+        "vs_baseline": round(
+            images_per_sec / GPU_BASELINE_IMAGES_PER_SEC, 3
+        ) if on_accel else None,
+        "mfu": round(mfu, 4) if on_accel else None,
+        "peak_tflops": peak / 1e12,
+        "peak_source": peak_src,
+    }
+
+
+def _run_lm(on_accel: bool):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+        make_lm_train_step,
+        next_token_targets,
     )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+    from container_engine_accelerators_tpu.parallel import create_mesh
+
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_accel else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "50" if on_accel else "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "4096" if on_accel else "256"))
+    layers = int(os.environ.get("BENCH_LM_LAYERS", "12" if on_accel else "2"))
+
+    lm = transformer_lm(
+        vocab_size=32_768,
+        num_layers=layers,
+        num_heads=16,
+        head_dim=64,
+        mlp_dim=4096,
+        use_flash=True if on_accel else None,
+    )
+    rng = jax.random.PRNGKey(0)
+    n_batches = 4
+    toks = [
+        jax.random.randint(
+            jax.random.PRNGKey(i), (batch, seq), 0, 32_768, jnp.int32
+        )
+        for i in range(n_batches)
+    ]
+    jax.block_until_ready(toks)
+    state = create_lm_train_state(
+        lm, rng, toks[0], tx=optax.adamw(3e-4, weight_decay=0.1)
+    )
+    mesh = create_mesh(data=1, model=1, devices=jax.devices()[:1])
+    step_fn, placed = make_lm_train_step(mesh, state)
+
+    batches = [next_token_targets(t) for t in toks]
+    step_fn, flops_per_step = _compile_step(
+        step_fn, placed, toks[0], batches[0][0], batches[0][1]
+    )
+    n_params = sum(
+        x.size for x in jax.tree_util.tree_leaves(placed.params)
+    )
+    if not flops_per_step:
+        # PaLM-appendix analytic: 6*N per token + causal attention term.
+        flops_per_step = batch * seq * (
+            6 * n_params + 12 * layers * 16 * 64 * seq // 2
+        )
+
+    placed, _ = step_fn(placed, toks[0], *batches[0])
+    for i in range(4 if on_accel else 1):
+        placed, _ = step_fn(placed, toks[i % n_batches], *batches[i % n_batches])
+    jax.block_until_ready(placed.params)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        placed, metrics = step_fn(
+            placed, toks[i % n_batches], *batches[i % n_batches]
+        )
+    jax.block_until_ready(placed.params)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    peak, peak_src = _chip_peak_flops(jax.devices()[0])
+    mfu = (flops_per_step * steps / dt) / peak
+    suffix = "" if on_accel else "_cpufallback"
+    return {
+        "metric": f"lm_{layers}L_flash_bf16_train_tokens_per_sec_1chip"
+        + suffix,
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / LM_BASELINE_MFU, 3) if on_accel else None,
+        "mfu": round(mfu, 4) if on_accel else None,
+        "params": int(n_params),
+        "seq_len": seq,
+        "peak_tflops": peak / 1e12,
+        "peak_source": peak_src,
+    }
+
+
+def inner_main():
+    """One benchmark run in this process; prints the JSON line."""
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+    workload = os.environ.get("BENCH_WORKLOAD", "resnet")
+    if workload == "lm":
+        result = _run_lm(on_accel)
+    else:
+        result = _run_resnet(on_accel)
+    print(json.dumps(result))
+
+
+def _cpu_env() -> dict:
+    from container_engine_accelerators_tpu.utils.cpuenv import cpu_mesh_env
+
+    env = cpu_mesh_env()
+    env["BENCH_INNER"] = "1"
+    return env
+
+
+def _probe_backend(timeout: int) -> bool:
+    """Cheaply check the accelerator backend answers at all.
+
+    The axon failure has TWO modes: fast UNAVAILABLE (BENCH_r01) and an
+    indefinite hang in ``jax.devices()`` (MULTICHIP_r01 rc=124).  The
+    hang mode would burn a whole BENCH_ATTEMPT_TIMEOUT per attempt and
+    blow any outer driver budget, so every attempt starts with this
+    short-timeout probe and only a live backend gets the full benchmark
+    run.
+    """
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; d = jax.devices(); "
+                "print(d[0].platform, len(d))",
+            ],
+            cwd=_REPO_ROOT, capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"bench probe: backend did not answer within {timeout}s "
+            f"(hang mode)",
+            file=sys.stderr,
+        )
+        return False
+    if proc.returncode == 0:
+        print(f"bench probe: backend up ({proc.stdout.strip()})",
+              file=sys.stderr)
+        return True
+    tail = "\n".join(proc.stderr.strip().splitlines()[-5:])
+    print(f"bench probe: backend init failed:\n{tail}", file=sys.stderr)
+    return False
+
+
+def orchestrate() -> int:
+    """Retry the benchmark in fresh subprocesses; CPU-fallback at the end.
+
+    Backend-init failure (UNAVAILABLE) is cached per-process by JAX, so
+    each attempt is a fresh interpreter.
+    """
+    attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "3"))
+    timeout = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "900"))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1800"))
+    backoffs = [10, 30, 60, 90, 120]
+    cmd = [sys.executable, os.path.abspath(__file__)]
+
+    for attempt in range(attempts):
+        if not _probe_backend(probe_timeout):
+            if attempt + 1 < attempts:
+                wait = backoffs[min(attempt, len(backoffs) - 1)]
+                print(f"bench: retrying probe in {wait}s", file=sys.stderr)
+                time.sleep(wait)
+            continue
+        env = dict(os.environ)
+        env["BENCH_INNER"] = "1"
+        try:
+            proc = subprocess.run(
+                cmd, env=env, cwd=_REPO_ROOT, capture_output=True,
+                text=True, timeout=timeout,
+            )
+        except subprocess.TimeoutExpired:
+            print(
+                f"bench attempt {attempt + 1}/{attempts}: timed out after "
+                f"{timeout}s",
+                file=sys.stderr,
+            )
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            sys.stderr.write(proc.stderr)
+            print(proc.stdout.strip().splitlines()[-1])
+            return 0
+        tail = "\n".join(proc.stderr.strip().splitlines()[-15:])
+        print(
+            f"bench attempt {attempt + 1}/{attempts} failed "
+            f"(rc={proc.returncode}):\n{tail}",
+            file=sys.stderr,
+        )
+        transient = (
+            "UNAVAILABLE" in proc.stderr
+            or "Unable to initialize backend" in proc.stderr
+            or "DEADLINE_EXCEEDED" in proc.stderr
+        )
+        if not transient and attempt >= 1:
+            break  # persistent failure — stop burning attempts
+        if attempt + 1 >= attempts:
+            break  # last attempt: no point sleeping before the fallback
+        wait = backoffs[min(attempt, len(backoffs) - 1)]
+        print(
+            f"bench: TPU backend unavailable; retrying in {wait}s "
+            f"(diagnostics above; tunnel may still be warming)",
+            file=sys.stderr,
+        )
+        time.sleep(wait)
+
+    if os.environ.get("BENCH_ALLOW_CPU_FALLBACK", "1") != "1":
+        print("bench: all TPU attempts failed; fallback disabled",
+              file=sys.stderr)
+        return 1
+    print(
+        "bench: all TPU attempts failed — falling back to a LABELED CPU "
+        "run (metric name carries _cpufallback)",
+        file=sys.stderr,
+    )
+    try:
+        proc = subprocess.run(
+            cmd, env=_cpu_env(), cwd=_REPO_ROOT, capture_output=True,
+            text=True, timeout=cpu_timeout,
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: CPU fallback timed out", file=sys.stderr)
+        return 1
+    sys.stderr.write(proc.stderr)
+    if proc.returncode == 0 and proc.stdout.strip():
+        print(proc.stdout.strip().splitlines()[-1])
+        return 0
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_INNER") == "1":
+        inner_main()
+    else:
+        sys.exit(orchestrate())

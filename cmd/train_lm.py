@@ -68,7 +68,6 @@ def main(argv=None):
     from container_engine_accelerators_tpu.models.lm_train import (
         create_lm_train_state,
         make_lm_train_step,
-        next_token_targets,
     )
     from container_engine_accelerators_tpu.models.transformer import (
         transformer_lm,
@@ -130,17 +129,42 @@ def main(argv=None):
             log.info("resuming from checkpoint at step %d", start_step)
 
     # Rotate distinct synthetic batches (see bench.py on why).
-    np_rng = np.random.default_rng(0)
+    #
+    # Multi-host: the step's in_shardings span the FULL mesh, so inputs
+    # must be global jax.Arrays. Every process generates the identical
+    # global numpy batch (same seed), labels/mask are derived globally
+    # (the label of a sequence shard's last position lives in the next
+    # shard), and make_array_from_callback assembles the device-local
+    # shards — the multi-host pipeline train_resnet.py uses, adapted to
+    # sequence sharding.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS
+
+    spec = P(None, DATA_AXIS) if seq_parallel else P(DATA_AXIS)
+    data_sh = NamedSharding(mesh, spec)
+
+    def globalize(global_np):
+        if num_procs == 1:
+            return jax.device_put(jnp.asarray(global_np), data_sh)
+        return jax.make_array_from_callback(
+            global_np.shape, data_sh, lambda idx: global_np[idx]
+        )
+
+    np_rng = np.random.default_rng(0)  # same seed everywhere: global batch
     n_batches = 4
     batches = []
     for _ in range(n_batches):
-        toks = jnp.asarray(
-            np_rng.integers(0, args.vocab_size,
-                            (args.train_batch_size, args.seq_len)),
-            jnp.int32,
+        toks = np_rng.integers(
+            0, args.vocab_size, (args.train_batch_size, args.seq_len)
+        ).astype(np.int32)
+        # numpy mirror of next_token_targets on the GLOBAL sequence
+        labels = np.roll(toks, -1, axis=1)
+        mask = np.ones(toks.shape, np.float32)
+        mask[:, -1] = 0.0
+        batches.append(
+            (globalize(toks), globalize(labels), globalize(mask))
         )
-        labels, mask = next_token_targets(toks)
-        batches.append((toks, labels, mask))
 
     t0 = time.perf_counter()
     tokens_per_batch = args.train_batch_size * args.seq_len

@@ -247,8 +247,14 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, positions)
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
-        # Final projection in f32 for a numerically stable softmax loss.
-        return emb.attend(x.astype(jnp.float32))
+        # Final projection in TRUE f32 for a numerically stable softmax
+        # loss: Embed.attend would promote the query back to the module
+        # dtype (bf16), so tie the weights manually with both operands
+        # cast to f32.
+        return jnp.dot(
+            x.astype(jnp.float32),
+            emb.embedding.T.astype(jnp.float32),
+        )
 
 
 def transformer_lm(**kwargs) -> TransformerLM:

@@ -13,10 +13,14 @@ MXU matmuls with f32 accumulation (``preferred_element_type``), the
 exp/max/rescale chain runs on the VPU, and the causal path skips K
 blocks entirely above the diagonal (not just masks them), halving work.
 
-The op is differentiable via ``jax.custom_vjp``: the backward pass
-recomputes attention with plain jnp ops (the standard recompute trick —
-nothing is saved but q/k/v) and lets XLA differentiate that; forward
-speed is where the kernel matters for training steps.
+The op is differentiable via ``jax.custom_vjp`` with Pallas **backward
+kernels** (FlashAttention-2 style): the forward additionally saves the
+per-row logsumexp; the backward recomputes attention probabilities
+*inside VMEM per block* from (q, k, v, lse) — never materializing the
+O(T^2) logits in HBM — in two passes: a dQ kernel (grid over Q blocks,
+streaming K/V) and a dK/dV kernel (grid over K blocks, streaming Q/dO).
+Both skip fully-masked blocks under causal attention rather than
+masking them.
 
 Use :func:`flash_attention` directly, or through
 ``models/transformer.py`` which selects it automatically on TPU for
@@ -40,7 +44,8 @@ BLOCK_Q = 128
 BLOCK_K = 128
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
+               block_k):
     """One grid step: q block (i) of one batch*head against all K/V."""
     q_i = pl.program_id(1)
     q = q_ref[0]  # [BQ, D] — keep the input precision: bf16 operands run
@@ -85,69 +90,235 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, causal, scale, block_k):
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
     acc0 = jnp.zeros((bq, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, nk_run, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, nk_run, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _to_bh(x):  # [B, T, H, D] -> [B*H, T, D]
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bh(x, b, h):  # [B*H, T, D] -> [B, T, H, D]
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
 def _fa_forward(q, k, v, causal, scale, interpret):
-    """Pallas forward on [B, T, H, D] inputs."""
+    """Pallas forward on [B, T, H, D] inputs -> (out, lse [B*H, T])."""
     b, t, h, d = q.shape
-
-    def to_bh(x):  # [B, T, H, D] -> [B*H, T, D]
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    qf, kf, vf = to_bh(q), to_bh(k), to_bh(v)
+    qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
     grid = (b * h, t // BLOCK_Q)
     kernel = functools.partial(
         _fa_kernel, causal=causal, scale=scale, block_k=BLOCK_K
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, BLOCK_Q), lambda bh, i: (bh, i)),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return _from_bh(out, b, h), lse
 
 
-def _dense_ref(q, k, v, causal, scale):
-    """Recompute-backward reference: the shared dense_attention numerics
-    (parallel/seq.py is the single source of attention math)."""
-    from container_engine_accelerators_tpu.parallel.seq import (
-        dense_attention,
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
+                      *, causal, scale, block_k):
+    """dQ for one Q block: stream K/V blocks, recompute P from lse.
+
+    ds = P * (dP - delta) * scale with dP = dO V^T and
+    delta_i = dO_i . O_i; dQ = ds K — all products MXU matmuls with f32
+    accumulation, P/ds cast to the input dtype for full-rate MXU.
+    """
+    q_i = pl.program_id(1)
+    q = q_ref[0]  # [BQ, D]
+    do = do_ref[0]
+    o = o_ref[0]
+    lse = lse_ref[0]  # [BQ] f32
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    nk = t // block_k
+
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=1
+    )  # [BQ]
+
+    if causal:
+        last_row = q_i * bq + (bq - 1)
+        nk_run = last_row // block_k + 1
+    else:
+        nk_run = nk
+
+    def body(j, dq_acc):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK] f32
+        if causal:
+            rows = q_i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0
+            )
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [BQ, BK] f32; 0 where masked
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    dq = jax.lax.fori_loop(0, nk_run, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fa_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
+                       dk_ref, dv_ref, *, causal, scale, block_q):
+    """dK/dV for one K block: stream Q/dO/O/lse blocks.
+
+    dV = P^T dO; dK = ds^T Q.  Under causal attention, Q blocks strictly
+    above this K block's diagonal are skipped (their P column-block is
+    all zero), so the loop starts at the diagonal.
+    """
+    k_j = pl.program_id(1)
+    k = k_ref[0]  # [BK, D]
+    v = v_ref[0]
+    bk, d = k.shape
+    t = q_ref.shape[1]
+    nq = t // block_q
+
+    start = (k_j * bk) // block_q if causal else 0
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
+        o_blk = o_ref[0, pl.ds(i * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = jnp.sum(
+            do_blk.astype(jnp.float32) * o_blk.astype(jnp.float32), axis=1
+        )  # [BQ]
+        s = scale * jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK] f32
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0
+            )
+            cols = k_j * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse_blk[:, None])  # [BQ, BK]
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BK, D]
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BK, D]
+        return dk_acc, dv_acc
+
+    z = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, nq, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret):
+    """Pallas backward on [B, T, H, D] primals; lse is [B*H, T] f32."""
+    b, t, h, d = q.shape
+    qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
+    of, gf = _to_bh(o), _to_bh(g)
+
+    full = pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0))
+    blk_q = pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0))
+    blk_k = pl.BlockSpec((1, BLOCK_K, d), lambda bh, i: (bh, i, 0))
+    lse_full = pl.BlockSpec((1, t), lambda bh, i: (bh, 0))
+    lse_blk = pl.BlockSpec((1, BLOCK_Q), lambda bh, i: (bh, i))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dq_kernel, causal=causal, scale=scale, block_k=BLOCK_K
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, t // BLOCK_Q),
+        in_specs=[blk_q, full, full, blk_q, blk_q, lse_blk],
+        out_specs=blk_q,
+        interpret=interpret,
+    )(qf, kf, vf, gf, of, lse)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _fa_bwd_dkv_kernel, causal=causal, scale=scale,
+            block_q=BLOCK_Q,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
+        ),
+        grid=(b * h, t // BLOCK_K),
+        in_specs=[blk_k, blk_k, full, full, full, lse_full],
+        out_specs=(blk_k, blk_k),
+        interpret=interpret,
+    )(kf, vf, qf, gf, of, lse)
+
+    return (
+        _from_bh(dq, b, h),
+        _from_bh(dk, b, h),
+        _from_bh(dv, b, h),
     )
-
-    return dense_attention(q, k, v, causal=causal, scale=scale)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
     """Flash attention on [B, T, H, D]; T must be a multiple of 128.
 
-    ``interpret=True`` runs the kernel in the Pallas interpreter
+    ``interpret=True`` runs the kernels in the Pallas interpreter
     (hardware-free, used by the test suite).
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _fa_forward(q, k, v, causal, scale, interpret)
+    out, _ = _fa_forward(q, k, v, causal, scale, interpret)
+    return out
 
 
 def _fa_fwd(q, k, v, causal, scale, interpret):
-    return flash_attention(q, k, v, causal, scale, interpret), (q, k, v)
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _fa_forward(q, k, v, causal, scale_, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, interpret, res, g):
-    q, k, v = res
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    _, vjp = jax.vjp(
-        lambda q, k, v: _dense_ref(q, k, v, causal, scale), q, k, v
-    )
-    return vjp(g)
+    q, k, v, o, lse = res
+    scale_ = scale if scale is not None else q.shape[-1] ** -0.5
+    return _fa_backward(q, k, v, o, lse, g, causal, scale_, interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -73,22 +73,31 @@ def dense_attention(q, k, v, causal=False, scale=None):
     return o.astype(q.dtype)
 
 
-def _block_attend(q, k, v, m, l, o, causal_mask=None):
-    """One flash-attention accumulation step against a K/V block.
+# Largest K-chunk a ring step scores at once: bounds the live logits
+# intermediate to [B, H, Tq, RING_CHUNK] f32 — O(Tq) per device, never
+# O(Tq * Tk) — so ring memory stays linear in the sequence shard.
+RING_CHUNK = 512
 
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; m/l running max/denominator
+
+def _chunk_attend(q, k, v, m, l, o, q_pos=None, k_pos=None):
+    """One flash-attention accumulation step against ONE K/V chunk.
+
+    q: [B, Tq, H, D]; k/v: [B, C, H, D]; m/l running max/denominator
     float32 [B, H, Tq]; o unnormalized f32 accumulator [B, Tq, H, D].
     Statistics run in f32 so the ring result matches
     :func:`dense_attention` in bf16; the QK/PV matmuls keep the input
     precision with f32 accumulation (``preferred_element_type``).
+    ``q_pos``/``k_pos`` are global token positions; when given, keys at
+    positions above the query are causally masked.
     """
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     )
-    if causal_mask is not None:
-        s = jnp.where(causal_mask, s, NEG_INF)
+    if q_pos is not None:
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, C]
+        s = jnp.where(mask[None, None], s, NEG_INF)
     m_new = jnp.maximum(m, s.max(axis=-1))
-    # Rescale previous accumulator to the new max, then add this block.
+    # Rescale previous accumulator to the new max, then add this chunk.
     correction = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new[..., None])
     l_new = l * correction + p.sum(axis=-1)
@@ -99,25 +108,108 @@ def _block_attend(q, k, v, m, l, o, causal_mask=None):
     return m_new, l_new, o_new
 
 
-def ring_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    axis_name: str,
-    causal: bool = False,
-    scale: Optional[float] = None,
-) -> jax.Array:
-    """Ring self-attention over a sequence-sharded axis.
+def _chunks_of(tk: int) -> tuple:
+    """(chunk, nc) splitting a K block of tk columns into RING_CHUNK runs
+    (single chunk when ragged — correct, more memory)."""
+    chunk = min(tk, RING_CHUNK)
+    if tk % chunk:
+        chunk = tk
+    return chunk, tk // chunk
 
-    Call inside ``shard_map``; q/k/v are the per-device sequence shards
-    ``[batch, seq/n, heads, head_dim]``.  K/V rotate n-1 times via
-    ``ppermute`` to the next ring neighbor; a ``lax.scan`` over ring
-    steps keeps the jitted program free of Python-level unrolling.
+
+def _block_attend(q, k, v, m, l, o, q_pos=None, k_pos=None):
+    """Accumulate attention of resident Q against one ring K/V block,
+    streaming the block in RING_CHUNK-sized K chunks (flash-style inner
+    loop) so the score intermediate never materializes [Tq, Tk].
     """
+    chunk, nc = _chunks_of(k.shape[1])
+    if nc == 1:
+        return _chunk_attend(q, k, v, m, l, o, q_pos, k_pos)
+
+    def body(c, carry):
+        m, l, o = carry
+        k_blk = lax.dynamic_slice_in_dim(k, c * chunk, chunk, axis=1)
+        v_blk = lax.dynamic_slice_in_dim(v, c * chunk, chunk, axis=1)
+        kp = (
+            lax.dynamic_slice_in_dim(k_pos, c * chunk, chunk, axis=0)
+            if k_pos is not None
+            else None
+        )
+        return _chunk_attend(q, k_blk, v_blk, m, l, o, q_pos, kp)
+
+    return lax.fori_loop(0, nc, body, (m, l, o))
+
+
+def _block_backward(q_s, do, delta, lse, k_blk, v_blk, scale, axis_name,
+                    q_pos=None, k_pos=None):
+    """Gradient contributions of one ring K/V block (FA2-style recompute).
+
+    q_s is the pre-scaled query shard; lse/delta are [B, H, Tq] f32 row
+    statistics (logsumexp of the scaled logits; rowsum(do*o)).  Returns
+    (dq_partial [B,Tq,H,D] f32, dk_blk [B,Tk,H,D] f32, dv_blk same):
+    P is recomputed chunk-by-chunk from lse — the O(T^2) matrix never
+    exists in HBM, forward or backward.
+    """
+    tk = k_blk.shape[1]
+    chunk, nc = _chunks_of(tk)
+    b, tq, h, d = q_s.shape
+
+    def one_chunk(ks, vs, kp):
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q_s, ks, preferred_element_type=jnp.float32
+        )
+        if q_pos is not None:
+            mask = q_pos[:, None] >= kp[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # [B,H,Tq,C]; 0 where masked
+        dv_c = jnp.einsum(
+            "bhqk,bqhd->bkhd", p.astype(do.dtype), do,
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.einsum(
+            "bqhd,bkhd->bhqk", do, vs, preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[..., None])  # d/d(scaled logits)
+        dq_c = scale * jnp.einsum(
+            "bhqk,bkhd->bqhd", ds.astype(ks.dtype), ks,
+            preferred_element_type=jnp.float32,
+        )
+        dk_c = jnp.einsum(
+            "bhqk,bqhd->bkhd", ds.astype(q_s.dtype), q_s,
+            preferred_element_type=jnp.float32,
+        )
+        return dq_c, dk_c, dv_c
+
+    if nc == 1:
+        dq, dk, dv = one_chunk(k_blk, v_blk, k_pos)
+        return dq, dk, dv
+
+    def body(c, carry):
+        dq, dk, dv = carry
+        ks = lax.dynamic_slice_in_dim(k_blk, c * chunk, chunk, axis=1)
+        vs = lax.dynamic_slice_in_dim(v_blk, c * chunk, chunk, axis=1)
+        kp = (
+            lax.dynamic_slice_in_dim(k_pos, c * chunk, chunk, axis=0)
+            if k_pos is not None
+            else None
+        )
+        dq_c, dk_c, dv_c = one_chunk(ks, vs, kp)
+        dk = lax.dynamic_update_slice_in_dim(dk, dk_c, c * chunk, axis=1)
+        dv = lax.dynamic_update_slice_in_dim(dv, dv_c, c * chunk, axis=1)
+        return dq + dq_c, dk, dv
+
+    # Fresh zeros inside shard_map are unvaried constants; the fori_loop
+    # carry must match the varying outputs, so mark them up front.
+    z = _pvary(jnp.zeros((b, tk, h, d), jnp.float32), axis_name)
+    dq0 = _pvary(jnp.zeros((b, tq, h, d), jnp.float32), axis_name)
+    return lax.fori_loop(0, nc, body, (dq0, z, z))
+
+
+def _ring_forward(q, k, v, axis_name, causal, scale):
+    """Ring forward pass -> (out, lse [B, H, Tq] f32)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    scale = scale if scale is not None else q.shape[-1] ** -0.5
-    q = q * scale
+    q_s = q * scale
 
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -138,11 +230,8 @@ def ring_attention(
         src = (idx - step_idx) % n
         if causal:
             k_pos = src * tk + jnp.arange(tk)
-            mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-            mask = mask[None, None, :, :]
-        else:
-            mask = None
-        return _block_attend(q, k_blk, v_blk, m, l, o, mask)
+            return _block_attend(q_s, k_blk, v_blk, m, l, o, q_pos, k_pos)
+        return _block_attend(q_s, k_blk, v_blk, m, l, o)
 
     def step(carry, step_idx):
         m, l, o, k_blk, v_blk = carry
@@ -159,7 +248,98 @@ def ring_attention(
     )
     m, l, o = attend(m, l, o, k_last, v_last, n - 1)
     out = o * (1.0 / l).transpose(0, 2, 1)[..., None]
-    return out.astype(q.dtype)
+    return out.astype(q.dtype), m + jnp.log(l)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_forward(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_attention_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_attention_bwd(axis_name, causal, scale, res, do):
+    """Ring backward: a second ring pass with FA2-style recompute.
+
+    Plain AD through the forward scan would save every chunk's [Tq, C]
+    probabilities as residuals — re-materializing O(Tq*Tk) per device and
+    defeating the long-context point (ADVICE.md round 1) — so the
+    backward instead recomputes P from the saved logsumexp while
+    (k, v, dk, dv) rotate together around the ring: n compute+rotate
+    cycles return each dk/dv block to its home rank fully accumulated.
+    dq accumulates locally.  Twice the forward's ICI traffic (the dk/dv
+    blocks ride along, in f32 so late large contributions still land).
+    """
+    q, k, v, o, lse = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    q_s = q * scale
+    delta = jnp.einsum(
+        "bqhd,bqhd->bhq", do.astype(jnp.float32), o.astype(jnp.float32)
+    )
+    q_pos = idx * tq + jnp.arange(tq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq0 = _pvary(jnp.zeros((b, tq, h, d), jnp.float32), axis_name)
+    dk0 = _pvary(jnp.zeros((b, tk, h, d), jnp.float32), axis_name)
+    dv0 = _pvary(jnp.zeros((b, tk, h, d), jnp.float32), axis_name)
+
+    def step(carry, step_idx):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        src = (idx - step_idx) % n
+        if causal:
+            k_pos = src * tk + jnp.arange(tk)
+            dq_c, dk_c, dv_c = _block_backward(
+                q_s, do, delta, lse, k_blk, v_blk, scale, axis_name,
+                q_pos, k_pos,
+            )
+        else:
+            dq_c, dk_c, dv_c = _block_backward(
+                q_s, do, delta, lse, k_blk, v_blk, scale, axis_name
+            )
+        dq = dq + dq_c
+        dk_blk = dk_blk + dk_c
+        dv_blk = dv_blk + dv_c
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = lax.ppermute(dv_blk, axis_name, perm)
+        return (dq, k_blk, v_blk, dk_blk, dv_blk), None
+
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(n)
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring self-attention over a sequence-sharded axis.
+
+    Call inside ``shard_map``; q/k/v are the per-device sequence shards
+    ``[batch, seq/n, heads, head_dim]``.  K/V rotate n-1 times via
+    ``ppermute`` to the next ring neighbor; a ``lax.scan`` over ring
+    steps keeps the jitted program free of Python-level unrolling.
+    Differentiable with O(seq/n) memory in BOTH directions via a custom
+    VJP (see :func:`_ring_attention_bwd`).
+    """
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _ring_attention(q, k, v, axis_name, causal, scale)
 
 
 def ulysses_attention(

@@ -1,0 +1,45 @@
+"""Build an environment that forces an n-device virtual CPU mesh.
+
+Single source of truth for escaping the axon TPU harness: its
+sitecustomize (keyed off ``PALLAS_AXON_POOL_IPS``) pre-initializes JAX
+with the remote TPU backend at interpreter startup, so CPU-mesh code
+must run in a fresh process with this environment.  Used by
+``__graft_entry__.dryrun_multichip``, ``bench.py``'s CPU fallback, and
+``tests/conftest.py``'s re-exec — keep them in sync by keeping them
+here.
+"""
+
+import os
+import re
+
+# Env vars that arm TPU sitecustomize hooks; removed for CPU subprocesses.
+_TPU_HOOK_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def cpu_mesh_env(n_devices=None, base=None):
+    """Return an env dict forcing the CPU platform.
+
+    ``n_devices``: also force that many virtual CPU devices (rewriting
+    any existing count flag, which may be smaller).  ``base`` defaults to
+    ``os.environ``.
+    """
+    env = dict(os.environ if base is None else base)
+    for var in _TPU_HOOK_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = re.sub(
+            _COUNT_FLAG + r"=\d+", "", env.get("XLA_FLAGS", "")
+        )
+        env["XLA_FLAGS"] = (
+            flags + f" {_COUNT_FLAG}={n_devices}"
+        ).strip()
+    return env
+
+
+def in_tpu_harness(environ=None) -> bool:
+    """True when a TPU sitecustomize hook owns this process's JAX."""
+    environ = os.environ if environ is None else environ
+    return any(environ.get(v) for v in _TPU_HOOK_VARS)

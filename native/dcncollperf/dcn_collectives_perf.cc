@@ -151,6 +151,84 @@ int ConnectTo(const HostPort& hp, double timeout_sec) {
   }
 }
 
+// ---- ring handshake --------------------------------------------------------
+// The listener binds INADDR_ANY, so the first inbound connection could be a
+// port scanner or a misconfigured peer; silently wiring it in as prev-rank
+// would corrupt the benchmark/data check.  Each rank therefore sends a
+// magic+rank header right after connect, and the accept side keeps accepting
+// until it sees the expected prev rank.
+
+constexpr uint32_t kHelloMagic = 0x44434e43;  // "DCNC"
+
+struct Hello {
+  uint32_t magic;
+  int32_t rank;
+};
+
+bool ReadFullTimeout(int fd, char* buf, size_t len, double timeout_sec) {
+  if (timeout_sec <= 0) return false;
+  struct timeval tv;
+  tv.tv_sec = static_cast<time_t>(timeout_sec);
+  tv.tv_usec =
+      static_cast<suseconds_t>((timeout_sec - tv.tv_sec) * 1e6);
+  if (tv.tv_sec == 0 && tv.tv_usec == 0) tv.tv_usec = 1;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  size_t got = 0;
+  while (got < len) {
+    ssize_t k = recv(fd, buf + got, len - got, 0);
+    if (k <= 0) return false;  // EOF, timeout, or error: reject the peer
+    got += static_cast<size_t>(k);
+  }
+  return true;
+}
+
+void SendHello(int fd, int rank) {
+  Hello h{kHelloMagic, rank};
+  const char* p = reinterpret_cast<const char*>(&h);
+  size_t sent = 0;
+  while (sent < sizeof(h)) {
+    ssize_t k = send(fd, p + sent, sizeof(h) - sent, MSG_NOSIGNAL);
+    if (k < 0) Die("handshake send: %s", strerror(errno));
+    sent += static_cast<size_t>(k);
+  }
+}
+
+// Accept until the peer proves it is `want_rank` via the Hello header.
+// The listener is polled with a timeout so the deadline also fires when
+// no peer ever connects (a blocking accept would hang forever).
+int AcceptRank(int lfd, int want_rank, double deadline) {
+  for (;;) {
+    double remain = deadline - NowSec();
+    if (remain <= 0) Die("timed out waiting for prev-rank hello");
+    struct pollfd pfd = {lfd, POLLIN, 0};
+    int ready = poll(&pfd, 1, static_cast<int>(remain * 1000) + 1);
+    if (ready < 0) {
+      if (errno == EINTR) continue;
+      Die("poll(listen): %s", strerror(errno));
+    }
+    if (ready == 0) continue;  // deadline re-checked at loop top
+    int fd = accept(lfd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      Die("accept: %s", strerror(errno));
+    }
+    Hello h{};
+    // Clamp the per-connection handshake budget to the remaining overall
+    // deadline so slow-dripping strays can't starve the real peer.
+    double hs = std::min(5.0, deadline - NowSec());
+    if (ReadFullTimeout(fd, reinterpret_cast<char*>(&h), sizeof(h), hs) &&
+        h.magic == kHelloMagic && h.rank == want_rank) {
+      SetSockOpts(fd);
+      return fd;
+    }
+    fprintf(stderr,
+            "dcn_collectives_perf: rejecting stray connection "
+            "(magic=0x%x rank=%d, want rank %d)\n",
+            h.magic, h.rank, want_rank);
+    close(fd);
+  }
+}
+
 // ---- full-duplex progress engine -------------------------------------------
 // Every ring step sends one chunk to next while receiving one from prev.  A
 // blocking send of a chunk larger than the socket buffer would deadlock the
@@ -331,9 +409,9 @@ int main(int argc, char** argv) {
   ring.nranks = nranks;
   int lfd = ListenOn(hosts[rank]);
   ring.next_fd = ConnectTo(hosts[(rank + 1) % nranks], connect_timeout);
-  ring.prev_fd = accept(lfd, nullptr, nullptr);
-  if (ring.prev_fd < 0) Die("accept: %s", strerror(errno));
-  SetSockOpts(ring.prev_fd);
+  SendHello(ring.next_fd, rank);
+  ring.prev_fd = AcceptRank(lfd, (rank + nranks - 1) % nranks,
+                            NowSec() + connect_timeout);
   close(lfd);
   SetNonBlocking(ring.next_fd, true);
   SetNonBlocking(ring.prev_fd, true);

@@ -8,26 +8,24 @@ it with tempdirs; JAX-level tests run on a virtual 8-device CPU mesh.
 import os
 import sys
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from container_engine_accelerators_tpu.utils.cpuenv import (  # noqa: E402
+    cpu_mesh_env,
+    in_tpu_harness,
+)
+
 # Tests need a virtual 8-device CPU mesh.  Under the axon TPU environment,
 # sitecustomize pre-initializes JAX with the TPU backend before conftest
 # runs, so env changes here are too late — re-exec the test process with
 # the TPU plugin disabled and CPU forced.
-if (
-    os.environ.get("PALLAS_AXON_POOL_IPS")
-    and os.environ.get("CEA_TPU_TESTS") != "1"
-):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (
-            flags + " --xla_force_host_platform_device_count=8"
-        ).strip()
+if in_tpu_harness() and os.environ.get("CEA_TPU_TESTS") != "1":
     os.execve(
         sys.executable,
         [sys.executable, "-m", "pytest"] + sys.argv[1:],
-        env,
+        cpu_mesh_env(8),
     )
 
 # Plain environments: set before jax is imported anywhere.
@@ -37,10 +35,6 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if REPO_ROOT not in sys.path:
-    sys.path.insert(0, REPO_ROOT)
 
 import pytest  # noqa: E402
 

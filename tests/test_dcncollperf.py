@@ -75,6 +75,51 @@ def test_two_rank_ring():
     assert summary["nranks"] == 2 and summary["value"] > 0
 
 
+def test_stray_connection_rejected():
+    """A stray connection (port scanner / misconfigured peer) must not be
+    wired in as prev-rank: the ring handshakes magic+rank after accept and
+    keeps accepting until the true peer arrives."""
+    import time
+
+    ports = _free_ports(2)
+    hosts = ",".join(f"127.0.0.1:{p}" for p in ports)
+    # Start rank 0 alone so its listener is up, then poke it with garbage
+    # before rank 1 exists.
+    p0 = subprocess.Popen(
+        [BIN, "--op", "all_reduce", "--rank", "0", "--hosts", hosts,
+         "-b", "4K", "-e", "4K", "-n", "2", "-w", "0", "-c", "1",
+         "--connect_timeout", "30"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.time() + 10
+    stray = None
+    while time.time() < deadline:
+        try:
+            stray = socket.create_connection(("127.0.0.1", ports[0]),
+                                             timeout=1)
+            break
+        except OSError:
+            time.sleep(0.05)
+    assert stray is not None, "rank 0 listener never came up"
+    stray.sendall(b"GET / HTTP/1.1\r\n\r\n")  # wrong magic
+    p1 = subprocess.Popen(
+        [BIN, "--op", "all_reduce", "--rank", "1", "--hosts", hosts,
+         "-b", "4K", "-e", "4K", "-n", "2", "-w", "0", "-c", "1",
+         "--connect_timeout", "30"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    out0, err0 = p0.communicate(timeout=120)
+    out1, err1 = p1.communicate(timeout=120)
+    stray.close()
+    assert p0.returncode == 0, f"rank0: {err0}"
+    assert p1.returncode == 0, f"rank1: {err1}"
+    assert "rejecting stray connection" in err0
+    # Data check still exact: the stray bytes never entered the ring.
+    rows = [l for l in out0.splitlines()
+            if l.startswith("  ") and l.strip()[0].isdigit()]
+    assert rows and all(r.split()[-1] == "0" for r in rows)
+
+
 def test_rejects_bad_flags():
     proc = subprocess.run([BIN, "--op", "broadcast"], capture_output=True,
                           text=True)
