@@ -100,6 +100,11 @@ class DevicePluginClient:
             request_serializer=pb.AllocateRequest.SerializeToString,
             response_deserializer=pb.AllocateResponse.FromString,
         )
+        self.get_preferred_allocation = channel.unary_unary(
+            p + "GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
         self.pre_start_container = channel.unary_unary(
             p + "PreStartContainer",
             request_serializer=pb.PreStartContainerRequest.SerializeToString,
@@ -130,6 +135,11 @@ def register_with_v1beta1_kubelet(
                 version=API_VERSION,
                 endpoint=plugin_endpoint,
                 resource_name=resource_name,
+                # The kubelet only calls GetPreferredAllocation when the
+                # registration advertises it.
+                options=pb.DevicePluginOptions(
+                    get_preferred_allocation_available=True
+                ),
             ),
             timeout=10,
         )

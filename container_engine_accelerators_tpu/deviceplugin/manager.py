@@ -29,7 +29,7 @@ from typing import Dict, List, Optional
 
 import grpc
 
-from container_engine_accelerators_tpu.deviceplugin import api
+from container_engine_accelerators_tpu.deviceplugin import api, preferred
 from container_engine_accelerators_tpu.partition.subslice import (
     SubsliceDeviceManager,
 )
@@ -207,6 +207,63 @@ class TpuManager:
             )
         node = os.path.join(self.dev_directory, device_id)
         return [DeviceSpec(host_path=node, container_path=node, permissions="mrw")]
+
+    def preferred_allocation(
+        self,
+        available_ids: List[str],
+        must_include_ids: List[str],
+        allocation_size: int,
+    ) -> List[str]:
+        """ICI-contiguous preferred set for the kubelet's
+        GetPreferredAllocation hook.
+
+        The reference no-ops this (beta_plugin.go:95-103) — host GPUs are
+        interchangeable; TPU chips on an ICI mesh are not.  Device IDs map
+        to mesh coordinates (sub-slices to their tile centroid, vtpus to
+        their physical device) and the most compact set wins.
+        """
+        coords = self._device_coords(available_ids)
+        return preferred.choose_preferred(
+            available_ids, must_include_ids, allocation_size, coords
+        )
+
+    def _device_coords(
+        self, device_ids: List[str]
+    ) -> Optional[Dict[str, preferred.Coord]]:
+        """Map advertised device IDs to ICI coordinates; None without a
+        topology backend."""
+        if self.lib is None:
+            return None
+        try:
+            chip_coords = {c.name: c.coords for c in self.lib.chips()}
+        except Exception as e:  # noqa: BLE001 — never fail an allocation
+            log.error("preferred-allocation topology query failed: %s", e)
+            return None
+        out: Dict[str, preferred.Coord] = {}
+        for did in device_ids:
+            try:
+                phys = did
+                if self.config.sharing.max_shared_clients_per_tpu > 0 and (
+                    "/" in did
+                ):
+                    phys = virtual_to_physical_device_id(did)
+                if self.config.partition_size and self.subslice_manager:
+                    members = self.subslice_manager.members(phys)
+                    if not members:
+                        return None
+                    out[did] = tuple(
+                        sum(c.coords[axis] for c in members) / len(members)
+                        for axis in range(3)
+                    )
+                elif phys in chip_coords:
+                    out[did] = tuple(float(v) for v in chip_coords[phys])
+                else:
+                    return None
+            except ValueError:
+                # Malformed ID: degrade to the no-topology fallback rather
+                # than failing the kubelet's RPC.
+                return None
+        return out
 
     def envs(self, request_device_ids: List[str]) -> Dict[str, str]:
         """Env contract for a container allocation.

@@ -30,7 +30,10 @@ class DevicePluginService:
     # -- small RPCs ----------------------------------------------------------
 
     def GetDevicePluginOptions(self, request, context):
-        return pb.DevicePluginOptions()
+        # Unlike the reference (beta_plugin.go:95-103, a no-op because host
+        # GPUs are interchangeable), TPU chips sit on an ICI mesh, so the
+        # plugin opts into the kubelet's preferred-allocation hook.
+        return pb.DevicePluginOptions(get_preferred_allocation_available=True)
 
     def PreStartContainer(self, request, context):
         log.error(
@@ -40,11 +43,20 @@ class DevicePluginService:
         return pb.PreStartContainerResponse()
 
     def GetPreferredAllocation(self, request, context):
-        log.error(
-            "device-plugin: GetPreferredAllocation should NOT be called for "
-            "the GKE TPU device plugin"
-        )
-        return pb.PreferredAllocationResponse()
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            ids = self.manager.preferred_allocation(
+                list(creq.available_deviceIDs),
+                list(creq.must_include_deviceIDs),
+                creq.allocation_size,
+            )
+            log.info(
+                "device-plugin: preferred allocation of %d from %d "
+                "available: %s",
+                creq.allocation_size, len(creq.available_deviceIDs), ids,
+            )
+            resp.container_responses.add().deviceIDs.extend(ids)
+        return resp
 
     # -- ListAndWatch --------------------------------------------------------
 

@@ -124,6 +124,10 @@ class SubsliceDeviceManager:
         if device_id in self.devices:
             self.devices[device_id].health = health
 
+    def members(self, device_id: str) -> List[ChipInfo]:
+        """Member chips of partition ``device_id`` ([] when unknown)."""
+        return list(self._members.get(device_id, []))
+
     def slice_for_chip(self, chip_name: str) -> Optional[str]:
         """Which partition owns chip ``accelN`` (for health-event routing)."""
         for slice_id, members in self._members.items():

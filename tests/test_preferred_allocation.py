@@ -1,0 +1,177 @@
+"""GetPreferredAllocation: ICI-contiguous preferred sets.
+
+The reference no-ops this hook (beta_plugin.go:95-103); the TPU plugin
+implements it for real — chips on an ICI mesh are not interchangeable.
+Unit tests cover the chooser; gRPC tests drive the real service over the
+2x2 sysfs fixture like the rest of the device-plugin suite.
+"""
+
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    deviceplugin_v1beta1_pb2 as pb,
+)
+from container_engine_accelerators_tpu.deviceplugin.preferred import (
+    choose_preferred,
+    natural_key,
+    pairwise_distance,
+)
+from tests.test_device_plugin import PluginHarness
+
+# 2x2x1 mesh, row-major like tpulib.write_fixture: accel0=(0,0) accel1=(1,0)
+# accel2=(0,1) accel3=(1,1).
+GRID_2X2 = {
+    "accel0": (0.0, 0.0, 0.0),
+    "accel1": (1.0, 0.0, 0.0),
+    "accel2": (0.0, 1.0, 0.0),
+    "accel3": (1.0, 1.0, 0.0),
+}
+
+# 4x2x1 mesh, row-major.
+GRID_4X2 = {
+    f"accel{i}": (float(i % 4), float(i // 4), 0.0) for i in range(8)
+}
+
+
+# ---- chooser units ---------------------------------------------------------
+
+
+def test_natural_key_orders_numerically():
+    ids = ["accel10", "accel2", "accel1"]
+    assert sorted(ids, key=natural_key) == ["accel1", "accel2", "accel10"]
+
+
+def test_pairwise_distance():
+    assert pairwise_distance([(0, 0, 0), (1, 0, 0), (0, 1, 0)]) == 4.0
+
+
+@pytest.mark.parametrize(
+    "available,size,expect",
+    [
+        # Adjacent pair beats diagonal: accel0+accel1 (dist 1), never 0+3.
+        (["accel0", "accel3", "accel1"], 2, ["accel0", "accel1"]),
+        # Two distance-1 pairs tie ({0,2} and {2,3}); deterministic first
+        # combination wins — never the diagonal {0,3}.
+        (["accel0", "accel2", "accel3"], 2, ["accel0", "accel2"]),
+        # Whole mesh when size == available.
+        (list(GRID_2X2), 4, ["accel0", "accel1", "accel2", "accel3"]),
+    ],
+)
+def test_choose_contiguous_on_2x2(available, size, expect):
+    assert choose_preferred(available, [], size, GRID_2X2) == expect
+
+
+def test_must_include_is_honored():
+    got = choose_preferred(list(GRID_2X2), ["accel3"], 2, GRID_2X2)
+    assert "accel3" in got and len(got) == 2
+    # Best partner for the (1,1) corner is an adjacent chip, not (0,0).
+    assert got != ["accel0", "accel3"]
+
+
+def test_compact_square_beats_scattered_on_4x2():
+    # Free: a 2x2 square (0,1,4,5) plus two far chips (3,7).  The square
+    # (total pairwise distance 4+2 = 8... compute: (0,0),(1,0),(0,1),(1,1)
+    # -> 8) must win over any set using the far column.
+    avail = ["accel0", "accel1", "accel4", "accel5", "accel3", "accel7"]
+    got = choose_preferred(avail, [], 4, GRID_4X2)
+    assert got == ["accel0", "accel1", "accel4", "accel5"]
+
+
+def test_no_coords_falls_back_to_natural_order():
+    got = choose_preferred(["accel10", "accel2", "accel0"], [], 2, None)
+    assert got == ["accel0", "accel2"]
+
+
+def test_unknown_coord_falls_back():
+    coords = {"accel0": (0.0, 0.0, 0.0)}  # accel1 missing
+    got = choose_preferred(["accel1", "accel0"], [], 1, coords)
+    assert got == ["accel0"]
+
+
+def test_oversized_request_returns_all_available():
+    got = choose_preferred(["accel0", "accel1"], [], 5, GRID_2X2)
+    assert got == ["accel0", "accel1"]
+
+
+def test_zero_size():
+    assert choose_preferred(["accel0"], [], 0, GRID_2X2) == []
+
+
+def test_greedy_path_matches_exact_on_grid():
+    # Force the greedy path by shrinking the exact-search limit.
+    import container_engine_accelerators_tpu.deviceplugin.preferred as mod
+
+    old = mod._EXACT_SEARCH_LIMIT
+    try:
+        exact = choose_preferred(list(GRID_4X2), [], 4, GRID_4X2)
+        mod._EXACT_SEARCH_LIMIT = 0
+        greedy = choose_preferred(list(GRID_4X2), [], 4, GRID_4X2)
+    finally:
+        mod._EXACT_SEARCH_LIMIT = old
+    assert pairwise_distance([GRID_4X2[d] for d in greedy]) == (
+        pairwise_distance([GRID_4X2[d] for d in exact])
+    )
+
+
+# ---- gRPC integration over the sysfs fixture -------------------------------
+
+
+def preferred_ids(harness, available, must=(), size=1):
+    req = pb.PreferredAllocationRequest()
+    creq = req.container_requests.add()
+    creq.available_deviceIDs.extend(available)
+    creq.must_include_deviceIDs.extend(must)
+    creq.allocation_size = size
+    resp = harness.client.get_preferred_allocation(req, timeout=5)
+    assert len(resp.container_responses) == 1
+    return list(resp.container_responses[0].deviceIDs)
+
+
+def test_options_advertise_preferred_allocation(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        opts = h.client.get_device_plugin_options(pb.Empty(), timeout=5)
+        assert opts.get_preferred_allocation_available
+        assert h.register_request.options.get_preferred_allocation_available
+
+
+def test_grpc_prefers_adjacent_chips(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        got = preferred_ids(
+            h, ["accel0", "accel3", "accel1"], size=2
+        )
+        assert got == ["accel0", "accel1"]
+
+
+def test_grpc_must_include(tmp_path):
+    with PluginHarness(tmp_path) as h:
+        got = preferred_ids(
+            h, ["accel0", "accel1", "accel2", "accel3"],
+            must=["accel2"], size=2,
+        )
+        assert "accel2" in got and len(got) == 2
+
+
+def test_grpc_time_sharing_packs_same_chip(tmp_path):
+    cfg = {
+        "TPUSharingConfig": {
+            "TPUSharingStrategy": "core-sharing",
+            "MaxSharedClientsPerTPU": 2,
+        }
+    }
+    with PluginHarness(tmp_path, config_json=cfg, num_chips=1) as h:
+        got = preferred_ids(
+            h,
+            ["accel0/vtpu0", "accel0/vtpu1"],
+            size=2,
+        )
+        assert got == ["accel0/vtpu0", "accel0/vtpu1"]
+
+
+def test_grpc_partitioned_prefers_adjacent_slices(tmp_path):
+    # 2x2 host tiled 1x1 -> slice0..slice3 at the chip coordinates.
+    cfg = {"TPUPartitionSize": "1x1"}
+    with PluginHarness(tmp_path, config_json=cfg) as h:
+        got = preferred_ids(
+            h, ["slice0", "slice3", "slice1"], size=2
+        )
+        assert got == ["slice0", "slice1"]
