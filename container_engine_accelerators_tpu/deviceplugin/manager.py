@@ -38,6 +38,7 @@ from container_engine_accelerators_tpu.sharing import (
     virtual_device_ids,
     virtual_to_physical_device_id,
 )
+from container_engine_accelerators_tpu.sharing.gate import CoreSharingGate
 from container_engine_accelerators_tpu.tpulib.types import TpuLib
 from container_engine_accelerators_tpu.utils.config import TPUConfig
 from container_engine_accelerators_tpu.utils.device import (
@@ -85,6 +86,7 @@ class TpuManager:
             SubsliceDeviceManager(lib, dev_directory) if lib is not None else None
         )
         self.total_hbm_per_chip = 0
+        self.sharing_gate: Optional[CoreSharingGate] = None
         self.grpc_server: Optional[grpc.Server] = None
         self.socket: str = ""
         self.device_check_interval_s = device_check_interval_s
@@ -147,6 +149,11 @@ class TpuManager:
                     f"core-sharing requires a valid hbm_total_bytes for "
                     f"{first_chip}; node sysfs contract is incomplete"
                 )
+            # isMpsHealthy analog (manager.go:376-386): prove the
+            # co-tenancy mechanism is enforceable before advertising
+            # shared devices.
+            self.sharing_gate = CoreSharingGate(self.mount_paths)
+            self.sharing_gate.verify()
 
     # ---- device views ------------------------------------------------------
 
@@ -186,6 +193,12 @@ class TpuManager:
                 self.subslice_manager.set_device_health(name, health)
 
     # ---- allocate path -----------------------------------------------------
+
+    def verify_allocatable(self) -> None:
+        """Pre-Allocate gate: under core-sharing, re-check the co-tenancy
+        mechanism is still enforceable (ValueError rejects the request)."""
+        if self.sharing_gate is not None:
+            self.sharing_gate.check_allocatable()
 
     def device_spec(self, device_id: str) -> List[DeviceSpec]:
         """Map one requested device ID to its device nodes

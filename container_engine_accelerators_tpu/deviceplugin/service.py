@@ -84,6 +84,7 @@ class DevicePluginService:
         resps = pb.AllocateResponse()
         for rqt in request.container_requests:
             try:
+                self.manager.verify_allocatable()
                 validate_request(
                     list(rqt.devicesIDs),
                     len(self.manager.list_physical_devices()),

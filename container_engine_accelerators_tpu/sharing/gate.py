@@ -1,0 +1,115 @@
+"""Core-sharing runtime gate — the isMpsHealthy analog.
+
+The reference refuses to serve MPS-shared GPUs until it has proven the
+co-tenancy mechanism is alive: it execs ``mps-control`` and checks the
+daemon answers (ref: pkg/gpu/nvidia/manager.go:376-386).  TPU
+core-sharing has no control daemon — enforcement lives in the libtpu
+that every co-tenant container loads, keyed off the env contract the
+plugin injects (``TPU_VISIBLE_DEVICES`` + ``TPU_CORE_PERCENTAGE`` /
+``TPU_HBM_LIMIT_BYTES``).  So "is the mechanism live" becomes: the
+libtpu the plugin mounts into containers must (a) exist on the node and
+(b) actually consume the visibility env — probed by scanning the shared
+object for the env-var name, which libtpu embeds as a string constant.
+Without that, the env contract is decoration and every co-tenant would
+silently see (and could OOM) the whole chip; the gate refuses instead.
+
+Verification runs in full at manager start, and cheaply (stat
+comparison) on every Allocate so a driver re-install or wiped host
+directory mid-flight stops handing out shared devices.
+"""
+
+import logging
+import os
+from typing import List, Optional, Tuple
+
+from container_engine_accelerators_tpu.utils.device import Mount
+
+log = logging.getLogger(__name__)
+
+# The env libtpu consults to restrict a process to its assigned chips —
+# the enforcement half of the sharing contract.  Present as a literal
+# string in any libtpu that supports co-tenancy.
+VISIBILITY_ENV_MARKER = b"TPU_VISIBLE_DEVICES"
+
+# Relative locations of libtpu under the driver-install mount
+# (libtpu-installer/ubuntu/entrypoint.sh:82-88 ships lib64/libtpu.so).
+_LIBTPU_RELPATHS = ("lib64/libtpu.so", "libtpu.so")
+
+_SCAN_CHUNK = 1 << 20
+
+
+class CoreSharingGateError(RuntimeError):
+    """The co-tenancy mechanism is not enforceable on this node."""
+
+
+class CoreSharingGate:
+    def __init__(self, mount_paths: List[Mount]):
+        self.mount_paths = mount_paths
+        # (path, size, mtime_ns) of the verified libtpu; None = unverified.
+        self._verified: Optional[Tuple[str, int, int]] = None
+
+    def find_libtpu(self) -> Optional[str]:
+        for mount in self.mount_paths:
+            for rel in _LIBTPU_RELPATHS:
+                path = os.path.join(mount.host_path, rel)
+                if os.path.isfile(path):
+                    return path
+        return None
+
+    def verify(self) -> None:
+        """Full check; raises CoreSharingGateError when unenforceable."""
+        path = self.find_libtpu()
+        if path is None:
+            raise CoreSharingGateError(
+                "core-sharing requires libtpu on the node (searched "
+                f"{[m.host_path for m in self.mount_paths]}); the installer "
+                "DaemonSet has not delivered it"
+            )
+        st = os.stat(path)
+        if st.st_size == 0:
+            raise CoreSharingGateError(
+                f"core-sharing gate: {path} is empty; broken install"
+            )
+        if not self._scan_for_marker(path):
+            raise CoreSharingGateError(
+                f"core-sharing gate: {path} does not consume "
+                f"{VISIBILITY_ENV_MARKER.decode()}; this libtpu cannot "
+                "enforce co-tenant chip visibility — refusing to advertise "
+                "shared devices"
+            )
+        self._verified = (path, st.st_size, st.st_mtime_ns)
+        log.info("core-sharing gate: %s verified enforceable", path)
+
+    def check_allocatable(self) -> None:
+        """Cheap per-Allocate re-check; full re-verify when the install
+        changed underneath us.  Raises ValueError so the service maps it
+        onto the allocation-rejection path."""
+        try:
+            if self._verified is not None:
+                path, size, mtime_ns = self._verified
+                st = os.stat(path)
+                if (st.st_size, st.st_mtime_ns) == (size, mtime_ns):
+                    return
+            self.verify()
+        except (OSError, CoreSharingGateError) as e:
+            self._verified = None
+            raise ValueError(
+                f"core-sharing co-tenancy mechanism not enforceable: {e}"
+            )
+
+    def _scan_for_marker(self, path: str) -> bool:
+        """Stream the .so looking for the visibility-env string (chunked
+        with overlap so a marker spanning a chunk boundary still hits)."""
+        overlap = len(VISIBILITY_ENV_MARKER) - 1
+        tail = b""
+        try:
+            with open(path, "rb") as f:
+                while True:
+                    chunk = f.read(_SCAN_CHUNK)
+                    if not chunk:
+                        return False
+                    if VISIBILITY_ENV_MARKER in tail + chunk:
+                        return True
+                    tail = chunk[-overlap:]
+        except OSError as e:
+            raise CoreSharingGateError(f"core-sharing gate: cannot read {path}: {e}")

@@ -37,7 +37,11 @@ from container_engine_accelerators_tpu.tpulib.types import (
     TpuErrorEvent,
     TpuLib,
 )
-from container_engine_accelerators_tpu.tpulib.sysfs import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.tpulib.sysfs import (
+    SysfsTpuLib,
+    write_fixture,
+    write_libtpu_install,
+)
 
 
 def open_lib(root: str = "/", prefer_native: bool = True) -> TpuLib:

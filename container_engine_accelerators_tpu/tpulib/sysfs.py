@@ -171,6 +171,20 @@ def write_fixture(
             open(os.path.join(root, "dev", f"accel{i}"), "w").close()
 
 
+def write_libtpu_install(root: str) -> str:
+    """Fabricate the installer's libtpu delivery under ``root`` (the
+    node contract the core-sharing gate probes:
+    libtpu-installer/ubuntu/entrypoint.sh:82-88).  Returns the host dir
+    to mount.  The fake .so carries the visibility-env marker a real
+    libtpu embeds."""
+    host_dir = os.path.join(root, "home/kubernetes/bin/tpu")
+    lib64 = os.path.join(host_dir, "lib64")
+    os.makedirs(lib64, exist_ok=True)
+    with open(os.path.join(lib64, "libtpu.so"), "wb") as f:
+        f.write(b"\x7fELF-fake-libtpu\x00TPU_VISIBLE_DEVICES\x00")
+    return host_dir
+
+
 def post_event(root: str, code: int, device: Optional[str], message: str = "") -> None:
     """Drop an error event into the queue (test + fault-injection helper)."""
     events = os.path.join(root, "var/run/tpu/events")

@@ -20,7 +20,11 @@ from container_engine_accelerators_tpu.deviceplugin import (
     deviceplugin_v1beta1_pb2 as pb,
 )
 from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
-from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.tpulib import (
+    SysfsTpuLib,
+    write_fixture,
+    write_libtpu_install,
+)
 from container_engine_accelerators_tpu.utils.config import TPUConfig
 from container_engine_accelerators_tpu.utils.device import (
     HEALTHY,
@@ -40,7 +44,7 @@ def make_manager(root, config_json=None, num_chips=NUM_CHIPS, topology="2x2x1"):
     cfg.add_defaults_and_validate()
     mounts = [
         Mount(
-            host_path="/home/kubernetes/bin/tpu",
+            host_path=write_libtpu_install(root),
             container_path="/usr/local/tpu",
             read_only=True,
         )
@@ -142,7 +146,9 @@ def test_allocate_plain(tmp_path):
             assert d.container_path == d.host_path
             assert d.permissions == "mrw"
         assert len(cresp.mounts) == 1
-        assert cresp.mounts[0].host_path == "/home/kubernetes/bin/tpu"
+        assert cresp.mounts[0].host_path == os.path.join(
+            h.root, "home/kubernetes/bin/tpu"
+        )
         assert cresp.mounts[0].container_path == "/usr/local/tpu"
         assert cresp.mounts[0].read_only is True
         assert dict(cresp.envs) == {}

@@ -5,9 +5,13 @@ import os
 import pytest
 
 from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
-from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.tpulib import (
+    SysfsTpuLib,
+    write_fixture,
+    write_libtpu_install,
+)
 from container_engine_accelerators_tpu.utils.config import TPUConfig
-from container_engine_accelerators_tpu.utils.device import HEALTHY
+from container_engine_accelerators_tpu.utils.device import HEALTHY, Mount
 
 HBM = 16 * 2**30
 
@@ -17,7 +21,16 @@ def make_manager(tmp_path, config_json, num_chips=1):
     write_fixture(root, num_chips, hbm_total=HBM)
     cfg = TPUConfig.from_json(config_json)
     cfg.add_defaults_and_validate()
-    m = TpuManager(os.path.join(root, "dev"), [], cfg, lib=SysfsTpuLib(root))
+    mounts = [
+        Mount(
+            host_path=write_libtpu_install(root),
+            container_path="/usr/local/tpu",
+            read_only=True,
+        )
+    ]
+    m = TpuManager(
+        os.path.join(root, "dev"), mounts, cfg, lib=SysfsTpuLib(root)
+    )
     m.start()
     return m
 

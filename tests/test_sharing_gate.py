@@ -1,0 +1,136 @@
+"""Core-sharing runtime gate — the isMpsHealthy analog
+(ref: pkg/gpu/nvidia/manager.go:376-386).
+
+The manager must prove the co-tenancy mechanism (libtpu consuming the
+visibility env) is enforceable before advertising shared devices, and
+keep proving it cheaply on every Allocate.
+"""
+
+import os
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin import (
+    deviceplugin_v1beta1_pb2 as pb,
+)
+from container_engine_accelerators_tpu.sharing.gate import (
+    CoreSharingGate,
+    CoreSharingGateError,
+    _SCAN_CHUNK,
+    VISIBILITY_ENV_MARKER,
+)
+from container_engine_accelerators_tpu.utils.device import Mount
+from tests.test_device_plugin import PluginHarness, allocate_ids
+from tests.test_manager import CORE_SHARING, make_manager
+
+# ---- gate units ------------------------------------------------------------
+
+
+def _gate_for(tmp_path, content=None):
+    lib64 = tmp_path / "tpu" / "lib64"
+    lib64.mkdir(parents=True)
+    if content is not None:
+        (lib64 / "libtpu.so").write_bytes(content)
+    return CoreSharingGate(
+        [Mount(str(tmp_path / "tpu"), "/usr/local/tpu", True)]
+    )
+
+
+def test_missing_libtpu_refused(tmp_path):
+    gate = _gate_for(tmp_path, content=None)
+    with pytest.raises(CoreSharingGateError, match="installer"):
+        gate.verify()
+
+
+def test_empty_libtpu_refused(tmp_path):
+    gate = _gate_for(tmp_path, content=b"")
+    with pytest.raises(CoreSharingGateError, match="empty"):
+        gate.verify()
+
+
+def test_markerless_libtpu_refused(tmp_path):
+    gate = _gate_for(tmp_path, content=b"\x7fELF no sharing support here")
+    with pytest.raises(CoreSharingGateError, match="cannot enforce"):
+        gate.verify()
+
+
+def test_marker_found(tmp_path):
+    gate = _gate_for(tmp_path, b"\x7fELF" + VISIBILITY_ENV_MARKER + b"\x00")
+    gate.verify()
+    gate.check_allocatable()  # cheap path
+
+
+def test_marker_spanning_chunk_boundary(tmp_path):
+    # Marker straddles the 1 MiB scan chunk: the overlap tail must catch it.
+    pad = _SCAN_CHUNK - len(VISIBILITY_ENV_MARKER) // 2
+    gate = _gate_for(tmp_path, b"x" * pad + VISIBILITY_ENV_MARKER)
+    gate.verify()
+
+
+def test_install_wiped_after_verify_rejected(tmp_path):
+    path = tmp_path / "tpu" / "lib64" / "libtpu.so"
+    gate = _gate_for(tmp_path, b"\x7fELF" + VISIBILITY_ENV_MARKER)
+    gate.verify()
+    os.unlink(path)
+    with pytest.raises(ValueError, match="not enforceable"):
+        gate.check_allocatable()
+    # Re-delivery heals the gate (re-verify path).
+    path.write_bytes(b"\x7fELF" + VISIBILITY_ENV_MARKER + b"v2")
+    gate.check_allocatable()
+
+
+def test_swapped_markerless_libtpu_rejected(tmp_path):
+    path = tmp_path / "tpu" / "lib64" / "libtpu.so"
+    gate = _gate_for(tmp_path, b"\x7fELF" + VISIBILITY_ENV_MARKER)
+    gate.verify()
+    path.write_bytes(b"\x7fELF downgraded build, no visibility plumbing!")
+    with pytest.raises(ValueError, match="not enforceable"):
+        gate.check_allocatable()
+
+
+# ---- manager integration ---------------------------------------------------
+
+
+def test_manager_start_refuses_without_libtpu(tmp_path):
+    import shutil
+
+    # make_manager delivers the install; wipe it and restart.
+    m = make_manager(tmp_path, CORE_SHARING)
+    shutil.rmtree(os.path.join(str(tmp_path), "home"))
+    with pytest.raises(CoreSharingGateError):
+        m.start()
+
+
+def test_manager_gate_absent_without_sharing(tmp_path):
+    m = make_manager(tmp_path, {})
+    assert m.sharing_gate is None
+    m.verify_allocatable()  # no-op
+
+
+# ---- gRPC integration ------------------------------------------------------
+
+
+CORE_SHARING_CFG = {
+    "TPUSharingConfig": {
+        "TPUSharingStrategy": "core-sharing",
+        "MaxSharedClientsPerTPU": 2,
+    }
+}
+
+
+def test_allocate_gated_on_live_mechanism(tmp_path):
+    with PluginHarness(
+        tmp_path, config_json=CORE_SHARING_CFG, num_chips=1
+    ) as h:
+        resp = allocate_ids(h, ["accel0/vtpu0"])
+        assert resp.container_responses[0].envs["TPU_CORE_PERCENTAGE"] == "50"
+        # Driver wipe mid-flight: Allocate must start refusing.
+        libtpu = os.path.join(
+            h.root, "home/kubernetes/bin/tpu/lib64/libtpu.so"
+        )
+        os.unlink(libtpu)
+        with pytest.raises(grpc.RpcError) as e:
+            allocate_ids(h, ["accel0/vtpu1"])
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        assert "not enforceable" in e.value.details()
