@@ -209,6 +209,12 @@ def main(argv=None):
                    help="ICI/DCN line rate; enables the >=threshold pass bar")
     p.add_argument("--pass-threshold", type=float, default=0.9)
     p.add_argument("--json", action="store_true", help="one JSON line per size")
+    p.add_argument(
+        "--verdict-json", default=None, metavar="FILE",
+        help="write the full sweep + PASS/FAIL verdict as one JSON document "
+             "(the artifact a cluster rig uploads; nccl-tests analog of the "
+             "mpirun log the reference's test runner collects)",
+    )
     args = p.parse_args(argv)
 
     from container_engine_accelerators_tpu.parallel import dcn
@@ -237,13 +243,33 @@ def main(argv=None):
         else:
             print(f"  {r.size_bytes:>12} {r.time_us:>12.1f} "
                   f"{r.alg_bw_gbps:>12.2f} {r.bus_bw_gbps:>12.2f}")
+    ok = True
+    frac = None
     if args.line_rate_gbps:
         frac = best / args.line_rate_gbps
         ok = frac >= args.pass_threshold
         print(f"# peak busbw {best:.1f} GB/s = {frac:.1%} of line rate "
               f"{args.line_rate_gbps} GB/s -> {'PASS' if ok else 'FAIL'}")
-        return 0 if ok else 1
-    return 0
+    if args.verdict_json:
+        verdict = {
+            "op": args.op,
+            "devices": n,
+            "platform": jax.devices()[0].platform,
+            "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+            "dtype": args.dtype,
+            "iters": args.iters,
+            "warmup": args.warmup,
+            "results": [dataclasses.asdict(r) for r in results],
+            "peak_busbw_gbps": best,
+            "line_rate_gbps": args.line_rate_gbps,
+            "pass_threshold": args.pass_threshold,
+            "line_rate_fraction": frac,
+            "pass": ok if args.line_rate_gbps else None,
+        }
+        with open(args.verdict_json, "w") as f:
+            json.dump(verdict, f, indent=1)
+            f.write("\n")
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,56 @@
+"""Worker process for the 2-process jax.distributed rendezvous test.
+
+Launched by tests/test_dcn_rendezvous.py with the K8s env contract set
+(TPU_WORKER_COUNT / TPU_WORKER_ID or JOB_COMPLETION_INDEX /
+TPU_COORDINATOR_ADDR).  Initializes through
+container_engine_accelerators_tpu.parallel.dcn — the production path —
+then runs a cross-process global reduction and prints the result for
+the parent to assert on.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.parallel import dcn  # noqa: E402
+
+
+def main() -> None:
+    if os.environ.get("DCN_DERIVE_CHECK") == "1":
+        # Derivation-only mode: print what the env contract resolves to.
+        addr, num, pid = dcn.resolve_cluster()
+        print(f"DERIVED {addr} {num} {pid}", flush=True)
+        return
+
+    num, pid = dcn.initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == num, (jax.process_count(), num)
+    devices = jax.devices()
+    local = jax.local_device_count()
+    mesh = Mesh(np.array(devices), ("data",))
+
+    # Each process contributes rows filled with (pid+1); the global sum
+    # can only be produced by a cross-process collective.
+    rows_per_proc = local * 2
+    local_data = np.full((rows_per_proc, 8), pid + 1, np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local_data
+    )
+    total = jax.jit(
+        jnp.sum, out_shardings=NamedSharding(mesh, P())
+    )(arr)
+    print(
+        f"RESULT {float(total)} procs={num} pid={pid} "
+        f"global_devices={len(devices)}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

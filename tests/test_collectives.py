@@ -96,3 +96,43 @@ def test_per_rank_payload_accounting():
         op="all_gather", dtype=jnp.float32,
     )
     assert gathered[0].size_bytes == 2**13
+
+
+# ---- CLI verdict path (the nccl-test rig's PASS/FAIL bar) ------------------
+
+
+def _run_cli(tmp_path, extra):
+    from container_engine_accelerators_tpu.collectives.bench import main
+
+    verdict_file = tmp_path / "verdict.json"
+    rc = main(
+        ["-b", "64K", "-e", "128K", "--iters", "2", "--warmup", "1",
+         "--op", "all_reduce", "--verdict-json", str(verdict_file)] + extra
+    )
+    import json
+
+    return rc, json.loads(verdict_file.read_text())
+
+
+def test_cli_pass_verdict_artifact(tmp_path):
+    rc, v = _run_cli(tmp_path, ["--line-rate-gbps", "1e-6"])
+    assert rc == 0
+    assert v["pass"] is True
+    assert v["op"] == "all_reduce" and v["devices"] == len(jax.devices())
+    assert v["line_rate_fraction"] > 1
+    assert len(v["results"]) == 2
+    assert all(r["bus_bw_gbps"] > 0 for r in v["results"])
+
+
+def test_cli_fail_verdict_artifact(tmp_path):
+    # A line rate no rig can reach: the bar must FAIL with rc 1.
+    rc, v = _run_cli(tmp_path, ["--line-rate-gbps", "1e9"])
+    assert rc == 1
+    assert v["pass"] is False
+    assert v["line_rate_fraction"] < 1
+
+
+def test_cli_no_bar_records_null_verdict(tmp_path):
+    rc, v = _run_cli(tmp_path, [])
+    assert rc == 0
+    assert v["pass"] is None and v["line_rate_gbps"] is None

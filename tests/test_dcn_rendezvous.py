@@ -1,0 +1,102 @@
+"""Real 2-process jax.distributed rendezvous over the DCN env contract.
+
+The reference's multi-host story is ssh + MPI between pods
+(gpudirect-tcpx/nccl-config.yaml:31-35); ours is
+``jax.distributed.initialize`` with coordinator addressing derived from
+the Job env (SURVEY.md §7 hard part (e)).  Unit tests elsewhere cover
+``resolve_cluster`` parsing; this file spawns TWO actual processes that
+initialize through ``parallel.dcn`` on the CPU backend and run a
+cross-process global reduction — the rendezvous path that fails in the
+field.  (Actual K8s DNS resolution of ``<job>-0.<svc>`` needs a
+cluster; derivation is asserted in a real worker process instead.)
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+from container_engine_accelerators_tpu.utils.cpuenv import cpu_mesh_env
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO_ROOT, "tests", "dcn_rendezvous_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(extra):
+    # 2 virtual CPU devices per process -> 4 global devices.
+    env = cpu_mesh_env(2)
+    env.update(extra)
+    return env
+
+
+def test_two_process_rendezvous_and_global_reduce():
+    port = _free_port()
+    common = {
+        "TPU_WORKER_COUNT": "2",
+        "TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+    }
+    procs = []
+    for pid in range(2):
+        # Worker 1 uses the indexed-Job fallback env instead of
+        # TPU_WORKER_ID — both production spellings get exercised.
+        id_env = (
+            {"TPU_WORKER_ID": "0"} if pid == 0
+            else {"JOB_COMPLETION_INDEX": "1"}
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, WORKER],
+                env=_worker_env({**common, **id_env}),
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=REPO_ROOT,
+            )
+        )
+    deadline = time.monotonic() + 240
+    outs = []
+    for p in procs:
+        timeout = max(5.0, deadline - time.monotonic())
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("rendezvous deadlocked (timeout)")
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(out)
+
+    # Global array: 4 rows of 8 from pid0 (value 1) + 4 rows of 8 from
+    # pid1 (value 2) -> sum = 4*8*1 + 4*8*2 = 96.  Every process must
+    # report the same global sum and see all 4 devices.
+    for pid, out in enumerate(outs):
+        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
+        assert line.split()[1] == "96.0", line
+        assert f"pid={pid}" in line and "global_devices=4" in line, line
+
+
+def test_worker_derives_coordinator_from_job_dns_env():
+    # A real worker process resolves the headless-service DNS form from
+    # JOB_NAME/TPU_SERVICE_NAME when TPU_COORDINATOR_ADDR is absent.
+    env = _worker_env(
+        {
+            "DCN_DERIVE_CHECK": "1",
+            "TPU_WORKER_COUNT": "2",
+            "JOB_COMPLETION_INDEX": "1",
+            "JOB_NAME": "rdv",
+            "TPU_SERVICE_NAME": "rdv-svc",
+        }
+    )
+    env.pop("TPU_COORDINATOR_ADDR", None)
+    out = subprocess.run(
+        [sys.executable, WORKER], env=env, capture_output=True, text=True,
+        cwd=REPO_ROOT, timeout=120, check=True,
+    ).stdout
+    assert "DERIVED rdv-0.rdv-svc:8476 2 1" in out

@@ -153,6 +153,8 @@ def test_collectives_test_pods_symmetric_and_wired():
     flags accepted by the native binary's parser."""
     for fname in (
         "xla-collectives-test.yaml",
+        "xla-collectives-test-latest.yaml",
+        "xla-collectives-test-without-hostnetwork.yaml",
         "xla-collectives-test-unprivileged-without-hostnetwork.yaml",
     ):
         path = os.path.join(REPO, "ici-collectives", fname)
@@ -178,6 +180,76 @@ def test_collectives_test_pods_symmetric_and_wired():
                 assert f in ("--uds_path", "--pool_bytes", "--max_flows",
                              "--verbose"), f"{fname}: unknown dcnxferd flag {f}"
         assert ids == {"0", "1"}, f"{fname}: worker ids {ids}"
+
+
+def test_collectives_rig_matrix_axes():
+    """The 4-variant matrix must actually vary along the privilege and
+    hostNetwork axes it claims (the reference ships the same 4-flavor
+    spread: nccl-test{,-latest,-without-hostnetwork,-unprivileged-...})."""
+    expect = {
+        # fname -> (daemon privileged?, hostNetwork?)
+        "xla-collectives-test.yaml": (True, True),
+        "xla-collectives-test-latest.yaml": (True, True),
+        "xla-collectives-test-without-hostnetwork.yaml": (True, False),
+        "xla-collectives-test-unprivileged-without-hostnetwork.yaml":
+            (False, False),
+    }
+    for fname, (priv, hostnet) in expect.items():
+        path = os.path.join(REPO, "ici-collectives", fname)
+        for pod in (d for d in _docs(path) if d["kind"] == "Pod"):
+            spec = pod["spec"]
+            assert bool(spec.get("hostNetwork")) is hostnet, fname
+            daemon = next(
+                c for c in spec["containers"] if c["name"] == "dcn-daemon"
+            )
+            sc = daemon.get("securityContext", {})
+            assert bool(sc.get("privileged")) is priv, fname
+            if not hostnet:
+                # Pod-network rendezvous needs the stable pod DNS name.
+                assert spec.get("subdomain"), f"{fname}: missing subdomain"
+            if not priv:
+                # Unprivileged daemons get device nodes from the NRI
+                # injector annotation.
+                ann = pod["metadata"]["annotations"]
+                assert "devices.gke.io/container.dcn-daemon" in ann, fname
+
+
+def test_latest_rig_runs_full_matrix_with_artifacts():
+    path = os.path.join(REPO, "ici-collectives", "xla-collectives-test-latest.yaml")
+    for pod in (d for d in _docs(path) if d["kind"] == "Pod"):
+        test_c = next(
+            c for c in pod["spec"]["containers"]
+            if c["name"] == "xla-collectives-test"
+        )
+        assert "matrix.sh" in test_c["command"][-1], "latest rig must sweep the op matrix"
+        env = {e["name"]: e.get("value") for e in test_c["env"]}
+        assert env.get("ARTIFACT_DIR") == "/artifacts"
+        mounts = {m["mountPath"] for m in test_c["volumeMounts"]}
+        assert "/artifacts" in mounts
+
+    # matrix.sh itself must cover all four ops and emit per-op verdicts.
+    (cfg,) = _docs(os.path.join(REPO, "ici-collectives", "xla-collectives-config.yaml"))
+    matrix = cfg["data"]["matrix.sh"]
+    for op in ("all_reduce", "all_gather", "reduce_scatter", "ppermute"):
+        assert op in matrix
+    assert "--verdict-json" in matrix
+
+
+def test_recorded_sweep_artifact_is_a_pass():
+    """The committed virtual-mesh verdict artifact stays parseable and
+    internally consistent (peak matches the per-size results)."""
+    import json
+
+    path = os.path.join(
+        REPO, "ici-collectives", "results", "sweep-virtual-cpu8.json"
+    )
+    with open(path) as f:
+        v = json.load(f)
+    assert v["op"] == "all_reduce" and v["devices"] == 8
+    assert v["pass"] is True
+    peak = max(r["bus_bw_gbps"] for r in v["results"])
+    assert abs(peak - v["peak_busbw_gbps"]) < 1e-9
+    assert v["line_rate_fraction"] >= v["pass_threshold"]
 
 
 def test_installer_entrypoint_is_executable_bash():
