@@ -16,6 +16,11 @@ RUN make native
 FROM python:3.12-slim-bookworm
 
 WORKDIR /app
+# Bake the node daemons' wheels at build time: startup must not depend
+# on a package index any more than the reference's static binary does.
+COPY requirements-node.txt ./
+RUN pip install --no-cache-dir -r requirements-node.txt
+
 COPY container_engine_accelerators_tpu/ container_engine_accelerators_tpu/
 COPY cmd/ cmd/
 COPY demo/ demo/

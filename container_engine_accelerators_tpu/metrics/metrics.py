@@ -63,14 +63,10 @@ class TpuMetricsCollector:
         return [c.name for c in self.lib.chips()]
 
     def model(self, device_name: str) -> str:
-        lib = self.lib
-        attr = getattr(lib, "_attr", None)
-        if attr is not None:
-            try:
-                return attr(device_name, "model", default="tpu")
-            except Exception:
-                return "tpu"
-        return "tpu"
+        try:
+            return self.lib.model(device_name)
+        except Exception:
+            return "tpu"
 
 
 class MetricServer:

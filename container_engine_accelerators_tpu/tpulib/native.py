@@ -181,6 +181,16 @@ class NativeTpuLib(TpuLib):
         rc = self._lib.tpu_duty_cycle(self._ctx, name.encode())
         return max(0, rc)
 
+    def model(self, name: str) -> str:
+        # The C shim samples counters; the model string is a static sysfs
+        # attribute, read directly from the same tree the shim is rooted at.
+        p = os.path.join(self.root, "sys/class/accel", name, "device", "model")
+        try:
+            with open(p) as f:
+                return f.read().strip()
+        except OSError:
+            return "tpu"
+
     def health(self, name: str) -> str:
         buf = ctypes.create_string_buffer(_HEALTH_LEN)
         rc = self._lib.tpu_health(self._ctx, name.encode(), buf, _HEALTH_LEN)

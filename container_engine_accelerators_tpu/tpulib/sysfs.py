@@ -90,6 +90,9 @@ class SysfsTpuLib(TpuLib):
     def health(self, name: str) -> str:
         return self._attr(name, "health", default="ok")
 
+    def model(self, name: str) -> str:
+        return self._attr(name, "model", default="tpu")
+
     # -- events -------------------------------------------------------------
 
     def _next_event_file(self) -> Optional[str]:

@@ -50,6 +50,13 @@ class TpuLib:
         """0-100 TensorCore busy percentage (NVML duty-cycle analog)."""
         raise NotImplementedError
 
+    def model(self, name: str) -> str:
+        """Chip model string for metric labels, e.g. "tpu-v5e" (the
+        NVML device-name analog; metrics labels carry it like the
+        reference's model label, metrics.go:59-115).  Backends without
+        model info return "tpu"."""
+        return "tpu"
+
     def health(self, name: str) -> str:
         """"ok" or "error:<code>"."""
         raise NotImplementedError

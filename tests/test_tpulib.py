@@ -1,5 +1,7 @@
 """tpulib sysfs backend tests: the node filesystem contract."""
 
+import os
+
 import pytest
 
 from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
@@ -45,3 +47,21 @@ def test_bad_chip_name_rejected(tmp_path):
     lib = SysfsTpuLib(str(tmp_path))
     with pytest.raises(ValueError):
         lib.chip_info("nvidia0")
+
+
+def test_model_attr_through_interface(tmp_path):
+    """model() is part of the TpuLib seam (metrics labels consume it), not
+    a private-attribute probe."""
+    root = str(tmp_path)
+    write_fixture(root, 1)
+    lib = SysfsTpuLib(root)
+    assert lib.model("accel0") == "tpu"  # fixture writes no model attr
+    with open(
+        os.path.join(root, "sys/class/accel/accel0/device/model"), "w"
+    ) as f:
+        f.write("tpu-v5e\n")
+    assert lib.model("accel0") == "tpu-v5e"
+
+    from container_engine_accelerators_tpu.tpulib.types import TpuLib
+
+    assert TpuLib().model("accel0") == "tpu"  # interface default
