@@ -138,10 +138,19 @@ class InceptionE(nn.Module):
 
 
 class InceptionV3(nn.Module):
-    """Inception-v3 with the standard channel plan."""
+    """Inception-v3 with the standard channel plan.
+
+    ``a_blocks``/``c_blocks``/``e_blocks`` parameterize the per-stage
+    repeat plan (defaults = the standard 3/4/2 architecture); tests use
+    a 1/1/1 plan so the compile cost under test scales with one block of
+    each type, not the full graph.
+    """
 
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
+    a_blocks: Tuple[int, ...] = (32, 64, 64)  # InceptionA pool_features
+    c_blocks: Tuple[int, ...] = (128, 160, 160, 192)  # InceptionC 7x7 ch
+    e_blocks: int = 2
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -167,17 +176,14 @@ class InceptionV3(nn.Module):
         x = cba(192, (3, 3))(x)
         x = _pool(x, 3, 2, "max")
 
-        x = InceptionA(32, cba=cba)(x)
-        x = InceptionA(64, cba=cba)(x)
-        x = InceptionA(64, cba=cba)(x)
+        for pool_features in self.a_blocks:
+            x = InceptionA(pool_features, cba=cba)(x)
         x = InceptionB(cba=cba)(x)
-        x = InceptionC(128, cba=cba)(x)
-        x = InceptionC(160, cba=cba)(x)
-        x = InceptionC(160, cba=cba)(x)
-        x = InceptionC(192, cba=cba)(x)
+        for c7 in self.c_blocks:
+            x = InceptionC(c7, cba=cba)(x)
         x = InceptionD(cba=cba)(x)
-        x = InceptionE(cba=cba)(x)
-        x = InceptionE(cba=cba)(x)
+        for _ in range(self.e_blocks):
+            x = InceptionE(cba=cba)(x)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)

@@ -204,6 +204,34 @@ class Block(nn.Module):
         return x
 
 
+class _ScanBlock(nn.Module):
+    """Block wrapped into nn.scan's (carry, out) contract."""
+
+    num_heads: int
+    head_dim: int
+    mlp_dim: int
+    dtype: Any
+    seq_parallel: Optional[str]
+    seq_axis: str
+    use_flash: Optional[bool]
+    decode: bool
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = Block(
+            self.num_heads,
+            self.head_dim,
+            self.mlp_dim,
+            self.dtype,
+            self.seq_parallel,
+            self.seq_axis,
+            self.use_flash,
+            self.decode,
+            name="block",
+        )(x, positions)
+        return x, None
+
+
 class TransformerLM(nn.Module):
     """Causal LM.  ``__call__(tokens [B, T], positions [T]) -> logits``.
 
@@ -234,18 +262,31 @@ class TransformerLM(nn.Module):
             name="embed",
         )
         x = emb(tokens)
-        for i in range(self.num_layers):
-            x = Block(
-                self.num_heads,
-                self.head_dim,
-                self.mlp_dim,
-                self.dtype,
-                self.seq_parallel,
-                self.seq_axis,
-                self.use_flash,
-                self.decode,
-                name=f"block_{i}",
-            )(x, positions)
+        block_args = (
+            self.num_heads,
+            self.head_dim,
+            self.mlp_dim,
+            self.dtype,
+            self.seq_parallel,
+            self.seq_axis,
+            self.use_flash,
+            self.decode,
+        )
+        # Scan over a single stacked Block: compile time is O(1) in depth
+        # instead of O(num_layers) — with a Python loop the 12-layer
+        # flash-attention step took >15 min to compile on the TPU backend;
+        # XLA sees one layer either way.  Decode mode scans its KV cache
+        # along the same leading layer axis, so train-mode params load
+        # directly into a decode-mode model (one param-tree layout).
+        stack = nn.scan(
+            _ScanBlock,
+            variable_axes={"params": 0, "cache": 0},
+            split_rngs={"params": True},
+            length=self.num_layers,
+            in_axes=nn.broadcast,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"},
+        )(*block_args, name="blocks")
+        x, _ = stack(x, positions)
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
         # Final projection in TRUE f32 for a numerically stable softmax
         # loss: Embed.attend would promote the query back to the module

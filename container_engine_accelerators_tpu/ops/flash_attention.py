@@ -43,6 +43,14 @@ NEG_INF = -1e30
 BLOCK_Q = 128
 BLOCK_K = 128
 
+# Mosaic requires the last two dims of every block to be (8k, 128k) or
+# equal to the array dims, so per-row scalars (the logsumexp) cannot be
+# stored as a [.., T] array with [.., BLOCK_Q] blocks.  Like the stock
+# JAX TPU kernel (pallas/ops/tpu/flash_attention.py, MIN_BLOCK_SIZE),
+# lse is carried as [B*H, T, LANES] with the scalar broadcast across a
+# full 128-lane vector register.
+LSE_LANES = 128
+
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
                block_k):
@@ -92,7 +100,9 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, scale,
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nk_run, body, (m0, l0, acc0))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    lse_ref[0] = jax.lax.broadcast_in_dim(
+        m + jnp.log(l), (bq, LSE_LANES), (0,)
+    )
 
 
 def _to_bh(x):  # [B, T, H, D] -> [B*H, T, D]
@@ -106,7 +116,7 @@ def _from_bh(x, b, h):  # [B*H, T, D] -> [B, T, H, D]
 
 
 def _fa_forward(q, k, v, causal, scale, interpret):
-    """Pallas forward on [B, T, H, D] inputs -> (out, lse [B*H, T])."""
+    """Pallas forward on [B, T, H, D] -> (out, lse [B*H, T, LSE_LANES])."""
     b, t, h, d = q.shape
     qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
     grid = (b * h, t // BLOCK_Q)
@@ -117,7 +127,7 @@ def _fa_forward(q, k, v, causal, scale, interpret):
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, t, LSE_LANES), jnp.float32),
         ),
         grid=grid,
         in_specs=[
@@ -127,7 +137,7 @@ def _fa_forward(q, k, v, causal, scale, interpret):
         ],
         out_specs=(
             pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, BLOCK_Q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, BLOCK_Q, LSE_LANES), lambda bh, i: (bh, i, 0)),
         ),
         interpret=interpret,
     )(qf, kf, vf)
@@ -146,7 +156,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
     q = q_ref[0]  # [BQ, D]
     do = do_ref[0]
     o = o_ref[0]
-    lse = lse_ref[0]  # [BQ] f32
+    # lse arrives lane-broadcast [BQ, LSE_LANES]; keep one lane as a
+    # [BQ, 1] column so later uses broadcast against [BQ, BK].
+    lse = lse_ref[0][:, :1]  # [BQ, 1] f32
     bq, d = q.shape
     t = k_ref.shape[1]
     nk = t // block_k
@@ -176,7 +188,7 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref,
                 jnp.int32, (bq, block_k), 1
             )
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [BQ, BK] f32; 0 where masked
+        p = jnp.exp(s - lse)  # [BQ, BK] f32; 0 where masked
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -214,7 +226,8 @@ def _fa_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
         q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
         do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
         o_blk = o_ref[0, pl.ds(i * block_q, block_q), :]
-        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q)]
+        # [BQ, 1] column of the lane-broadcast lse block.
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :][:, :1]
         delta = jnp.sum(
             do_blk.astype(jnp.float32) * o_blk.astype(jnp.float32), axis=1
         )  # [BQ]
@@ -230,7 +243,7 @@ def _fa_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
                 jnp.int32, (block_q, bk), 1
             )
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse_blk[:, None])  # [BQ, BK]
+        p = jnp.exp(s - lse_blk)  # [BQ, BK]
         dv_acc = dv_acc + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -253,7 +266,7 @@ def _fa_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
 
 
 def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret):
-    """Pallas backward on [B, T, H, D] primals; lse is [B*H, T] f32."""
+    """Pallas backward on [B,T,H,D] primals; lse is [B*H,T,LSE_LANES]."""
     b, t, h, d = q.shape
     qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
     of, gf = _to_bh(o), _to_bh(g)
@@ -261,8 +274,8 @@ def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret):
     full = pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0))
     blk_q = pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0))
     blk_k = pl.BlockSpec((1, BLOCK_K, d), lambda bh, i: (bh, i, 0))
-    lse_full = pl.BlockSpec((1, t), lambda bh, i: (bh, 0))
-    lse_blk = pl.BlockSpec((1, BLOCK_Q), lambda bh, i: (bh, i))
+    lse_full = pl.BlockSpec((1, t, LSE_LANES), lambda bh, i: (bh, 0, 0))
+    lse_blk = pl.BlockSpec((1, BLOCK_Q, LSE_LANES), lambda bh, i: (bh, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -312,13 +325,17 @@ def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
 def _fa_fwd(q, k, v, causal, scale, interpret):
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     out, lse = _fa_forward(q, k, v, causal, scale_, interpret)
-    return out, (q, k, v, out, lse)
+    # The lane-broadcast lse is 128 identical copies; keep only one lane
+    # in the residual so HBM held from forward to backward is [B*H, T]
+    # f32, not 128x that.  The backward re-broadcasts just-in-time.
+    return out, (q, k, v, out, lse[..., 0])
 
 
 def _fa_bwd(causal, scale, interpret, res, g):
     q, k, v, o, lse = res
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
-    return _fa_backward(q, k, v, o, lse, g, causal, scale_, interpret)
+    lse_lanes = jnp.broadcast_to(lse[..., None], (*lse.shape, LSE_LANES))
+    return _fa_backward(q, k, v, o, lse_lanes, g, causal, scale_, interpret)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

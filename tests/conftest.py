@@ -36,7 +36,76 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# NOTE: do NOT enable the persistent XLA compilation cache here.  On
+# XLA:CPU, reloading AOT results intermittently trips machine-feature
+# mismatches ("+prefer-no-scatter is not supported on the host") and
+# then deadlocks multi-device collective rendezvous (fatal abort).
+# Suite speed comes from structural test design instead: scanned layers,
+# reduced block plans, shared train-step compiles.
+
 import pytest  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item):
+    """Per-test deadman switch.
+
+    XLA:CPU multi-device collectives can (rarely) deadlock in their
+    in-process rendezvous on small hosts — observed as a device_get
+    blocked >15 min in a test that normally takes 7s.  The block is
+    inside native code, so SIGALRM-style in-thread timeouts never fire;
+    faulthandler's watchdog thread does: dump all stacks and hard-exit,
+    turning an infinite CI hang into a bounded, diagnosable failure.
+    """
+    import faulthandler
+
+    faulthandler.dump_traceback_later(600, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture(scope="session")
+def tiny_sharded():
+    """Session-shared tiny-ResNet sharded train step on the 4x2 mesh.
+
+    The dp x tp step compile (~20s on 8 virtual CPU devices) is the
+    single most duplicated cost in the suite; test_models and
+    test_checkpoint exercise the same program, so compile it once.
+    Returns (mesh, model, x, y, step_fn, placed) — treat `placed` as
+    immutable (every step returns a fresh state).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.models import resnet
+    from container_engine_accelerators_tpu.models.train import (
+        create_train_state,
+        make_sharded_train_step,
+    )
+    from container_engine_accelerators_tpu.parallel import create_mesh
+
+    mesh = create_mesh(data=4, model=2)
+    model = resnet(depth=18, num_classes=10, num_filters=8,
+                   small_inputs=True)
+    x = jnp.ones((8, 32, 32, 3))
+    y = jnp.zeros((8,), jnp.int32)
+    state = create_train_state(model, jax.random.PRNGKey(1), x)
+    step_fn, placed = make_sharded_train_step(mesh, state)
+    # step_fn DONATES its state argument, so the one `placed` cannot be
+    # shared across tests — each consumer gets a fresh copy on the same
+    # shardings (the compile, not the placement, is the expensive part).
+    # The template lives on the HOST: device_put can alias a device
+    # array into the new placement, and donation would then delete the
+    # template out from under the next caller (observed on the scalar
+    # step leaf).  numpy leaves cannot be aliased or donated.
+    shardings = jax.tree_util.tree_map(lambda a: a.sharding, placed)
+    host_state = jax.device_get(state)
+    del placed
+
+    def fresh_placed():
+        return jax.device_put(host_state, shardings)
+
+    return mesh, model, x, y, step_fn, fresh_placed
 
 
 @pytest.fixture

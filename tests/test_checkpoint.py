@@ -23,13 +23,10 @@ from container_engine_accelerators_tpu.parallel import (
 
 
 @pytest.fixture(scope="module")
-def trained():
-    mesh = create_mesh(data=4, model=2)
-    model = resnet(depth=18, num_classes=8, num_filters=8, small_inputs=True)
-    x = jnp.ones((8, 32, 32, 3))
-    y = jnp.zeros((8,), jnp.int32)
-    state = create_train_state(model, jax.random.PRNGKey(0), x)
-    step_fn, placed = make_sharded_train_step(mesh, state)
+def trained(tiny_sharded):
+    # Rides the session-shared sharded-step compile (tests/conftest.py).
+    mesh, model, x, y, step_fn, fresh_placed = tiny_sharded
+    placed = fresh_placed()
     xs = jax.device_put(x, batch_sharding(mesh))
     ys = jax.device_put(y, batch_sharding(mesh))
     for _ in range(3):
@@ -44,7 +41,7 @@ def test_save_restore_roundtrip(trained, tmp_path):
 
     # Fresh state from a different seed: restore must overwrite it with the
     # trained values AND lay leaves out on the same dp/tp shardings.
-    fresh = create_train_state(model, jax.random.PRNGKey(1), x)
+    fresh = create_train_state(model, jax.random.PRNGKey(2), x)
     _, fresh_placed = make_sharded_train_step(mesh, fresh)
     restored, step = ck.restore_latest(fresh_placed)
     ck.close()

@@ -30,7 +30,7 @@ def _load(name, *rel):
 def test_train_resnet_driver_end_to_end(tmp_path):
     train = _load("train_resnet_main", "cmd", "train_resnet.py")
     train.main([
-        "--resnet-depth", "34", "--train-batch-size", "8",
+        "--resnet-depth", "18", "--train-batch-size", "8",
         "--train-steps", "2", "--steps-per-eval", "1",
         "--image-size", "32", "--num-classes", "10",
         "--model-par", "2", "--model-dir", str(tmp_path),
@@ -50,7 +50,7 @@ def test_train_batch_not_divisible_rejected():
 def test_serve_resnet_http_roundtrip(tmp_path):
     serve = _load("serve_resnet_main", "cmd", "serve_resnet.py")
     args = serve.parse_args([
-        "--resnet-depth", "34", "--image-size", "32",
+        "--resnet-depth", "18", "--image-size", "32",
         "--num-classes", "10", "--port", "0",
     ])
     forward = serve.build_forward(args)
@@ -134,7 +134,7 @@ def test_train_resnet_profile_trace(tmp_path):
     train = _load("train_resnet_prof", "cmd", "train_resnet.py")
     prof = tmp_path / "prof"
     train.main([
-        "--resnet-depth", "34", "--train-batch-size", "8",
+        "--resnet-depth", "18", "--train-batch-size", "8",
         "--train-steps", "2", "--steps-per-eval", "5",
         "--image-size", "32", "--num-classes", "10",
         "--profile-dir", str(prof),

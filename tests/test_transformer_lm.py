@@ -191,3 +191,32 @@ def test_checkpoint_roundtrip_lm(tokens, tmp_path):
         jax.tree_util.tree_leaves(jax.device_get(restored.params)),
     ):
         np.testing.assert_array_equal(a, b)
+
+
+def test_train_params_load_into_decode_model():
+    """Train-then-serve contract: params from a train-mode (scanned)
+    model must load directly into a decode-mode model — both modes share
+    one param-tree layout (cache scans along the same layer axis)."""
+    import optax
+
+    from container_engine_accelerators_tpu.models.generate import generate
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+
+    cfg = dict(vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+               mlp_dim=32)
+    train_model = transformer_lm(**cfg)
+    toks = jnp.zeros((2, 8), jnp.int32)
+    state = create_lm_train_state(
+        train_model, jax.random.PRNGKey(0), toks, tx=optax.sgd(0.1)
+    )
+    out = generate(
+        transformer_lm(**cfg, decode=True), state.params,
+        jnp.ones((2, 3), jnp.int32), 4,
+    )
+    assert out.shape == (2, 7)
+    assert bool(jnp.all(out[:, :3] == 1))  # prompt teacher-forced
