@@ -61,10 +61,22 @@ def create_lm_train_state(
     )
 
 
+MOE_AUX_WEIGHT = 0.01  # Switch load-balance coefficient
+
+
 def _loss(apply_fn, params, tokens, labels, mask, positions):
-    logits = apply_fn({"params": params}, tokens, positions)
+    logits, mutated = apply_fn(
+        {"params": params}, tokens, positions, mutable=["losses"]
+    )
     per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    return (per_tok * mask).sum(), mask.sum()
+    # MoE models sow their Switch load-balance loss; dense models sow
+    # nothing and the sum is empty.
+    aux = sum(
+        jnp.sum(v)
+        for v in jax.tree_util.tree_leaves(mutated.get("losses", {}))
+    )
+    denom = mask.sum()
+    return (per_tok * mask).sum() + MOE_AUX_WEIGHT * aux * denom, denom
 
 
 def make_lm_train_step(

@@ -182,6 +182,7 @@ class Block(nn.Module):
     seq_axis: str = "data"
     use_flash: Optional[bool] = None
     decode: bool = False
+    num_experts: int = 0  # >0: MoE FFN (Switch top-1) instead of dense
 
     @nn.compact
     def __call__(self, x, positions):
@@ -197,15 +198,26 @@ class Block(nn.Module):
             name="attn",
         )(y, positions)
         y = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
+        if self.num_experts > 0:
+            from container_engine_accelerators_tpu.ops.moe import MoEFFN
+
+            out, aux = MoEFFN(
+                num_experts=self.num_experts,
+                mlp_dim=self.mlp_dim,
+                dtype=self.dtype,
+                name="moe",
+            )(y)
+            return x + out, aux
         dense = functools.partial(nn.Dense, use_bias=False, dtype=self.dtype)
         gate = dense(self.mlp_dim, name="gate")(y)
         up = dense(self.mlp_dim, name="up")(y)
         x = x + dense(x.shape[-1], name="down")(nn.silu(gate) * up)
-        return x
+        return x, jnp.zeros((), jnp.float32)
 
 
 class _ScanBlock(nn.Module):
-    """Block wrapped into nn.scan's (carry, out) contract."""
+    """Block wrapped into nn.scan's (carry, out) contract; the per-layer
+    MoE aux loss rides the scan's output slot."""
 
     num_heads: int
     head_dim: int
@@ -215,10 +227,11 @@ class _ScanBlock(nn.Module):
     seq_axis: str
     use_flash: Optional[bool]
     decode: bool
+    num_experts: int = 0
 
     @nn.compact
     def __call__(self, x, positions):
-        x = Block(
+        x, aux = Block(
             self.num_heads,
             self.head_dim,
             self.mlp_dim,
@@ -227,9 +240,10 @@ class _ScanBlock(nn.Module):
             self.seq_axis,
             self.use_flash,
             self.decode,
+            self.num_experts,
             name="block",
         )(x, positions)
-        return x, None
+        return x, aux
 
 
 class TransformerLM(nn.Module):
@@ -249,6 +263,7 @@ class TransformerLM(nn.Module):
     seq_axis: str = "data"
     use_flash: Optional[bool] = None
     decode: bool = False
+    num_experts: int = 0  # >0: MoE-LM (Switch FFN in every block)
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = True):
@@ -271,6 +286,7 @@ class TransformerLM(nn.Module):
             self.seq_axis,
             self.use_flash,
             self.decode,
+            self.num_experts,
         )
         # Scan over a single stacked Block: compile time is O(1) in depth
         # instead of O(num_layers) — with a Python loop the 12-layer
@@ -286,7 +302,11 @@ class TransformerLM(nn.Module):
             in_axes=nn.broadcast,
             metadata_params={nn.meta.PARTITION_NAME: "layers"},
         )(*block_args, name="blocks")
-        x, _ = stack(x, positions)
+        x, layer_aux = stack(x, positions)
+        if self.num_experts > 0:
+            # Total Switch load-balance loss; training reads it via
+            # mutable=["losses"] (lm_train adds it to the CE loss).
+            self.sow("losses", "moe_aux", jnp.sum(layer_aux))
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
         # Final projection in TRUE f32 for a numerically stable softmax
         # loss: Embed.attend would promote the query back to the module

@@ -1,0 +1,122 @@
+"""Mixture-of-Experts FFN with expert parallelism — TPU-first.
+
+Switch-Transformer-style top-1 routing, built the way TPUs want it: the
+dispatch and combine are **dense one-hot einsums** (MXU work, static
+shapes) rather than scatters/gathers, so the whole layer jits into a
+few batched matmuls.  Expert parallelism is pure GSPMD: shard the
+leading expert axis of the expert weights (``expert_sharding``) and XLA
+inserts the all-to-all that moves token slots to their experts — no
+hand-written collectives, same recipe as the sharding of ``mesh.py``.
+
+Capacity semantics: each expert processes at most
+``ceil(capacity_factor * N / E)`` token slots; overflow tokens fall
+through the residual (their combine weight is zero), the standard
+Switch trade that keeps every shape static for XLA.
+
+The reference has no model-code analog (its scaling is infrastructure,
+SURVEY.md §2.3); this rounds out the parallelism layer's ep axis next
+to dp/tp (mesh.py), sp (seq.py), and pp (pipeline.py).
+"""
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from container_engine_accelerators_tpu.parallel.mesh import MODEL_AXIS
+
+
+class MoEFFN(nn.Module):
+    """Top-1 (Switch) MoE feed-forward: [..., D] -> [..., D].
+
+    ``num_experts`` gated SiLU MLPs; router in f32 for stable softmax.
+    Returns (output, aux_loss) where aux_loss is the Switch load-balance
+    loss (mean over experts of fraction_routed * mean_gate, scaled by E).
+    """
+
+    num_experts: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x):
+        *lead, d = x.shape
+        n = math.prod(lead)
+        e = self.num_experts
+        capacity = max(1, math.ceil(self.capacity_factor * n / e))
+        flat = x.reshape(n, d)
+
+        # Router (f32): top-1 expert and its gate probability.
+        logits = nn.Dense(
+            e, use_bias=False, dtype=jnp.float32, name="router"
+        )(flat.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [N]
+        gate = jnp.max(probs, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
+
+        # Capacity: position of each token within its expert's queue;
+        # tokens past the capacity drop out of the combine (residual
+        # carries them).  cumsum keeps it a static-shape VPU op.
+        pos = jnp.einsum(
+            "ne,ne->n", onehot, jnp.cumsum(onehot, axis=0) - 1.0
+        ).astype(jnp.int32)
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [N, C]
+        dispatch = (
+            onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
+        )  # [N, E, C]
+
+        # Move token slots to experts: dense einsum; under expert-sharded
+        # weights GSPMD turns this into the all-to-all.
+        slots = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(self.dtype), flat.astype(self.dtype)
+        )  # [E, C, D]
+
+        wi_gate = self.param(
+            "wi_gate", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, self.mlp_dim), jnp.float32,
+        )
+        wi_up = self.param(
+            "wi_up", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, self.mlp_dim), jnp.float32,
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, self.mlp_dim, d), jnp.float32,
+        )
+        h = nn.silu(
+            jnp.einsum("ecd,edh->ech", slots, wi_gate.astype(self.dtype))
+        ) * jnp.einsum("ecd,edh->ech", slots, wi_up.astype(self.dtype))
+        out_slots = jnp.einsum(
+            "ech,ehd->ecd", h, wo.astype(self.dtype)
+        )  # [E, C, D]
+
+        combine = dispatch * gate[:, None, None]  # [N, E, C]
+        out = jnp.einsum(
+            "nec,ecd->nd", combine.astype(self.dtype), out_slots
+        )
+
+        # Switch load-balance aux loss (f32).
+        frac_routed = jnp.mean(onehot, axis=0)  # [E]
+        mean_gate = jnp.mean(probs, axis=0)  # [E]
+        aux = e * jnp.sum(frac_routed * mean_gate)
+
+        return out.reshape(*lead, d).astype(self.dtype), aux
+
+
+def expert_sharding(mesh: Mesh, params, axis: str = MODEL_AXIS):
+    """NamedShardings placing each MoE weight's leading expert axis on
+    ``axis`` (expert parallelism); router weights replicate."""
+
+    def spec(path, x):
+        name = "/".join(str(p) for p in path)
+        if x.ndim == 3 and "router" not in name:
+            return NamedSharding(mesh, P(axis))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -1,0 +1,171 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over ICI.
+
+The reference has no in-repo model parallelism (SURVEY.md §2.3): its
+scaling story is infrastructure.  This module rounds out the TPU-native
+parallelism layer (dp/tp in ``mesh.py``, sp in ``seq.py``) with the
+remaining classic axis: **pipeline** parallelism, for models whose
+layers don't fit one chip's HBM.
+
+Design (the scaling-book collective-permute recipe, TPU-first):
+
+- Layer params arrive **stacked** on a leading axis — exactly the layout
+  ``nn.scan`` produces for the transformer (models/transformer.py) — and
+  are reshaped to ``[S, L/S, ...]``: stage-sharded over the mesh's
+  ``pipe`` axis, layers within a stage scanned locally.
+- The schedule is GPipe with M microbatches: at step t every stage runs
+  its local layer scan, then activations hop one stage down the ring via
+  ``lax.ppermute`` (ICI neighbor traffic only — stages are laid out so
+  hop distance is 1).  ``M + S - 1`` steps total; warmup/drain bubbles
+  compute on zeros, the standard trade against per-step dispatch.
+- Everything lives inside ONE ``shard_map`` + ``lax.fori_loop`` with a
+  static trip count, so XLA sees a single compiled program
+  (data-dependent Python control flow never enters the jit).
+- Reverse-mode AD falls out: static-bound fori_loop lowers to scan, and
+  ppermute transposes to the reverse permutation, which IS the backward
+  pipeline schedule — no hand-written backward pass.
+
+Composes with data parallelism: the mesh is ``(pipe, data)``; microbatch
+batch dims shard over ``data``, params over ``pipe``.
+"""
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS
+
+PIPE_AXIS = "pipe"
+
+
+def create_pipeline_mesh(
+    pipe: int,
+    data: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(pipe, data) mesh; consecutive devices form a stage ring so the
+    ppermute hops ride neighbor ICI links."""
+    devices = list(devices if devices is not None else jax.devices())
+    if pipe * data != len(devices):
+        raise ValueError(
+            f"mesh {pipe}x{data} != {len(devices)} devices"
+        )
+    arr = np.array(devices).reshape(pipe, data)
+    return Mesh(arr, (PIPE_AXIS, DATA_AXIS))
+
+
+def stage_params(stacked_params, num_stages: int):
+    """Reshape every stacked-layer leaf [L, ...] -> [S, L/S, ...]."""
+
+    def r(x):
+        if x.shape[0] % num_stages != 0:
+            raise ValueError(
+                f"{x.shape[0]} layers not divisible by {num_stages} stages"
+            )
+        return x.reshape(num_stages, x.shape[0] // num_stages, *x.shape[1:])
+
+    return jax.tree_util.tree_map(r, stacked_params)
+
+
+def unstage_params(staged_params):
+    """Inverse of :func:`stage_params`: [S, L/S, ...] -> [L, ...]."""
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+        staged_params,
+    )
+
+
+def staged_sharding(mesh: Mesh, staged_params):
+    """NamedShardings placing the leading stage axis on PIPE_AXIS."""
+    return jax.tree_util.tree_map(
+        lambda x: NamedSharding(mesh, P(PIPE_AXIS)), staged_params
+    )
+
+
+def make_pipeline_apply(
+    layer_fn: Callable,
+    mesh: Mesh,
+    num_microbatches: int,
+):
+    """Build ``apply(staged_params, x) -> y`` running all L layers as an
+    S-stage pipeline.
+
+    ``layer_fn(layer_params, x) -> x`` is one layer (shape-preserving);
+    ``staged_params`` leaves are [S, L/S, ...] placed with
+    :func:`staged_sharding`; ``x`` is [B, ...] with B divisible by
+    ``num_microbatches`` (and the microbatch by the data-axis size).
+    """
+    S = mesh.shape[PIPE_AXIS]
+    M = num_microbatches
+
+    def local_stage(chunk, x):
+        def body(c, p):
+            return layer_fn(p, c), None
+
+        y, _ = jax.lax.scan(body, x, chunk)
+        return y
+
+    def device_fn(staged, xs):
+        # staged leaves here: [1, L/S, ...] (this stage's chunk).
+        chunk = jax.tree_util.tree_map(lambda a: a[0], staged)
+        s = jax.lax.axis_index(PIPE_AXIS)
+
+        def vary_pipe(v):
+            # xs is replicated over pipe; the loop carry becomes
+            # pipe-varying after the first hop, so the initial value
+            # must carry that type too.
+            if hasattr(jax.lax, "pcast"):
+                return jax.lax.pcast(v, (PIPE_AXIS,), to="varying")
+            return jax.lax.pvary(v, (PIPE_AXIS,))
+
+        buf = vary_pipe(jnp.zeros_like(xs[0]))
+        outs = vary_pipe(jnp.zeros_like(xs))
+
+        def body(t, carry):
+            buf, outs = carry
+            # Stage 0 feeds microbatch t (clamped during drain); others
+            # consume the activation shifted in from the previous stage.
+            mb = jax.lax.dynamic_index_in_dim(
+                xs, jnp.minimum(t, M - 1), 0, keepdims=False
+            )
+            inp = jnp.where(s == 0, mb, buf)
+            y = local_stage(chunk, inp)
+            # The last stage emits microbatch t-(S-1) once it's real.
+            oidx = t - (S - 1)
+            emit = (s == S - 1) & (oidx >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                outs, y, jnp.clip(oidx, 0, M - 1), 0
+            )
+            outs = jnp.where(emit, updated, outs)
+            if S > 1:
+                buf = jax.lax.ppermute(
+                    y, PIPE_AXIS, [(i, i + 1) for i in range(S - 1)]
+                )
+            return buf, outs
+
+        _, outs = jax.lax.fori_loop(0, M + S - 1, body, (buf, outs))
+        # Replicate the result over the pipe axis (only the last stage
+        # holds it); a masked psum is the differentiable broadcast.
+        return jax.lax.psum(
+            jnp.where(s == S - 1, outs, jnp.zeros_like(outs)), PIPE_AXIS
+        )
+
+    mapped = shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P(PIPE_AXIS), P(None, DATA_AXIS)),
+        out_specs=P(None, DATA_AXIS),
+    )
+
+    def apply(staged_params, x):
+        b = x.shape[0]
+        if b % M != 0:
+            raise ValueError(f"batch {b} not divisible by {M} microbatches")
+        xs = x.reshape(M, b // M, *x.shape[1:])
+        ys = mapped(staged_params, xs)
+        return ys.reshape(b, *x.shape[1:])
+
+    return apply

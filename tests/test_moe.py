@@ -1,0 +1,135 @@
+"""MoE FFN with expert parallelism: routing math, capacity semantics,
+sharded-equals-unsharded, gradients."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from container_engine_accelerators_tpu.ops.moe import MoEFFN, expert_sharding
+from container_engine_accelerators_tpu.parallel import create_mesh
+
+B, T, D, H, E = 2, 16, 8, 32, 8
+
+
+def make_moe(**kw):
+    args = dict(num_experts=E, mlp_dim=H, dtype=jnp.float32)
+    args.update(kw)
+    return MoEFFN(**args)
+
+
+def init_vars(moe, key=0):
+    x = jax.random.normal(jax.random.PRNGKey(key), (B, T, D))
+    return x, moe.init(jax.random.PRNGKey(1), x)
+
+
+def test_identical_experts_equal_gated_dense_ffn():
+    """With every expert identical and ample capacity, MoE(x) must equal
+    gate_prob * FFN(x) — routing becomes irrelevant, only the top-1
+    gate scaling remains."""
+    moe = make_moe(capacity_factor=float(E))  # capacity = N: nothing drops
+    x, variables = init_vars(moe)
+    p = variables["params"]
+    shared = jax.tree_util.tree_map(
+        lambda w: jnp.broadcast_to(w[:1], w.shape) if w.ndim == 3 else w, p
+    )
+    out, aux = moe.apply({"params": shared}, x)
+
+    flat = x.reshape(-1, D)
+    logits = flat @ shared["router"]["kernel"]
+    gate = jnp.max(jax.nn.softmax(logits, -1), -1)
+    wi_g, wi_u, wo = (
+        shared["wi_gate"][0], shared["wi_up"][0], shared["wo"][0]
+    )
+    ref = (jax.nn.silu(flat @ wi_g) * (flat @ wi_u)) @ wo
+    ref = (ref * gate[:, None]).reshape(B, T, D)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+    assert np.isfinite(float(aux))
+
+
+def test_capacity_overflow_drops_to_zero():
+    """Tokens past an expert's capacity contribute nothing (the caller's
+    residual carries them) — and nothing NaNs."""
+    moe = make_moe(capacity_factor=1e-9)  # capacity = 1 slot per expert
+    x, variables = init_vars(moe)
+    out, _ = moe.apply(variables, x)
+    flat = np.asarray(out).reshape(-1, D)
+    zero_rows = np.sum(np.all(flat == 0.0, axis=1))
+    # At most E slots survive; with N=32 tokens and 8 experts, >= N - E
+    # rows must be exactly zero.
+    assert zero_rows >= B * T - E
+    assert np.all(np.isfinite(flat))
+
+
+def test_expert_sharded_matches_replicated():
+    """Expert parallelism is numerics-neutral: sharding the expert axis
+    over the mesh (GSPMD all-to-all dispatch) must not change outputs."""
+    moe = make_moe()
+    x, variables = init_vars(moe)
+    out_rep, _ = moe.apply(variables, x)
+
+    mesh = create_mesh(data=1, model=8)
+    placed = jax.device_put(
+        variables["params"], expert_sharding(mesh, variables["params"])
+    )
+    # The expert weights really are sharded over the model axis.
+    assert "model" in str(placed["wo"].sharding.spec)
+    assert placed["router"]["kernel"].sharding.spec == ()
+
+    out_sh, _ = jax.jit(lambda p, x: moe.apply({"params": p}, x))(placed, x)
+    np.testing.assert_allclose(
+        np.asarray(out_sh), np.asarray(out_rep), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_gradients_flow_and_aux_balances():
+    moe = make_moe()
+    x, variables = init_vars(moe)
+
+    def loss(p):
+        out, aux = moe.apply({"params": p}, x)
+        return jnp.mean(out**2) + 0.01 * aux
+
+    grads = jax.jit(jax.grad(loss))(variables["params"])
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(bool(jnp.all(jnp.isfinite(g))) for g in leaves)
+    # Router must receive gradient (through gate and aux terms).
+    assert float(jnp.max(jnp.abs(grads["router"]["kernel"]))) > 0
+
+
+def test_moe_lm_trains():
+    """MoE-LM family: Switch FFN in every scanned block, aux loss reaches
+    the training objective, loss decreases."""
+    import optax
+
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+        make_lm_train_step,
+        next_token_targets,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+    from container_engine_accelerators_tpu.parallel import create_mesh
+
+    lm = transformer_lm(
+        vocab_size=64, num_layers=2, num_heads=2, head_dim=8, mlp_dim=32,
+        num_experts=4,
+    )
+    toks = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 64)
+    state = create_lm_train_state(
+        lm, jax.random.PRNGKey(1), toks, tx=optax.adamw(1e-2)
+    )
+    # MoE expert weights exist stacked under the scanned blocks.
+    assert state.params["blocks"]["block"]["moe"]["wo"].shape == (
+        2, 4, 32, 16
+    )  # (layers, experts, mlp_dim, embed_dim)
+    mesh = create_mesh(data=4, model=2)
+    step, placed = make_lm_train_step(mesh, state)
+    labels, mask = next_token_targets(toks)
+    losses = []
+    for _ in range(8):
+        placed, m = step(placed, toks, labels, mask)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,113 @@
+"""Pipeline parallelism: GPipe schedule equivalence + gradients.
+
+The pipelined application of L stacked layers over an S-stage (pipe,
+data) mesh must compute exactly what the sequential layer scan computes
+— forward and backward — and must actually shard stage params over the
+pipe axis.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    create_pipeline_mesh,
+    make_pipeline_apply,
+    stage_params,
+    staged_sharding,
+    unstage_params,
+)
+
+L, D = 8, 16
+
+
+def layer_fn(p, x):
+    # One shape-preserving "layer": x @ W + b through a nonlinearity.
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def make_params(key):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (L, D, D)) * 0.3,
+        "b": jax.random.normal(kb, (L, D)) * 0.1,
+    }
+
+
+def sequential(params, x):
+    def body(c, p):
+        return layer_fn(p, c), None
+
+    y, _ = jax.lax.scan(body, x, params)
+    return y
+
+
+@pytest.mark.parametrize("pipe,data,microbatches", [(4, 2, 4), (8, 1, 2)])
+def test_pipeline_matches_sequential(pipe, data, microbatches):
+    mesh = create_pipeline_mesh(pipe, data)
+    params = make_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, D))
+
+    staged = jax.device_put(
+        stage_params(params, pipe),
+        staged_sharding(mesh, stage_params(params, pipe)),
+    )
+    apply = make_pipeline_apply(layer_fn, mesh, microbatches)
+    got = jax.jit(apply)(staged, x)
+    want = sequential(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    # Stage sharding is real: the leading axis lives on the pipe axis.
+    assert PIPE_AXIS in str(
+        jax.tree_util.tree_leaves(staged)[0].sharding.spec
+    )
+
+
+def test_pipeline_gradients_match_sequential():
+    """AD through the schedule: ppermute transposes to the reverse hop,
+    which IS the backward pipeline — grads must match the dense scan."""
+    pipe, mb = 4, 4
+    mesh = create_pipeline_mesh(pipe, 2)
+    params = make_params(jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, D))
+    tgt = jax.random.normal(jax.random.PRNGKey(4), (8, D))
+
+    apply = make_pipeline_apply(layer_fn, mesh, mb)
+
+    def pipe_loss(staged, x):
+        return jnp.mean((apply(staged, x) - tgt) ** 2)
+
+    def seq_loss(params, x):
+        return jnp.mean((sequential(params, x) - tgt) ** 2)
+
+    staged = jax.device_put(
+        stage_params(params, pipe),
+        staged_sharding(mesh, stage_params(params, pipe)),
+    )
+    g_pipe = unstage_params(jax.jit(jax.grad(pipe_loss))(staged, x))
+    g_seq = jax.jit(jax.grad(seq_loss))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_stage_params_roundtrip_and_validation():
+    params = make_params(jax.random.PRNGKey(5))
+    staged = stage_params(params, 4)
+    assert staged["w"].shape == (4, 2, D, D)
+    back = unstage_params(staged)
+    np.testing.assert_array_equal(np.asarray(back["w"]),
+                                  np.asarray(params["w"]))
+    with pytest.raises(ValueError, match="not divisible"):
+        stage_params(params, 3)
+
+
+def test_batch_not_divisible_rejected():
+    mesh = create_pipeline_mesh(4, 2)
+    params = stage_params(make_params(jax.random.PRNGKey(6)), 4)
+    apply = make_pipeline_apply(layer_fn, mesh, 3)
+    with pytest.raises(ValueError, match="microbatches"):
+        apply(params, jnp.ones((8, D)))
