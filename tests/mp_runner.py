@@ -1,0 +1,69 @@
+"""Shared scaffolding for multi-process (jax.distributed) tests.
+
+Used by tests/test_dcn_rendezvous.py and
+tests/test_multiprocess_train.py.  Output goes to temp files rather
+than pipes (a blocked pipe writer stalls BOTH collectively-coupled
+processes), and every exit path kills AND reaps all children so a
+failing worker never leaks its sibling into later tests.
+"""
+
+import socket
+import subprocess
+import tempfile
+import time
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_procs(cmds, envs, cwd, timeout=420):
+    """Run len(cmds) processes to completion; return their outputs.
+
+    Asserts every process exits 0.  On timeout or any failure, all
+    processes are killed and reaped before the assertion propagates.
+    """
+    procs, files = [], []
+    try:
+        for cmd, env in zip(cmds, envs):
+            f = tempfile.TemporaryFile(mode="w+")
+            files.append(f)
+            procs.append(
+                subprocess.Popen(
+                    cmd, env=env, cwd=cwd, text=True,
+                    stdout=f, stderr=subprocess.STDOUT,
+                )
+            )
+        deadline = time.monotonic() + timeout
+        timed_out = False
+        for p in procs:
+            try:
+                p.wait(timeout=max(5, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                timed_out = True
+                break
+        outs = []
+        for f in files:
+            f.seek(0)
+            outs.append(f.read())
+        if timed_out:
+            raise AssertionError(
+                "multi-process run deadlocked (timeout); partial output:\n"
+                + "\n---\n".join(o[-1500:] for o in outs)
+            )
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+        return outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for f in files:
+            f.close()

@@ -12,21 +12,14 @@ cluster; derivation is asserted in a real worker process instead.)
 """
 
 import os
-import socket
 import subprocess
 import sys
-import time
 
 from container_engine_accelerators_tpu.utils.cpuenv import cpu_mesh_env
+from tests.mp_runner import free_port, run_procs
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO_ROOT, "tests", "dcn_rendezvous_worker.py")
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _worker_env(extra):
@@ -37,41 +30,20 @@ def _worker_env(extra):
 
 
 def test_two_process_rendezvous_and_global_reduce():
-    port = _free_port()
+    port = free_port()
     common = {
         "TPU_WORKER_COUNT": "2",
         "TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
     }
-    procs = []
-    for pid in range(2):
-        # Worker 1 uses the indexed-Job fallback env instead of
-        # TPU_WORKER_ID — both production spellings get exercised.
-        id_env = (
-            {"TPU_WORKER_ID": "0"} if pid == 0
-            else {"JOB_COMPLETION_INDEX": "1"}
-        )
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, WORKER],
-                env=_worker_env({**common, **id_env}),
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                cwd=REPO_ROOT,
-            )
-        )
-    deadline = time.monotonic() + 240
-    outs = []
-    for p in procs:
-        timeout = max(5.0, deadline - time.monotonic())
-        try:
-            out, err = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise AssertionError("rendezvous deadlocked (timeout)")
-        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-        outs.append(out)
+    # Worker 1 uses the indexed-Job fallback env instead of
+    # TPU_WORKER_ID — both production spellings get exercised.
+    envs = [
+        _worker_env({**common, "TPU_WORKER_ID": "0"}),
+        _worker_env({**common, "JOB_COMPLETION_INDEX": "1"}),
+    ]
+    outs = run_procs(
+        [[sys.executable, WORKER]] * 2, envs, cwd=REPO_ROOT, timeout=240
+    )
 
     # Global array: 4 rows of 8 from pid0 (value 1) + 4 rows of 8 from
     # pid1 (value 2) -> sum = 4*8*1 + 4*8*2 = 96.  Every process must

@@ -1,0 +1,69 @@
+"""Multi-process training drivers end-to-end on the CPU backend.
+
+tests/test_dcn_rendezvous.py proves the rendezvous primitive; these
+spawn TWO actual processes running the REAL training binaries
+(cmd/train_lm.py, cmd/train_resnet.py) through the full K8s env
+contract — jax.distributed init, global-batch assembly across
+processes (make_array_from_callback / make_array_from_process_local_
+data), sharded train steps with cross-process collectives.  This is
+the path ADVICE round 1 flagged as untested (host-local batches fed to
+a full-mesh jit fail exactly here).
+"""
+
+import os
+import sys
+
+from container_engine_accelerators_tpu.utils.cpuenv import cpu_mesh_env
+from tests.mp_runner import free_port, run_procs
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_two(argv, timeout=420):
+    port = free_port()
+    envs = []
+    for pid in range(2):
+        env = cpu_mesh_env(2)  # 2 local devices -> 4 global
+        env.update(
+            {
+                "TPU_WORKER_COUNT": "2",
+                "TPU_WORKER_ID": str(pid),
+                "TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            }
+        )
+        envs.append(env)
+    cmds = [[sys.executable] + argv] * 2
+    return run_procs(cmds, envs, cwd=REPO_ROOT, timeout=timeout)
+
+
+def test_train_lm_two_process_ring():
+    """Ring sequence parallelism across 2 processes x 2 devices: the
+    sequence shards span process boundaries, so every ring hop after the
+    first crosses processes."""
+    outs = _run_two(
+        [
+            "cmd/train_lm.py", "--num-layers", "1", "--num-heads", "2",
+            "--head-dim", "8", "--mlp-dim", "32", "--vocab-size", "64",
+            "--seq-len", "32", "--train-batch-size", "2",
+            "--train-steps", "2", "--seq-parallel", "ring",
+            "--steps-per-eval", "1",
+        ]
+    )
+    for out in outs:
+        assert "loss=" in out
+
+
+def test_train_resnet_two_process_dp():
+    """Data-parallel ResNet across 2 processes: per-process local batch
+    shards assemble into the global batch; gradient all-reduce crosses
+    processes."""
+    outs = _run_two(
+        [
+            "cmd/train_resnet.py", "--resnet-depth", "18",
+            "--train-batch-size", "8", "--train-steps", "2",
+            "--image-size", "32", "--num-classes", "8",
+            "--steps-per-eval", "1",
+        ]
+    )
+    for out in outs:
+        assert "done: 2 steps" in out
