@@ -7,7 +7,7 @@ DCNXFERD_BUILD := native/dcnxferd/build
 DCNFASTSOCK_BUILD := native/dcnfastsock/build
 DCNCOLLPERF_BUILD := native/dcncollperf/build
 
-.PHONY: all native test presubmit proto clean
+.PHONY: all native test test-all presubmit proto clean
 
 all: native
 
@@ -37,7 +37,13 @@ $(DCNXFERD_BUILD)/dcnxferd: native/dcnxferd/dcnxferd.cc
 	g++ -std=c++17 -O2 -Wall -Wextra \
 	    -o $(DCNXFERD_BUILD)/dcnxferd native/dcnxferd/dcnxferd.cc
 
+# Short mode, the reference's `go test -short` (ref Makefile:20-22):
+# skips the @pytest.mark.slow compile-heavy integration tests so the
+# default gate stays fast on small hosts.  `make test-all` runs them.
 test: native
+	$(PY) -m pytest tests/ -x -q -m "not slow"
+
+test-all: native
 	$(PY) -m pytest tests/ -x -q
 
 presubmit:

@@ -240,13 +240,14 @@ def _run_lm(on_accel: bool):
     seq = int(os.environ.get("BENCH_SEQ", "4096" if on_accel else "256"))
     layers = int(os.environ.get("BENCH_LM_LAYERS", "12" if on_accel else "2"))
 
+    flash_env = os.environ.get("BENCH_LM_FLASH", "1") == "1"
     lm = transformer_lm(
         vocab_size=32_768,
         num_layers=layers,
         num_heads=16,
         head_dim=64,
         mlp_dim=4096,
-        use_flash=True if on_accel else None,
+        use_flash=(True if on_accel else None) if flash_env else False,
     )
     rng = jax.random.PRNGKey(0)
     # Nonce-seeded batches: see _run_resnet on the execution cache.
@@ -266,17 +267,25 @@ def _run_lm(on_accel: bool):
     step_fn, placed = make_lm_train_step(mesh, state)
 
     batches = [next_token_targets(t) for t in toks]
-    step_fn, flops_per_step = _compile_step(
+    step_fn, xla_flops = _compile_step(
         step_fn, placed, toks[0], batches[0][0], batches[0][1]
     )
     n_params = sum(
         x.size for x in jax.tree_util.tree_leaves(placed.params)
     )
-    if not flops_per_step:
-        # PaLM-appendix analytic: 6*N per token + causal attention term.
-        flops_per_step = batch * seq * (
-            6 * n_params + 12 * layers * 16 * 64 * seq // 2
-        )
+    # MFU convention: analytic MODEL FLOPs (PaLM appendix: 6*N per token
+    # + causal attention term), NOT the executed-FLOP count — XLA's
+    # cost_analysis both misses the Pallas custom-call FLOPs and counts
+    # remat recompute, so it can swing far in either direction (observed
+    # 5x low on the remat+flash step).
+    flops_per_step = batch * seq * (
+        6 * n_params + 12 * layers * 16 * 64 * seq // 2
+    )
+    print(
+        f"bench: model flops/step {flops_per_step / 1e12:.2f}T "
+        f"(xla cost_analysis said {xla_flops / 1e12:.2f}T)",
+        file=sys.stderr,
+    )
 
     placed, m = step_fn(placed, toks[0], *batches[0])
     for i in range(4 if on_accel else 1):

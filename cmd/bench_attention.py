@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Attention microbenchmark: Pallas flash kernel vs XLA dense attention.
+
+Times forward and forward+backward at training shapes on the attached
+accelerator, sweeping flash block sizes, so kernel tuning is measured
+rather than guessed.  The reference repo benchmarks its comms stack the
+same way (nccl-tests sweep, gpudirect-tcpx/nccl-config.yaml:60-63);
+this is the per-op analog for the transformer workload's hot op.
+
+Usage:
+  python cmd/bench_attention.py [--seq 4096] [--batch 8] [--heads 16]
+                                [--head-dim 64] [--steps 20]
+
+Prints one human table and one JSON line per configuration.
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seq", type=int, default=4096)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--blocks", default="128x128,256x128,256x256,512x256",
+                   help="comma-separated flash QxK block sizes to sweep")
+    return p.parse_args(argv)
+
+
+def _time_fn(fn, args, steps):
+    """Median-of-3 timing of ``steps`` back-to-back dispatches."""
+    import jax
+
+    out = fn(*args)  # compile + warmup
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) / steps)
+    return sorted(times)[1]
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.ops.flash_attention import (
+        flash_attention,
+    )
+    from container_engine_accelerators_tpu.parallel.seq import (
+        dense_attention,
+    )
+
+    b, t, h, d = args.batch, args.seq, args.heads, args.head_dim
+    keys = jax.random.split(jax.random.PRNGKey(int(time.time_ns()) & 0xFFFF), 4)
+    q = jax.random.normal(keys[0], (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(keys[1], (b, t, h, d), jnp.bfloat16)
+    v = jax.random.normal(keys[2], (b, t, h, d), jnp.bfloat16)
+    g = jax.random.normal(keys[3], (b, t, h, d), jnp.bfloat16)
+    jax.block_until_ready((q, k, v, g))
+
+    # Causal attention FLOPs: QK^T + PV, half the square each.
+    fwd_flops = 2 * 2 * 0.5 * b * h * t * t * d
+    bwd_flops = fwd_flops * 2.5  # dq + dk/dv recompute-based passes
+
+    def loss_of(attn):
+        def f(q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32) * g.astype(jnp.float32))
+
+        return f
+
+    configs = []
+    for spec in args.blocks.split(","):
+        bq, bk = (int(x) for x in spec.strip().split("x"))
+        if t % bq or t % bk:
+            print(f"skip {spec}: T={t} not divisible", file=sys.stderr)
+            continue
+        fn = functools.partial(flash_attention, causal=True,
+                               block_q=bq, block_k=bk)
+        configs.append((f"flash_{bq}x{bk}", fn))
+    configs.append(("xla_dense", functools.partial(dense_attention, causal=True)))
+
+    print(f"attention bench: B={b} T={t} H={h} D={d} "
+          f"({jax.devices()[0].device_kind})", file=sys.stderr)
+    rows = []
+    for name, attn in configs:
+        fwd = jax.jit(lambda q, k, v, a=attn: a(q, k, v))
+        grad = jax.jit(jax.grad(loss_of(attn), argnums=(0, 1, 2)))
+        tf = _time_fn(fwd, (q, k, v), args.steps)
+        tg = _time_fn(grad, (q, k, v), args.steps)
+        row = {
+            "config": name, "B": b, "T": t, "H": h, "D": d,
+            "fwd_ms": round(tf * 1e3, 3),
+            "fwd_tflops": round(fwd_flops / tf / 1e12, 2),
+            "fwdbwd_ms": round(tg * 1e3, 3),
+            "fwdbwd_tflops": round((fwd_flops + bwd_flops) / tg / 1e12, 2),
+        }
+        rows.append(row)
+        print(json.dumps(row))
+
+    width = max(len(r["config"]) for r in rows)
+    print(f"\n{'config':<{width}}  fwd ms  fwd TF/s  fwd+bwd ms  fwd+bwd TF/s",
+          file=sys.stderr)
+    for r in rows:
+        print(
+            f"{r['config']:<{width}}  {r['fwd_ms']:6.2f}  {r['fwd_tflops']:8.2f}"
+            f"  {r['fwdbwd_ms']:10.2f}  {r['fwdbwd_tflops']:12.2f}",
+            file=sys.stderr,
+        )
+
+
+if __name__ == "__main__":
+    main()

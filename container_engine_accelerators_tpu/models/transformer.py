@@ -264,6 +264,7 @@ class TransformerLM(nn.Module):
     use_flash: Optional[bool] = None
     decode: bool = False
     num_experts: int = 0  # >0: MoE-LM (Switch FFN in every block)
+    remat: bool = True  # rematerialize blocks in backward (saves HBM)
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = True):
@@ -294,8 +295,20 @@ class TransformerLM(nn.Module):
         # XLA sees one layer either way.  Decode mode scans its KV cache
         # along the same leading layer axis, so train-mode params load
         # directly into a decode-mode model (one param-tree layout).
+        # Remat each scanned layer: without it the backward saves every
+        # layer's SwiGLU/attention activations (O(layers * B * T * mlp)
+        # HBM — a 12L/4096-seq train step OOMs a 16 GB chip); with it the
+        # scan carry is the only per-layer residual and the block
+        # recomputes inside the backward sweep.  prevent_cse=False is the
+        # documented setting under scan (the loop structure already
+        # prevents the CSE remat guards against).
+        block_cls = (
+            nn.remat(_ScanBlock, prevent_cse=False)
+            if self.remat
+            else _ScanBlock
+        )
         stack = nn.scan(
-            _ScanBlock,
+            block_cls,
             variable_axes={"params": 0, "cache": 0},
             split_rngs={"params": True},
             length=self.num_layers,
@@ -308,13 +321,16 @@ class TransformerLM(nn.Module):
             # mutable=["losses"] (lm_train adds it to the CE loss).
             self.sow("losses", "moe_aux", jnp.sum(layer_aux))
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
-        # Final projection in TRUE f32 for a numerically stable softmax
-        # loss: Embed.attend would promote the query back to the module
-        # dtype (bf16), so tie the weights manually with both operands
-        # cast to f32.
+        # Final projection with TRUE f32 logits for a numerically stable
+        # softmax loss: Embed.attend would promote the query back to the
+        # module dtype (bf16), so tie the weights manually.  Operands stay
+        # in the compute dtype with f32 ACCUMULATION
+        # (preferred_element_type) — the MXU runs at bf16 rate and the
+        # logits tensor still comes out f32.
         return jnp.dot(
-            x.astype(jnp.float32),
-            emb.embedding.T.astype(jnp.float32),
+            x,
+            emb.embedding.T.astype(x.dtype),
+            preferred_element_type=jnp.float32,
         )
 
 

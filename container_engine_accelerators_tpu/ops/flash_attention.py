@@ -115,13 +115,13 @@ def _from_bh(x, b, h):  # [B*H, T, D] -> [B, T, H, D]
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _fa_forward(q, k, v, causal, scale, interpret):
+def _fa_forward(q, k, v, causal, scale, interpret, block_q, block_k):
     """Pallas forward on [B, T, H, D] -> (out, lse [B*H, T, LSE_LANES])."""
     b, t, h, d = q.shape
     qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
-    grid = (b * h, t // BLOCK_Q)
+    grid = (b * h, t // block_q)
     kernel = functools.partial(
-        _fa_kernel, causal=causal, scale=scale, block_k=BLOCK_K
+        _fa_kernel, causal=causal, scale=scale, block_k=block_k
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -131,13 +131,13 @@ def _fa_forward(q, k, v, causal, scale, interpret):
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, BLOCK_Q, LSE_LANES), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda bh, i: (bh, i, 0)),
         ),
         interpret=interpret,
     )(qf, kf, vf)
@@ -265,24 +265,25 @@ def _fa_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, o_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret):
+def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret, block_q,
+                 block_k):
     """Pallas backward on [B,T,H,D] primals; lse is [B*H,T,LSE_LANES]."""
     b, t, h, d = q.shape
     qf, kf, vf = _to_bh(q), _to_bh(k), _to_bh(v)
     of, gf = _to_bh(o), _to_bh(g)
 
     full = pl.BlockSpec((1, t, d), lambda bh, i: (bh, 0, 0))
-    blk_q = pl.BlockSpec((1, BLOCK_Q, d), lambda bh, i: (bh, i, 0))
-    blk_k = pl.BlockSpec((1, BLOCK_K, d), lambda bh, i: (bh, i, 0))
+    blk_q = pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0))
+    blk_k = pl.BlockSpec((1, block_k, d), lambda bh, i: (bh, i, 0))
     lse_full = pl.BlockSpec((1, t, LSE_LANES), lambda bh, i: (bh, 0, 0))
-    lse_blk = pl.BlockSpec((1, BLOCK_Q, LSE_LANES), lambda bh, i: (bh, i, 0))
+    lse_blk = pl.BlockSpec((1, block_q, LSE_LANES), lambda bh, i: (bh, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(
-            _fa_bwd_dq_kernel, causal=causal, scale=scale, block_k=BLOCK_K
+            _fa_bwd_dq_kernel, causal=causal, scale=scale, block_k=block_k
         ),
         out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        grid=(b * h, t // BLOCK_Q),
+        grid=(b * h, t // block_q),
         in_specs=[blk_q, full, full, blk_q, blk_q, lse_blk],
         out_specs=blk_q,
         interpret=interpret,
@@ -291,13 +292,13 @@ def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret):
     dk, dv = pl.pallas_call(
         functools.partial(
             _fa_bwd_dkv_kernel, causal=causal, scale=scale,
-            block_q=BLOCK_Q,
+            block_q=block_q,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, t, d), v.dtype),
         ),
-        grid=(b * h, t // BLOCK_K),
+        grid=(b * h, t // block_k),
         in_specs=[blk_k, blk_k, full, full, full, lse_full],
         out_specs=(blk_k, blk_k),
         interpret=interpret,
@@ -310,32 +311,38 @@ def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret):
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention(q, k, v, causal=False, scale=None, interpret=False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None, interpret=False,
+                    block_q=None, block_k=None):
     """Flash attention on [B, T, H, D]; T must be a multiple of 128.
 
     ``interpret=True`` runs the kernels in the Pallas interpreter
-    (hardware-free, used by the test suite).
+    (hardware-free, used by the test suite).  ``block_q``/``block_k``
+    override the Q/K tile sizes (defaults BLOCK_Q/BLOCK_K); T must be a
+    multiple of both.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    out, _ = _fa_forward(q, k, v, causal, scale, interpret)
+    out, _ = _fa_forward(q, k, v, causal, scale, interpret,
+                         block_q or BLOCK_Q, block_k or BLOCK_K)
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale, interpret):
+def _fa_fwd(q, k, v, causal, scale, interpret, block_q, block_k):
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
-    out, lse = _fa_forward(q, k, v, causal, scale_, interpret)
+    out, lse = _fa_forward(q, k, v, causal, scale_, interpret,
+                           block_q or BLOCK_Q, block_k or BLOCK_K)
     # The lane-broadcast lse is 128 identical copies; keep only one lane
     # in the residual so HBM held from forward to backward is [B*H, T]
     # f32, not 128x that.  The backward re-broadcasts just-in-time.
     return out, (q, k, v, out, lse[..., 0])
 
 
-def _fa_bwd(causal, scale, interpret, res, g):
+def _fa_bwd(causal, scale, interpret, block_q, block_k, res, g):
     q, k, v, o, lse = res
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     lse_lanes = jnp.broadcast_to(lse[..., None], (*lse.shape, LSE_LANES))
-    return _fa_backward(q, k, v, o, lse_lanes, g, causal, scale_, interpret)
+    return _fa_backward(q, k, v, o, lse_lanes, g, causal, scale_,
+                        interpret, block_q or BLOCK_Q, block_k or BLOCK_K)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -81,6 +81,7 @@ def test_max_to_keep_prunes_old_steps(trained, tmp_path):
     assert steps[-1] == 6  # 3 + 3
 
 
+@pytest.mark.slow  # two driver subprocess compiles; `make test-all` / CI
 def test_driver_resume(tmp_path):
     """Run the real training driver twice against one checkpoint dir: the
     second invocation must resume at the saved step, not step 0."""

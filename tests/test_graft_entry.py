@@ -3,6 +3,8 @@
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
@@ -21,6 +23,7 @@ def test_entry_compiles(monkeypatch):
     assert out.shape == (2, 1000)
 
 
+@pytest.mark.slow  # duplicates the driver MULTICHIP artifact; `make test-all` / CI
 def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 

@@ -105,6 +105,7 @@ def test_sharded_train_step_mesh_and_equivalence(tiny_sharded, local_step):
     assert any("model" in str(s) for s in specs), specs
 
 
+@pytest.mark.slow  # three full inception compiles; `make test-all` / CI
 def test_inception_v3_family():
     """Second demo model family (demo/tpu-training/inception-v3-tpu.yaml
     analog) in one compile: build plan, forward shape/dtype policy, and

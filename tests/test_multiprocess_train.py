@@ -13,10 +13,15 @@ a full-mesh jit fail exactly here).
 import os
 import sys
 
+import pytest
+
 from container_engine_accelerators_tpu.utils.cpuenv import cpu_mesh_env
 from tests.mp_runner import free_port, run_procs
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Two full subprocess compiles per test: short-mode (`make test`) skips.
+pytestmark = pytest.mark.slow
 
 
 def _run_two(argv, timeout=420):
