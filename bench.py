@@ -30,9 +30,19 @@ run (set ``BENCH_ALLOW_CPU_FALLBACK=0`` to fail hard instead).
 Prints exactly ONE JSON line on stdout:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}
 
+Round-3 hardening (VERDICT.md round 2): a successful on-chip
+measurement is now PERSISTED — every TPU (non-fallback) run appends its
+JSON line, with nonce / loss trajectory / timestamp / commit, to the
+committed ``BENCH_TPU_LOG.jsonl``; and the CPU fallback embeds the most
+recent logged TPU entry (``last_tpu``) in its own JSON line, so a
+tunnel wedge at snapshot time no longer erases the round's perf
+evidence.  Retry is governed by a total TIME budget
+(``BENCH_RETRY_BUDGET``, default 2400 s — round 2's wedge outlasted the
+old ~8-minute attempt envelope), not a fixed attempt count.
+
 Env knobs: BENCH_WORKLOAD, BENCH_BATCH, BENCH_STEPS, BENCH_DEPTH,
-BENCH_SEQ, BENCH_MAX_ATTEMPTS, BENCH_ATTEMPT_TIMEOUT,
-BENCH_ALLOW_CPU_FALLBACK.
+BENCH_SEQ, BENCH_RETRY_BUDGET, BENCH_MAX_ATTEMPTS,
+BENCH_ATTEMPT_TIMEOUT, BENCH_ALLOW_CPU_FALLBACK.
 """
 
 import json
@@ -187,7 +197,8 @@ def _run_resnet(on_accel: bool):
     state, m = step_fn(state, xs[0], ys[0])
     for i in range(4 if on_accel else 1):
         state, m = step_fn(state, xs[i % n_batches], ys[i % n_batches])
-    print(f"bench: warmup loss {float(m['loss']):.4f}", file=sys.stderr)
+    warmup_loss = float(m["loss"])
+    print(f"bench: warmup loss {warmup_loss:.4f}", file=sys.stderr)
 
     t0 = time.perf_counter()
     for i in range(steps):
@@ -217,6 +228,11 @@ def _run_resnet(on_accel: bool):
         "mfu": round(mfu, 4) if on_accel else None,
         "peak_tflops": peak / 1e12,
         "peak_source": peak_src,
+        "batch": batch,
+        "steps": steps,
+        "nonce": nonce,
+        "warmup_loss": round(warmup_loss, 4),
+        "final_loss": round(final_loss, 4),
     }
 
 
@@ -290,7 +306,8 @@ def _run_lm(on_accel: bool):
     placed, m = step_fn(placed, toks[0], *batches[0])
     for i in range(4 if on_accel else 1):
         placed, m = step_fn(placed, toks[i % n_batches], *batches[i % n_batches])
-    print(f"bench: warmup loss {float(m['loss']):.4f}", file=sys.stderr)
+    warmup_loss = float(m["loss"])
+    print(f"bench: warmup loss {warmup_loss:.4f}", file=sys.stderr)
 
     t0 = time.perf_counter()
     for i in range(steps):
@@ -318,7 +335,64 @@ def _run_lm(on_accel: bool):
         "seq_len": seq,
         "peak_tflops": peak / 1e12,
         "peak_source": peak_src,
+        "batch": batch,
+        "steps": steps,
+        "nonce": nonce,
+        "warmup_loss": round(warmup_loss, 4),
+        "final_loss": round(final_loss, 4),
     }
+
+
+TPU_LOG = os.path.join(_REPO_ROOT, "BENCH_TPU_LOG.jsonl")
+
+
+def _log_tpu_result(result: dict) -> None:
+    """Append an on-chip result to the committed BENCH_TPU_LOG.jsonl.
+
+    This is the round-3 fix for the round-2 failure mode: the real
+    measurement existed only in prose (BENCH_HW.md) and the wedged
+    tunnel at snapshot time left a CPU fallback as the artifact of
+    record.  Logging every successful run machine-readably means the
+    fallback can carry provenance-stamped TPU evidence.
+    """
+    entry = dict(result)
+    entry["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO_ROOT,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+        if commit:
+            entry["commit"] = commit
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        pass
+    try:
+        with open(TPU_LOG, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        print(f"bench: could not append {TPU_LOG}: {e}", file=sys.stderr)
+
+
+def _latest_logged_tpu(workload: str):
+    """Most recent logged on-chip entry for this workload (None if none)."""
+    try:
+        with open(TPU_LOG) as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    prefix = "lm_" if workload == "lm" else "resnet"
+    for line in reversed(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue
+        metric = entry.get("metric", "")
+        if metric.startswith(prefix) and "cpufallback" not in metric:
+            return entry
+    return None
 
 
 def inner_main():
@@ -332,6 +406,8 @@ def inner_main():
         result = _run_lm(on_accel)
     else:
         result = _run_resnet(on_accel)
+    if on_accel:
+        _log_tpu_result(result)
     print(json.dumps(result))
 
 
@@ -384,19 +460,32 @@ def orchestrate() -> int:
     """Retry the benchmark in fresh subprocesses; CPU-fallback at the end.
 
     Backend-init failure (UNAVAILABLE) is cached per-process by JAX, so
-    each attempt is a fresh interpreter.
+    each attempt is a fresh interpreter.  Retry is bounded by a total
+    TIME budget (BENCH_RETRY_BUDGET, default 40 min): round 2's tunnel
+    wedge outlasted the old ~8-minute attempt envelope and the round's
+    artifact of record degraded to a CPU run.  An attempt-count cap
+    (BENCH_MAX_ATTEMPTS) remains as a runaway backstop.
     """
-    attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "3"))
+    budget = float(os.environ.get("BENCH_RETRY_BUDGET", "2400"))
+    attempts = int(os.environ.get("BENCH_MAX_ATTEMPTS", "40"))
     timeout = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "900"))
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
     cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1800"))
     backoffs = [10, 30, 60, 90, 120]
     cmd = [sys.executable, os.path.abspath(__file__)]
+    deadline = time.monotonic() + budget
 
     for attempt in range(attempts):
+        if time.monotonic() >= deadline:
+            print(
+                f"bench: retry budget ({budget:.0f}s) exhausted after "
+                f"{attempt} attempts",
+                file=sys.stderr,
+            )
+            break
+        wait = backoffs[min(attempt, len(backoffs) - 1)]
         if not _probe_backend(probe_timeout):
-            if attempt + 1 < attempts:
-                wait = backoffs[min(attempt, len(backoffs) - 1)]
+            if time.monotonic() + wait < deadline:
                 print(f"bench: retrying probe in {wait}s", file=sys.stderr)
                 time.sleep(wait)
             continue
@@ -432,21 +521,21 @@ def orchestrate() -> int:
             or "BenchMeasurementError" in proc.stderr
         )
         if not transient and attempt >= 1:
-            break  # persistent failure — stop burning attempts
-        if attempt + 1 >= attempts:
-            break  # last attempt: no point sleeping before the fallback
-        wait = backoffs[min(attempt, len(backoffs) - 1)]
-        print(
-            f"bench: TPU backend unavailable; retrying in {wait}s "
-            f"(diagnostics above; tunnel may still be warming)",
-            file=sys.stderr,
-        )
-        time.sleep(wait)
+            break  # persistent failure — stop burning the budget
+        if time.monotonic() + wait < deadline:
+            print(
+                f"bench: TPU backend unavailable; retrying in {wait}s "
+                f"(diagnostics above; tunnel may still be warming)",
+                file=sys.stderr,
+            )
+            time.sleep(wait)
 
     if os.environ.get("BENCH_ALLOW_CPU_FALLBACK", "1") != "1":
         print("bench: all TPU attempts failed; fallback disabled",
               file=sys.stderr)
         return 1
+    workload = os.environ.get("BENCH_WORKLOAD", "resnet")
+    last_tpu = _latest_logged_tpu(workload)
     print(
         "bench: all TPU attempts failed — falling back to a LABELED CPU "
         "run (metric name carries _cpufallback)",
@@ -462,7 +551,17 @@ def orchestrate() -> int:
         return 1
     sys.stderr.write(proc.stderr)
     if proc.returncode == 0 and proc.stdout.strip():
-        print(proc.stdout.strip().splitlines()[-1])
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+        if last_tpu is not None:
+            # Carry the most recent REAL measurement with provenance so a
+            # tunnel wedge at snapshot time cannot erase perf evidence.
+            result["last_tpu"] = last_tpu
+            result["last_tpu_note"] = (
+                "most recent on-chip measurement from the committed "
+                "BENCH_TPU_LOG.jsonl; this run fell back to CPU because "
+                "the TPU backend was unreachable within the retry budget"
+            )
+        print(json.dumps(result))
         return 0
     return 1
 

@@ -19,6 +19,14 @@ TPU error code registry (ours; the Xid-number analog):
   72  TensorCore hang / watchdog timeout
   31  invalid HBM memory access            (the Xid-31 fault-injection demo)
   13  program abort (user error)           (non-critical by default)
+
+The registry is a PROVISIONAL contract: libtpu publishes no numeric
+fault table, so these codes are defined by this stack and grounded by
+``health/runtime_map.py``, which classifies the error strings the
+runtime actually raises (captured on-chip transcripts in
+demo/tpu-error/hbm-oom/RESULTS.md) into registry codes and feeds the
+same event queue.  Swapping in a future official libtpu event table
+means updating runtime_map's patterns, not this state machine.
 """
 
 import logging

@@ -1,0 +1,93 @@
+"""Map observed TPU runtime/driver errors onto the error-code registry.
+
+The registry in health_checker.py is OUR contract (the Xid-number
+analog) — libtpu does not publish a numeric fault table the way the
+NVIDIA driver publishes Xids.  What the runtime actually surfaces to a
+workload is an ``XlaRuntimeError`` (or libtpu log line) whose text
+carries a gRPC-style status and a free-form message.  This module is
+the bridge: classify a captured runtime error into a registry code, and
+optionally report it into the event queue the health checker consumes
+(``/var/run/tpu/events``, tpulib/sysfs.py) so a REAL on-chip fault
+drives the same Unhealthy flow as an injected one.
+
+The patterns below are grounded in errors captured on the attached
+chip (see demo/tpu-error/hbm-oom/RESULTS.md for the recorded
+transcripts) plus libtpu's documented status usage; anything
+unrecognized maps to ``None`` rather than guessing a critical code.
+
+Reference analog: the Xid demo proves the CUDA OOB write produces
+Xid 31 in the driver's stream (demo/gpu-error/illegal-memory-access/
+vectorAdd.cu:29-35, README); this is the same grounding exercise for
+the TPU registry.
+"""
+
+import re
+from typing import Optional, Tuple
+
+from container_engine_accelerators_tpu.tpulib.sysfs import write_event_file
+
+# Registry codes (health_checker.py docstring).
+HBM_ECC = 48
+ICI_LINK = 63
+CORE_HANG = 72
+BAD_HBM_ACCESS = 31
+PROGRAM_ABORT = 13
+
+# Ordered (pattern, code, critical) — first match wins.  Hardware-fault
+# signatures come before resource/user errors so e.g. an "uncorrectable
+# ECC" message inside a RESOURCE_EXHAUSTED wrapper still maps to 48.
+_PATTERNS: Tuple[Tuple[str, int, bool], ...] = (
+    # Uncorrectable memory faults — chip-fatal.
+    (r"uncorrectable|double.?bit|ecc error", HBM_ECC, True),
+    # Interconnect faults — chip- (and usually slice-) fatal.
+    (r"ici\b.*(link|fail|fatal)|interconnect.*(error|down)", ICI_LINK, True),
+    # Hangs: the runtime's deadline/watchdog trips while a program is
+    # resident.  Includes the tunnel-visible form (DEADLINE_EXCEEDED on
+    # an execute call).
+    (r"watchdog|hang detected|deadline_exceeded.*execut", CORE_HANG, True),
+    # Wild addressing inside a program.
+    (r"(illegal|invalid).*(address|memory access)|out.of.bounds",
+     BAD_HBM_ACCESS, True),
+    # Resource exhaustion: a USER error (asked for more HBM than exists),
+    # not a chip fault — the chip stays schedulable.  Captured on-chip:
+    # "RESOURCE_EXHAUSTED: XLA:TPU compile permanent error. Ran out of
+    # memory in memory space hbm ..." (RESULTS.md).
+    (r"resource_exhausted|ran out of memory|out of memory|oom",
+     PROGRAM_ABORT, False),
+    # Generic program aborts / cancellations.  Anchored to the status
+    # form ("ABORTED: ...") — a bare "aborted" also appears in infra
+    # errors like "UNAVAILABLE: socket connection aborted", which are
+    # not device-health signals.
+    (r"\baborted:|internal: .*(abort|cancel)", PROGRAM_ABORT, False),
+)
+
+
+def classify(error_text: str) -> Optional[Tuple[int, bool]]:
+    """(registry code, critical?) for a runtime error string, or None.
+
+    None means "not a recognized device-health signal" — callers must
+    NOT fabricate an event for it.
+    """
+    text = error_text.lower()
+    for pattern, code, critical in _PATTERNS:
+        if re.search(pattern, text):
+            return code, critical
+    return None
+
+
+def report_runtime_error(
+    error_text: str,
+    device: Optional[str],
+    events_dir: str = "/var/run/tpu/events",
+) -> Optional[str]:
+    """Classify and, if recognized, drop an event file into the queue.
+
+    Returns the event path, or None when the error is not a health
+    signal.  The write is atomic (tmpfile + rename), matching the queue
+    contract in tpulib/sysfs.py.
+    """
+    got = classify(error_text)
+    if got is None:
+        return None
+    code, _ = got
+    return write_event_file(events_dir, code, device, error_text[:512])

@@ -6,8 +6,10 @@ register flows with the per-node daemon, which owns the pinned staging
 buffers; accounting rides the same socket.  Newline-delimited JSON.
 """
 
+import base64
 import json
 import socket
+import struct
 from typing import Optional
 
 DEFAULT_UDS_DIR = "/run/tpu-dcn"
@@ -97,6 +99,41 @@ class DcnXferClient:
         if nbytes is not None:
             req["bytes"] = nbytes
         return self._call(**req)
+
+    READ_CHUNK = 512 << 10  # daemon caps per-call reads (outbuf bound)
+
+    def read(self, flow: str, nbytes: int, offset: int = 0) -> bytes:
+        """Read back staged bytes (what a peer daemon landed into the
+        flow, or what ``put`` staged locally).  Base64 over the control
+        socket; reads larger than the daemon's 512 KiB per-call cap are
+        chunked by offset."""
+        out = bytearray()
+        while len(out) < nbytes:
+            chunk = min(nbytes - len(out), self.READ_CHUNK)
+            resp = self._call(op="read", flow=flow, bytes=chunk,
+                              offset=offset + len(out))
+            data = base64.b64decode(resp["data"])
+            if not data:
+                break
+            out.extend(data)
+        return bytes(out)
+
+    def put(self, flow: str, data: bytes, host: str = "127.0.0.1",
+            port: Optional[int] = None) -> None:
+        """Stage ``data`` into a flow's buffer via the data plane.
+
+        Frames the payload exactly as a peer daemon's ``send`` would
+        ("DXF1" magic, u32 LE name length, u64 LE payload length), so
+        local staging and remote landing exercise the same RX path.
+        """
+        if port is None:
+            port = self.data_port()
+        name = flow.encode()
+        hdr = b"DXF1" + struct.pack("<I", len(name)) + struct.pack(
+            "<Q", len(data)
+        )
+        with socket.create_connection((host, port), timeout=30) as s:
+            s.sendall(hdr + name + data)
 
     def stats(self) -> dict:
         return self._call(op="stats")

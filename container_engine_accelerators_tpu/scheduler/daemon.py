@@ -58,14 +58,21 @@ def job_creation_time(job: List[dict]):
 
 
 def pod_sorting_key(pod: dict):
-    """Completion index when present; otherwise (prefix, numeric-suffix)
-    so 'xxx-pod2' sorts before 'xxx-pod10'."""
+    """Uniform 3-tuple key: indexed pods first by completion index, then
+    unindexed pods by (name-prefix, numeric-suffix) so 'xxx-pod2' sorts
+    before 'xxx-pod10'.
+
+    The reference returns ``int`` for indexed pods and ``tuple`` for
+    unindexed ones (schedule-daemon.py:40-50), so a job mixing both
+    crashes ``sorted()`` with a TypeError; one key shape fixes that
+    without changing the order within either class.
+    """
     if pod.get("index") is not None:
-        return int(pod["index"])
+        return (0, "", int(pod["index"]))
     name = pod["name"]
     stripped = name.rstrip("0123456789")
     suffix = name[len(stripped):]
-    return (stripped, int(suffix) if suffix else 0)
+    return (1, stripped, int(suffix) if suffix else 0)
 
 
 # ---- discovery -------------------------------------------------------------

@@ -21,9 +21,15 @@ from typing import Optional, Tuple
 
 # A mismatch at the outermost hierarchy level costs DCN_FAR; each matching
 # level divides by DCN_LEVEL_FACTOR (same envelope as the reference,
-# schedule-daemon.py:66-70).  Any DCN distance dwarfs any ICI distance.
+# schedule-daemon.py:66-70).  Every cross-slice distance additionally
+# carries the DCN_MIN floor: without it, a cross-slice node in the same
+# rack cost 1e6/100^3 = 1.0 — CHEAPER than 2 ICI hops — and the packer
+# preferred hopping slices (= DCN traffic) over ICI neighbors.  DCN_MIN
+# exceeds any intra-slice ICI path (largest slices are ~tens of hops),
+# so ICI always wins; the hierarchy ordering rides on top additively.
 DCN_FAR = 1_000_000.0
 DCN_LEVEL_FACTOR = 100.0
+DCN_MIN = 1_000.0
 
 PLACEMENT_GROUP_LABEL = "cloud.google.com/gke-placement-group"
 CLUSTER_LABEL = "topology.gke.io/cluster"
@@ -100,9 +106,11 @@ def node_topology_distance(node1: dict, node2: dict) -> float:
     """Distance between two nodes for the assignment objective.
 
     Same slice + both have coords → ICI torus hops (small, < DCN floor).
-    Otherwise → hierarchical DCN distance: DCN_FAR at the first differing
-    level of (placement-group, cluster, rack, host), divided by
-    DCN_LEVEL_FACTOR per matching level; 0 when all four match.
+    Otherwise → DCN_MIN floor (so crossing slices always costs more than
+    any ICI path) plus the hierarchical distance: DCN_FAR at the first
+    differing level of (placement-group, cluster, rack, host), divided
+    by DCN_LEVEL_FACTOR per matching level; bare DCN_MIN when all four
+    match (co-located slices).
     """
     l1, l2 = node1["node_labels"], node2["node_labels"]
     slice1, slice2 = l1.get(SLICE_LABEL), l2.get(SLICE_LABEL)
@@ -118,6 +126,6 @@ def node_topology_distance(node1: dict, node2: dict) -> float:
     result = DCN_FAR
     for i in range(min(len(k1), len(k2))):
         if k1[i] != k2[i]:
-            return result
+            return DCN_MIN + result
         result /= DCN_LEVEL_FACTOR
-    return 0.0 if k1 and k1 == k2 else result
+    return DCN_MIN + (0.0 if k1 and k1 == k2 else result)

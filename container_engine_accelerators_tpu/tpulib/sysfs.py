@@ -188,12 +188,27 @@ def write_libtpu_install(root: str) -> str:
     return host_dir
 
 
-def post_event(root: str, code: int, device: Optional[str], message: str = "") -> None:
-    """Drop an error event into the queue (test + fault-injection helper)."""
-    events = os.path.join(root, "var/run/tpu/events")
-    os.makedirs(events, exist_ok=True)
+def write_event_file(
+    events_dir: str, code: int, device: Optional[str], message: str = ""
+) -> str:
+    """Atomically drop one event file into a queue directory.
+
+    THE event-queue producer: the fault-injection demo and the
+    runtime-error mapper both route through here, so the file contract
+    (atomic tmp+rename, monotonic-ns name, {code,device,message} JSON)
+    lives in exactly one place opposite the consumer above.
+    """
+    os.makedirs(events_dir, exist_ok=True)
     seq = time.monotonic_ns()
-    tmp = os.path.join(events, f".{seq}.tmp")
+    tmp = os.path.join(events_dir, f".{seq}.tmp")
     with open(tmp, "w") as f:
         json.dump({"code": code, "device": device, "message": message}, f)
-    os.rename(tmp, os.path.join(events, f"{seq}.json"))
+    final = os.path.join(events_dir, f"{seq}.json")
+    os.rename(tmp, final)
+    return final
+
+
+def post_event(root: str, code: int, device: Optional[str], message: str = "") -> None:
+    """Drop an error event into the queue (test + fault-injection helper)."""
+    write_event_file(os.path.join(root, "var/run/tpu/events"), code, device,
+                     message)

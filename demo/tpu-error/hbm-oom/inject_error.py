@@ -15,32 +15,41 @@ allocating past HBM capacity on the attached chip.
 """
 
 import argparse
-import json
 import os
 import sys
-import tempfile
-import time
+
+sys.path.insert(
+    0,
+    os.path.abspath(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..", "..")
+    ),
+)
 
 DEFAULT_EVENTS_DIR = "/var/run/tpu/events"
 
 
 def inject(events_dir: str, code: int, device: str, message: str) -> str:
-    """Atomically drop one event file into the queue; returns its path."""
-    os.makedirs(events_dir, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=events_dir, suffix=".tmp")
-    with os.fdopen(fd, "w") as f:
-        json.dump({"code": code, "device": device or None,
-                   "message": message}, f)
-    final = os.path.join(events_dir, f"{time.monotonic_ns()}.json")
-    os.rename(tmp, final)
-    return final
+    """Atomically drop one event file into the queue; returns its path.
+
+    Routed through tpulib's single event-file writer so producer and
+    consumer share one file contract."""
+    from container_engine_accelerators_tpu.tpulib.sysfs import write_event_file
+
+    return write_event_file(events_dir, code, device or None, message)
 
 
-def real_oom():
+def real_oom(events_dir: str, device: str):
     """Allocate past HBM capacity — a genuine device error, the closest
-    TPU analog of the CUDA OOB write."""
+    TPU analog of the CUDA OOB write.
+
+    The captured runtime error is classified through
+    health.runtime_map (the registry's grounding layer) and, when it is
+    a recognized health signal, reported into the event queue — the
+    full on-chip fault → classify → event → Unhealthy pipeline."""
     import jax
     import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.health import runtime_map
 
     dev = jax.devices()[0]
     stats = dev.memory_stats() or {}
@@ -48,8 +57,25 @@ def real_oom():
     n = int(limit * 2) // 4  # 2x HBM in f32
     print(f"allocating {n * 4 / 2**30:.1f} GiB on {dev} "
           f"(limit {limit / 2**30:.1f} GiB) ...")
-    x = jnp.ones((n,), jnp.float32)
-    x.block_until_ready()  # expected to raise RESOURCE_EXHAUSTED
+    try:
+        x = jnp.ones((n,), jnp.float32)
+        x.block_until_ready()  # expected to raise RESOURCE_EXHAUSTED
+    except Exception as e:  # noqa: BLE001 — the error IS the payload
+        text = f"{type(e).__name__}: {e}"
+        print("--- captured runtime error " + "-" * 40)
+        print(text[:2000])
+        print("-" * 67)
+        got = runtime_map.classify(text)
+        if got is None:
+            print("not a recognized health signal; no event emitted")
+            return
+        code, critical = got
+        print(f"classified: registry code {code} "
+              f"({'critical' if critical else 'non-critical'})")
+        path = runtime_map.report_runtime_error(text, device or None,
+                                                events_dir)
+        print(f"event emitted -> {path}")
+        return
     print("allocation unexpectedly succeeded")
 
 
@@ -67,7 +93,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     if args.real_oom:
-        real_oom()
+        real_oom(args.events_dir, args.device)
         return
     path = inject(args.events_dir, args.code, args.device, args.message)
     print(f"injected event code={args.code} device={args.device!r} -> {path}")

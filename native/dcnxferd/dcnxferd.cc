@@ -18,6 +18,7 @@
 //   {"op":"release_flow","flow":"g0"}
 //   {"op":"data_port"}
 //   {"op":"send","host":"10.0.0.2","port":"7474","flow":"g0","bytes":N}
+//   {"op":"read","flow":"g0","bytes":N,"offset":M}   (base64 payload out)
 //   {"op":"stats"}
 // Responses: {"ok":true,...} or {"ok":false,"error":"..."}.
 //
@@ -191,13 +192,14 @@ class Daemon {
     auto it = req.find("op");
     if (it == req.end()) return Err("missing op");
     const std::string& op = it->second;
-    if (op == "version") return Ok("\"version\":\"dcnxferd/1.1\"");
+    if (op == "version") return Ok("\"version\":\"dcnxferd/1.2\"");
     if (op == "ping") return Ok("");
     if (op == "register_flow") return RegisterFlow(fd, req);
     if (op == "record_transfer") return RecordTransfer(fd, req);
     if (op == "release_flow") return ReleaseFlow(fd, req);
     if (op == "data_port") return DataPort();
     if (op == "send") return Send(fd, req);
+    if (op == "read") return Read(fd, req);
     if (op == "stats") return Stats();
     return Err("unknown op '" + op + "'");
   }
@@ -441,6 +443,45 @@ class Daemon {
     return Ok(extra);
   }
 
+  // Read back staged bytes, base64 over the control socket.  This is the
+  // consumer-side seam the in-repo datapath needs to be end-to-end: a
+  // worker process reads the payload a PEER daemon landed into its flow
+  // (tests/test_dcn_jax_integration.py drives this from jax.distributed
+  // workers).  Bounded to 512 KiB per call so the base64 response fits
+  // kMaxOutbuf; the client chunks larger reads by offset.
+  std::string Read(int fd, const std::map<std::string, std::string>& req) {
+    auto fit = req.find("flow");
+    if (fit == req.end()) return Err("read needs 'flow'");
+    auto it = flows_.find(fit->second);
+    if (it == flows_.end())
+      return Err("unknown flow '" + JsonEscape(fit->second) + "'");
+    if (it->second.owner_fd != fd) return Err("flow owned by another client");
+
+    unsigned long long offset = 0, nbytes = it->second.buffer_bytes;
+    auto oit = req.find("offset");
+    if (oit != req.end()) {
+      if (!ParseU64(oit->second, &offset)) return Err("invalid 'offset'");
+    }
+    auto bit = req.find("bytes");
+    if (bit != req.end()) {
+      if (!ParseU64(bit->second, &nbytes) || nbytes == 0)
+        return Err("invalid 'bytes'");
+    }
+    if (offset >= it->second.buffer_bytes)
+      return Err("'offset' beyond staging buffer");
+    if (nbytes > it->second.buffer_bytes - offset)
+      nbytes = it->second.buffer_bytes - offset;
+    if (nbytes > (512ull << 10))
+      return Err("read capped at 512 KiB per call");
+
+    std::string b64 =
+        Base64((const unsigned char*)it->second.buffer + offset,
+               (size_t)nbytes);
+    std::string extra = "\"bytes\":" + std::to_string(nbytes) +
+                        ",\"data\":\"" + b64 + "\"";
+    return Ok(extra);
+  }
+
   std::string Stats() {
     std::string detail = "[";
     bool first = true;
@@ -464,6 +505,40 @@ class Daemon {
              pool_bytes_, pool_used_, flows_.size(), total_transferred_,
              total_rx_, rx_unmatched_);
     return Ok(extra + detail);
+  }
+
+  // Strict unsigned parse: digits only, bounded well below wrap range.
+  static bool ParseU64(const std::string& s, unsigned long long* out) {
+    if (s.empty() || !isdigit((unsigned char)s[0])) return false;
+    char* end = nullptr;
+    unsigned long long v = strtoull(s.c_str(), &end, 10);
+    if (end == s.c_str() || *end != '\0' || v > (1ull << 62)) return false;
+    *out = v;
+    return true;
+  }
+
+  static std::string Base64(const unsigned char* data, size_t n) {
+    static const char tbl[] =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    std::string out;
+    out.reserve((n + 2) / 3 * 4);
+    size_t i = 0;
+    for (; i + 3 <= n; i += 3) {
+      unsigned v = (data[i] << 16) | (data[i + 1] << 8) | data[i + 2];
+      out.push_back(tbl[(v >> 18) & 63]);
+      out.push_back(tbl[(v >> 12) & 63]);
+      out.push_back(tbl[(v >> 6) & 63]);
+      out.push_back(tbl[v & 63]);
+    }
+    if (i < n) {
+      unsigned v = data[i] << 16;
+      if (i + 1 < n) v |= data[i + 1] << 8;
+      out.push_back(tbl[(v >> 18) & 63]);
+      out.push_back(tbl[(v >> 12) & 63]);
+      out.push_back(i + 1 < n ? tbl[(v >> 6) & 63] : '=');
+      out.push_back('=');
+    }
+    return out;
   }
 
   static bool WriteAll(int fd, const void* data, size_t n) {
@@ -539,6 +614,7 @@ struct DataConn {
   std::string acc;                 // header/name accumulator
   uint32_t name_len = 0;
   unsigned long long remaining = 0;
+  unsigned long long frame_len = 0;  // total payload bytes this frame
   std::string flow;
   unsigned long long t0 = 0;       // frame start (throughput log)
 };
@@ -552,9 +628,13 @@ bool PumpDataConn(DataConn* dc, Daemon* daemon) {
       char* flow_buf = daemon->RxBuffer(dc->flow, &cap);
       size_t want = sizeof(tmp);
       char* dst = tmp;
-      if (flow_buf && cap > 0) {
-        dst = flow_buf;
-        want = cap;
+      // Land at the frame's running offset so multi-chunk payloads
+      // append instead of overwriting offset 0; bytes beyond the
+      // staging buffer are drained and only counted.
+      unsigned long long landed = dc->frame_len - dc->remaining;
+      if (flow_buf && landed < (unsigned long long)cap) {
+        dst = flow_buf + landed;
+        want = cap - (size_t)landed;
       }
       if ((unsigned long long)want > dc->remaining)
         want = (size_t)dc->remaining;
@@ -595,6 +675,7 @@ bool PumpDataConn(DataConn* dc, Daemon* daemon) {
       }
       memcpy(&dc->name_len, dc->acc.data() + 4, 4);
       memcpy(&dc->remaining, dc->acc.data() + 8, 8);
+      dc->frame_len = dc->remaining;
       if (dc->name_len == 0 || dc->name_len > kMaxNameLen ||
           dc->remaining > (1ull << 40)) {
         logf(0, "data conn fd %d: bad frame header", dc->fd);

@@ -1,0 +1,102 @@
+"""Worker for the jax.distributed ↔ dcnxferd integration test.
+
+Launched by tests/test_dcn_jax_integration.py.  Each worker:
+
+1. initializes ``jax.distributed`` through ``parallel.dcn`` (the
+   production rendezvous path, CPU backend);
+2. computes a pid-dependent local array and the global psum through
+   JAX's own collective (the ground truth);
+3. stages the local array's bytes into its node dcnxferd daemon via the
+   data plane, sends them to the PEER worker's daemon, reads the peer's
+   shard back out of its own daemon, and reduces host-side;
+4. asserts the daemon-transported reduction equals JAX's psum.
+
+This is the cross-pod leg of a DCN collective actually staged through
+the transfer daemon — the role the reference's NCCL plugin plays
+against tcpgpudmarxd (gpudirect-tcpx/nccl-test.yaml:29-52), driven from
+a real jax.distributed process instead of the daemon's own tests.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.parallel import dcn  # noqa: E402
+from container_engine_accelerators_tpu.parallel.dcn_client import (  # noqa: E402
+    DcnXferClient,
+)
+
+
+def _wait_rx(client: DcnXferClient, flow: str, nbytes: int, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        f = next(
+            (x for x in client.stats()["flows"] if x["flow"] == flow), None
+        )
+        if f is not None and f["rx_bytes"] >= nbytes:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"flow {flow} never received {nbytes} bytes")
+
+
+def main() -> None:
+    num, pid = dcn.initialize()
+    peer = 1 - pid
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("data",))
+    rows = jax.local_device_count() * 2
+
+    rng = np.random.default_rng(1234 + pid)
+    local_data = rng.standard_normal((rows, 64)).astype(np.float32)
+
+    # Ground truth: JAX's own cross-process reduction.
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local_data
+    )
+    jax_total = float(
+        jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    )
+
+    # DCN leg: stage local bytes -> peer daemon -> peer reads -> reduce.
+    uds = os.environ["DCN_UDS_DIR"]
+    peer_host = os.environ["DCN_PEER_HOST"]
+    peer_port = int(os.environ["DCN_PEER_DATA_PORT"])
+    nbytes = local_data.nbytes
+    with DcnXferClient(uds) as c:
+        c.register_flow(f"shard{pid}", peer=f"worker{peer}", bytes=nbytes)
+        c.register_flow(f"shard{peer}", peer=f"worker{peer}", bytes=nbytes)
+        # Barrier: the peer must have registered its landing flow before
+        # we send, or the payload counts as unmatched and is dropped.
+        multihost_utils.sync_global_devices("flows-ready")
+
+        c.put(f"shard{pid}", local_data.tobytes())
+        _wait_rx(c, f"shard{pid}", nbytes)
+        c.send(f"shard{pid}", peer_host, peer_port, nbytes)
+
+        _wait_rx(c, f"shard{peer}", nbytes)
+        peer_data = np.frombuffer(
+            c.read(f"shard{peer}", nbytes), np.float32
+        ).reshape(local_data.shape)
+
+    dcn_total = float(local_data.sum() + peer_data.sum())
+    ok = abs(dcn_total - jax_total) < 1e-2 * max(1.0, abs(jax_total))
+    print(
+        f"RESULT ok={ok} pid={pid} procs={num} "
+        f"dcn_total={dcn_total:.4f} jax_total={jax_total:.4f}",
+        flush=True,
+    )
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

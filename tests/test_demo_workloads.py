@@ -27,6 +27,7 @@ def _load(name, *rel):
     return mod
 
 
+@pytest.mark.slow
 def test_train_resnet_driver_end_to_end(tmp_path):
     train = _load("train_resnet_main", "cmd", "train_resnet.py")
     train.main([
@@ -47,6 +48,7 @@ def test_train_batch_not_divisible_rejected():
         ])
 
 
+@pytest.mark.slow
 def test_serve_resnet_http_roundtrip(tmp_path):
     serve = _load("serve_resnet_main", "cmd", "serve_resnet.py")
     args = serve.parse_args([
@@ -130,6 +132,7 @@ def test_generate_job_sh_produces_valid_jobs(tmp_path):
     assert args.resnet_depth in (34, 50, 101, 152)
 
 
+@pytest.mark.slow
 def test_train_resnet_profile_trace(tmp_path):
     train = _load("train_resnet_prof", "cmd", "train_resnet.py")
     prof = tmp_path / "prof"

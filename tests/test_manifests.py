@@ -252,6 +252,34 @@ def test_recorded_sweep_artifact_is_a_pass():
     assert v["line_rate_fraction"] >= v["pass_threshold"]
 
 
+def test_preloaded_smoke_manifests_never_pull():
+    """The preloaded-installer smoke DSes (analog of the reference's
+    test/nvidia_gpu/daemonset-*-preloaded*.yaml) must really use the
+    node-preloaded image: :fixed tag + imagePullPolicy Never, and the
+    COS test variant must pin itself to TEST-labeled nodes only."""
+    for fname, test_nodes in (
+        ("daemonset-preloaded-test.yaml", True),
+        ("daemonset-ubuntu-preloaded.yaml", False),
+    ):
+        path = os.path.join(REPO, "test", "tpu", fname)
+        (doc,) = _docs(path)
+        spec = doc["spec"]["template"]["spec"]
+        installer = next(
+            c for c in spec["initContainers"] if c["name"] == "libtpu-installer"
+        )
+        assert installer["image"].endswith(":fixed"), fname
+        assert installer["imagePullPolicy"] == "Never", fname
+        terms = spec["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"
+        ]["nodeSelectorTerms"]
+        keys = {m["key"] for t in terms for m in t["matchExpressions"]}
+        expected = (
+            "cloud.google.com/gke-tpu-accelerator-test"
+            if test_nodes else "cloud.google.com/gke-tpu-accelerator"
+        )
+        assert expected in keys, f"{fname}: affinity keys {keys}"
+
+
 def test_installer_entrypoint_is_executable_bash():
     path = os.path.join(REPO, "libtpu-installer", "ubuntu", "entrypoint.sh")
     with open(path) as f:

@@ -148,7 +148,10 @@ def test_ici_distance_within_slice_beats_dcn():
     ici = topology.node_topology_distance(a, b)
     dcn = topology.node_topology_distance(a, c)
     assert ici == 2.0
-    assert dcn == topology.DCN_FAR / topology.DCN_LEVEL_FACTOR ** 2  # pg+cluster match
+    # pg+cluster match, rack differs — plus the cross-slice floor.
+    assert dcn == topology.DCN_MIN + (
+        topology.DCN_FAR / topology.DCN_LEVEL_FACTOR ** 2
+    )
     assert ici < dcn
 
 
@@ -158,11 +161,16 @@ def test_ici_distance_uses_torus_wraparound():
     assert topology.ici_hop_distance((0, 0, 0), (3, 0, 0), None) == 3.0
 
 
-def test_same_host_distance_zero_and_missing_labels_far():
+def test_same_host_distance_floor_and_missing_labels_far():
+    # Nodes without slice/coords can only talk over DCN, so even
+    # co-located ones carry the cross-slice floor (never cheaper than
+    # any in-slice ICI path).
     a = {"node_labels": make_node("a")["metadata"]["labels"]}
     b = {"node_labels": make_node("b", host="a")["metadata"]["labels"]}
-    assert topology.node_topology_distance(a, b) == 0.0
-    assert topology.node_topology_distance(a, {"node_labels": {}}) == topology.DCN_FAR
+    assert topology.node_topology_distance(a, b) == topology.DCN_MIN
+    assert topology.node_topology_distance(a, {"node_labels": {}}) == (
+        topology.DCN_MIN + topology.DCN_FAR
+    )
 
 
 def test_topology_key_orders_slice_neighbors_adjacent():
@@ -286,7 +294,51 @@ def test_tainted_node_allowed_with_toleration():
 def test_pod_sorting_key_numeric_suffix():
     assert sched.pod_sorting_key({"name": "xxx-pod2", "index": None}) < \
         sched.pod_sorting_key({"name": "xxx-pod10", "index": None})
-    assert sched.pod_sorting_key({"name": "p", "index": "7"}) == 7
+    assert sched.pod_sorting_key({"name": "p", "index": "7"}) == (0, "", 7)
+
+
+def test_cross_slice_always_costs_more_than_any_ici_path():
+    """The DCN floor: a cross-slice neighbor (even same rack/host) must
+    never undercut an in-slice ICI path, or the packer prefers DCN
+    traffic over ICI (caught live by the round-3 verify drive)."""
+    def info(name, slice_id, coords):
+        n = make_node(name, host="h0", slice_id=slice_id, coords=coords,
+                      tpu_topology="16x16x16")
+        return {"name": name, "node_labels": n["metadata"]["labels"]}
+
+    far_ici = topology.node_topology_distance(
+        info("a", "s0", "0,0,0"), info("b", "s0", "8,8,8")
+    )  # worst-case torus path on the largest slice shape: 24 hops
+    cross = topology.node_topology_distance(
+        info("a", "s0", "0,0,0"), info("c", "s1", "0,0,0")
+    )  # identical rack+host labels, different slice
+    assert far_ici == 24.0
+    assert cross > far_ici
+    # Hierarchy ordering still discriminates above the floor.
+    d_same = cross
+    other_rack = info("d", "s1", "0,0,0")
+    other_rack["node_labels"] = dict(other_rack["node_labels"])
+    other_rack["node_labels"][topology.RACK_LABEL] = "r9"
+    d_rack = topology.node_topology_distance(
+        info("a", "s0", "0,0,0"), other_rack
+    )
+    assert d_rack > d_same
+
+
+def test_pod_sorting_key_mixed_indexed_and_unindexed():
+    """A job mixing indexed and unindexed pods must sort without a
+    TypeError (the reference crashes here: int vs tuple keys,
+    schedule-daemon.py:40-50) — indexed pods order first, by index."""
+    pods = [
+        {"name": "solo-pod3", "index": None},
+        {"name": "idx", "index": "1"},
+        {"name": "solo-pod1", "index": None},
+        {"name": "idx2", "index": "0"},
+    ]
+    ordered = sorted(pods, key=sched.pod_sorting_key)
+    assert [p["name"] for p in ordered] == [
+        "idx2", "idx", "solo-pod1", "solo-pod3"
+    ]
 
 
 # ---- daemon: assignment ----------------------------------------------------

@@ -134,6 +134,7 @@ def test_rotary_positions_are_global(tokens):
     assert not np.allclose(jax.device_get(a), jax.device_get(b))
 
 
+@pytest.mark.slow
 def test_lm_driver_ring_resume(tmp_path):
     """The real LM driver end-to-end with ring sequence parallelism,
     including checkpoint resume across two invocations."""
