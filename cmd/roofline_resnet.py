@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""ResNet-50 train-step roofline: is 0.33 MFU the chip's ceiling?
+
+For each batch size, AOT-compiles the train step and pulls XLA's
+compiled cost analysis (FLOPs + bytes accessed), then computes the
+classic roofline bound
+
+    t_lower >= max(flops / peak_flops, bytes / hbm_bw)
+    mfu_ceiling = flops / (t_lower * peak_flops)
+
+On an accelerator it also times real steps (nonce-rotated batches, host
+value fetch — see bench.py on the tunnel's execution cache) and reports
+measured MFU as a fraction of the ceiling.  VERDICT round 2 item 3: the
+recorded 0.33 MFU was unexamined; this makes the ceiling measurable.
+
+Usage: python cmd/roofline_resnet.py [--batches 128,256,512] [--steps 50]
+Prints one JSON line per batch size.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Peak dense bf16 FLOP/s and HBM bandwidth (bytes/s) per chip generation.
+HBM_BW = {
+    "v4": 1228e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6e": 1640e9,
+}
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batches", default="128,256,512")
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--no-time", action="store_true",
+                   help="compile + analyze only (no timed steps)")
+    return p.parse_args(argv)
+
+
+def _hbm_bw(device):
+    from bench import _KIND_PATTERNS  # ordered device_kind patterns
+
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    kind = kind.replace(" ", "").replace("-", "").replace("_", "")
+    for pat, gen in _KIND_PATTERNS:
+        if pat in kind:
+            return HBM_BW[gen], gen
+    return HBM_BW["v5e"], "v5e(default)"
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _chip_peak_flops, _compile_step
+    from container_engine_accelerators_tpu.models import resnet
+    from container_engine_accelerators_tpu.models.train import (
+        cosine_sgd,
+        create_train_state,
+        train_step,
+    )
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    peak, peak_src = _chip_peak_flops(dev)
+    bw, gen = _hbm_bw(dev)
+    print(f"roofline: {dev.device_kind} peak={peak / 1e12:.0f}TF/s "
+          f"hbm={bw / 1e9:.0f}GB/s ({gen}, peak from {peak_src})",
+          file=sys.stderr)
+
+    model = resnet(depth=args.depth)
+    size = args.image_size
+    for batch in (int(b) for b in args.batches.split(",")):
+        rng = jax.random.PRNGKey(0)
+        nonce = int(time.time_ns()) & 0x7FFFFFFF
+        xs = [
+            jax.random.normal(jax.random.PRNGKey(nonce + i),
+                              (batch, size, size, 3), jnp.float32)
+            for i in range(4)
+        ]
+        ys = [
+            jax.random.randint(jax.random.PRNGKey(nonce + 100 + i),
+                               (batch,), 0, 1000)
+            for i in range(4)
+        ]
+        state = create_train_state(model, rng, xs[0],
+                                   tx=cosine_sgd(total_steps=1000))
+        step_fn, flops = _compile_step(
+            jax.jit(train_step, donate_argnums=(0,)), state, xs[0], ys[0]
+        )
+        nbytes = 0.0
+        try:
+            cost = step_fn.cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0]
+            nbytes = float(cost.get("bytes accessed", 0.0))
+        except Exception as e:  # noqa: BLE001 — backend-dependent
+            print(f"roofline: bytes accessed unavailable ({e!r})",
+                  file=sys.stderr)
+        row = {"batch": batch, "image_size": size,
+               "flops_per_step_T": round(flops / 1e12, 3),
+               "bytes_per_step_G": round(nbytes / 1e9, 3)}
+        if flops and nbytes:
+            t_c = flops / peak
+            t_m = nbytes / bw
+            ceiling = flops / (max(t_c, t_m) * peak)
+            row.update({
+                "bound": "memory" if t_m > t_c else "compute",
+                "arith_intensity": round(flops / nbytes, 1),
+                "mfu_ceiling": round(ceiling, 4),
+            })
+        if on_accel and not args.no_time:
+            jax.block_until_ready(xs)
+            st, m = step_fn(state, xs[0], ys[0])
+            for i in range(4):
+                st, m = step_fn(st, xs[i % 4], ys[i % 4])
+            t0 = time.perf_counter()
+            for i in range(args.steps):
+                st, m = step_fn(st, xs[i % 4], ys[i % 4])
+            final_loss = float(m["loss"])  # host value fetch = true sync
+            dt = time.perf_counter() - t0
+            mfu = flops * args.steps / dt / peak if flops else None
+            row.update({
+                "images_per_sec": round(batch * args.steps / dt, 1),
+                "mfu": round(mfu, 4) if mfu else None,
+                "final_loss": round(final_loss, 4),
+            })
+            if mfu and row.get("mfu_ceiling"):
+                row["fraction_of_ceiling"] = round(mfu / row["mfu_ceiling"], 3)
+        print(json.dumps(row))
+
+
+if __name__ == "__main__":
+    main()

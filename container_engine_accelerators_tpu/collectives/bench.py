@@ -32,7 +32,10 @@ from typing import Callable, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+try:  # jax >= 0.6 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older pinned jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 

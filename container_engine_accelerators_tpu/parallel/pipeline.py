@@ -33,7 +33,10 @@ from typing import Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+try:  # jax >= 0.6 promotes shard_map out of experimental
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older pinned jax
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS

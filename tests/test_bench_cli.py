@@ -1,0 +1,50 @@
+"""Fast (jax-free) CLI-surface tests for the perf tooling.
+
+The attention bench and roofline tool are the round-3 perf evidence
+path; their argument surfaces and helpers must not rot between the
+rare on-chip runs.
+"""
+
+import importlib.util
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, rel):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(_REPO, rel))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_attention_args():
+    ba = _load("ba", "cmd/bench_attention.py")
+    args = ba.parse_args(["--seq", "2048", "--blocks", "128x128,256x256"])
+    assert args.seq == 2048 and args.steps == 10
+    assert args.blocks == "128x128,256x256"
+
+
+def test_roofline_args_and_bw_table():
+    rl = _load("rl", "cmd/roofline_resnet.py")
+    args = rl.parse_args(["--batches", "128,256"])
+    assert [int(b) for b in args.batches.split(",")] == [128, 256]
+    # Bandwidth table covers every generation the peak table knows.
+    bench = _load("bench_mod", "bench.py")
+    assert set(rl.HBM_BW) == set(bench.PEAK_BF16_FLOPS)
+
+
+def test_chip_peak_ordered_patterns_v5p_vs_v5e():
+    """v5p must not be shadowed by a 'v5' prefix match (review finding:
+    the attention bench's original inline table returned the v5e peak
+    for v5p chips)."""
+    bench = _load("bench_mod2", "bench.py")
+
+    class Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    peak_v5e, src = bench._chip_peak_flops(Dev("TPU v5 lite"))
+    assert (peak_v5e, src) == (197e12, "device_kind")
+    peak_v5p, src = bench._chip_peak_flops(Dev("TPU v5p"))
+    assert (peak_v5p, src) == (459e12, "device_kind")
