@@ -257,6 +257,9 @@ def _run_lm(on_accel: bool):
     layers = int(os.environ.get("BENCH_LM_LAYERS", "12" if on_accel else "2"))
 
     flash_env = os.environ.get("BENCH_LM_FLASH", "1") == "1"
+    # remat trades ~33% extra FLOPs for activation memory; at the bench
+    # config the activations may fit HBM, so make it sweepable.
+    remat_env = os.environ.get("BENCH_LM_REMAT", "1") == "1"
     lm = transformer_lm(
         vocab_size=32_768,
         num_layers=layers,
@@ -264,6 +267,7 @@ def _run_lm(on_accel: bool):
         head_dim=64,
         mlp_dim=4096,
         use_flash=(True if on_accel else None) if flash_env else False,
+        remat=remat_env,
     )
     rng = jax.random.PRNGKey(0)
     # Nonce-seeded batches: see _run_resnet on the execution cache.

@@ -48,7 +48,13 @@ def create_lm_train_state(
     # materialize the [B, H, T, T] attention matrix the sequence-parallel
     # path exists to avoid (e.g. 131072^2 logits at demo scale).
     init_tokens = sample_tokens[:1, : min(sample_tokens.shape[1], 128)]
-    variables = init_model.init(rng, init_tokens)
+    # Accelerators: jitted init (eager init bounces every op through the
+    # tunnel); CPU: eager (compile costs more than it saves) — see
+    # models/train.py:create_train_state.
+    init_fn = init_model.init
+    if jax.default_backend() != "cpu":
+        init_fn = jax.jit(init_model.init)
+    variables = init_fn(rng, init_tokens)
     tx = tx or optax.adamw(3e-4, weight_decay=0.1)
     params = variables["params"]
     return TrainState(

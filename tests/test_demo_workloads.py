@@ -29,14 +29,20 @@ def _load(name, *rel):
 
 @pytest.mark.slow
 def test_train_resnet_driver_end_to_end(tmp_path):
+    """One driver run covers the end-to-end path AND the profiler hook
+    (merged from a separate test: each extra driver invocation costs a
+    full train-step compile)."""
     train = _load("train_resnet_main", "cmd", "train_resnet.py")
+    prof = tmp_path / "prof"
     train.main([
         "--resnet-depth", "18", "--train-batch-size", "8",
         "--train-steps", "2", "--steps-per-eval", "1",
         "--image-size", "32", "--num-classes", "10",
         "--model-par", "2", "--model-dir", str(tmp_path),
+        "--profile-dir", str(prof),
     ])
     assert (tmp_path / "params.msgpack").stat().st_size > 0
+    assert list(prof.rglob("*")), "profiler produced no trace files"
 
 
 def test_train_batch_not_divisible_rejected():
@@ -132,15 +138,3 @@ def test_generate_job_sh_produces_valid_jobs(tmp_path):
     assert args.resnet_depth in (34, 50, 101, 152)
 
 
-@pytest.mark.slow
-def test_train_resnet_profile_trace(tmp_path):
-    train = _load("train_resnet_prof", "cmd", "train_resnet.py")
-    prof = tmp_path / "prof"
-    train.main([
-        "--resnet-depth", "18", "--train-batch-size", "8",
-        "--train-steps", "2", "--steps-per-eval", "5",
-        "--image-size", "32", "--num-classes", "10",
-        "--profile-dir", str(prof),
-    ])
-    traces = list(prof.rglob("*"))
-    assert traces, "profiler produced no trace files"

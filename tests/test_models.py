@@ -122,8 +122,10 @@ def test_inception_v3_family():
     model = inception_v3(
         num_classes=8, a_blocks=(32,), c_blocks=(128,), e_blocks=1
     )
-    x = jax.random.normal(jax.random.PRNGKey(1), (4, 75, 75, 3))
-    y = jax.random.randint(jax.random.PRNGKey(2), (4,), 0, 8)
+    # 35px: the head is a global mean, so nothing requires 75px+, and
+    # XLA:CPU compile time is graph-shaped, not resolution-shaped.
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 35, 35, 3))
+    y = jax.random.randint(jax.random.PRNGKey(2), (2,), 0, 8)
     state = create_train_state(
         model, jax.random.PRNGKey(0), x,
         tx=cosine_sgd(base_lr=0.01, total_steps=10, warmup_steps=1),
@@ -146,5 +148,5 @@ def test_inception_v3_family():
         {"params": state.params, "batch_stats": state.batch_stats},
         x, train=False,
     )
-    assert logits.shape == (4, 8)
+    assert logits.shape == (x.shape[0], 8)
     assert logits.dtype == jnp.float32

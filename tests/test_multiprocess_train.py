@@ -24,51 +24,43 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 pytestmark = pytest.mark.slow
 
 
-def _run_two(argv, timeout=420):
-    port = free_port()
-    envs = []
-    for pid in range(2):
-        env = cpu_mesh_env(2)  # 2 local devices -> 4 global
-        env.update(
-            {
-                "TPU_WORKER_COUNT": "2",
-                "TPU_WORKER_ID": str(pid),
-                "TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
-            }
-        )
-        envs.append(env)
-    cmds = [[sys.executable] + argv] * 2
-    return run_procs(cmds, envs, cwd=REPO_ROOT, timeout=timeout)
-
-
-def test_train_lm_two_process_ring():
-    """Ring sequence parallelism across 2 processes x 2 devices: the
-    sequence shards span process boundaries, so every ring hop after the
-    first crosses processes."""
-    outs = _run_two(
-        [
+def test_both_drivers_two_process():
+    """Both REAL training binaries across 2 processes each, run as two
+    CONCURRENT process groups (4 subprocesses total): ring sequence
+    parallelism for the LM (every ring hop after the first crosses
+    processes) and data-parallel ResNet (global-batch assembly +
+    cross-process gradient all-reduce).  One test instead of two halves
+    the wall-clock: each group's cost is almost entirely its train-step
+    compile, and the groups are independent (separate coordinators).
+    """
+    lm_port, rn_port = free_port(), free_port()
+    cmds, envs = [], []
+    for port, argv in (
+        (lm_port, [
             "cmd/train_lm.py", "--num-layers", "1", "--num-heads", "2",
             "--head-dim", "8", "--mlp-dim", "32", "--vocab-size", "64",
             "--seq-len", "32", "--train-batch-size", "2",
             "--train-steps", "2", "--seq-parallel", "ring",
             "--steps-per-eval", "1",
-        ]
-    )
-    for out in outs:
-        assert "loss=" in out
-
-
-def test_train_resnet_two_process_dp():
-    """Data-parallel ResNet across 2 processes: per-process local batch
-    shards assemble into the global batch; gradient all-reduce crosses
-    processes."""
-    outs = _run_two(
-        [
+        ]),
+        (rn_port, [
             "cmd/train_resnet.py", "--resnet-depth", "18",
             "--train-batch-size", "8", "--train-steps", "2",
             "--image-size", "32", "--num-classes", "8",
             "--steps-per-eval", "1",
-        ]
-    )
-    for out in outs:
+        ]),
+    ):
+        for pid in range(2):
+            env = cpu_mesh_env(2)  # 2 local devices -> 4 global
+            env.update({
+                "TPU_WORKER_COUNT": "2",
+                "TPU_WORKER_ID": str(pid),
+                "TPU_COORDINATOR_ADDR": f"127.0.0.1:{port}",
+            })
+            envs.append(env)
+            cmds.append([sys.executable] + argv)
+    outs = run_procs(cmds, envs, cwd=REPO_ROOT, timeout=420)
+    for out in outs[:2]:  # LM group
+        assert "loss=" in out
+    for out in outs[2:]:  # ResNet group
         assert "done: 2 steps" in out
