@@ -35,6 +35,9 @@ def parse_args(argv=None):
                         "query tensors are materialized (HBM-bounded)")
     p.add_argument("--blocks", default="128x128,256x128,256x256,512x256",
                    help="comma-separated flash QxK block sizes to sweep")
+    p.add_argument("--check", action="store_true",
+                   help="before timing, compare each flash config's "
+                        "output and grads against XLA dense (max err)")
     return p.parse_args(argv)
 
 
@@ -137,6 +140,22 @@ def main(argv=None):
     for name, attn in configs:
         fwd = jax.jit(lambda q, k, v, a=attn: a(q, k, v))
         grad = jax.jit(jax.grad(loss_of(attn), argnums=(0, 1, 2)))
+        if args.check and name != "xla_dense":
+            ref_fwd = jax.jit(functools.partial(dense_attention, causal=True))
+            ref_grad = jax.jit(jax.grad(
+                loss_of(functools.partial(dense_attention, causal=True)),
+                argnums=(0, 1, 2)))
+            qc, kc, vc = argsets[0]
+            err_o = float(jnp.max(jnp.abs(
+                fwd(qc, kc, vc).astype(jnp.float32)
+                - ref_fwd(qc, kc, vc).astype(jnp.float32))))
+            errs_g = [
+                float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                      - b.astype(jnp.float32))))
+                for a, b in zip(grad(qc, kc, vc), ref_grad(qc, kc, vc))
+            ]
+            print(json.dumps({"config": name, "check_max_abs_err_out": err_o,
+                              "check_max_abs_err_dqkv": errs_g}))
         tf = _time_fn(fwd, argsets, args.steps)
         tg = _time_fn(grad, argsets, args.steps)
         row = {

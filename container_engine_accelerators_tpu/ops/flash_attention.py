@@ -43,6 +43,18 @@ NEG_INF = -1e30
 BLOCK_Q = 128
 BLOCK_K = 128
 
+
+def _mosaic_params(interpret):
+    """Grid iterations of every kernel here are independent (each writes
+    its own output block), so tell Mosaic both grid dims are parallel —
+    it can then overlap DMA and compute across iterations instead of
+    assuming a sequential carry.  None in interpret mode / CPU builds."""
+    if interpret or pltpu is None:
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel")
+    )
+
 # Mosaic requires the last two dims of every block to be (8k, 128k) or
 # equal to the array dims, so per-row scalars (the logsumexp) cannot be
 # stored as a [.., T] array with [.., BLOCK_Q] blocks.  Like the stock
@@ -140,6 +152,7 @@ def _fa_forward(q, k, v, causal, scale, interpret, block_q, block_k):
             pl.BlockSpec((1, block_q, LSE_LANES), lambda bh, i: (bh, i, 0)),
         ),
         interpret=interpret,
+        compiler_params=_mosaic_params(interpret),
     )(qf, kf, vf)
     return _from_bh(out, b, h), lse
 
@@ -287,6 +300,7 @@ def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret, block_q,
         in_specs=[blk_q, full, full, blk_q, blk_q, lse_blk],
         out_specs=blk_q,
         interpret=interpret,
+        compiler_params=_mosaic_params(interpret),
     )(qf, kf, vf, gf, of, lse)
 
     dk, dv = pl.pallas_call(
@@ -302,6 +316,7 @@ def _fa_backward(q, k, v, o, lse, g, causal, scale, interpret, block_q,
         in_specs=[blk_k, blk_k, full, full, full, lse_full],
         out_specs=(blk_k, blk_k),
         interpret=interpret,
+        compiler_params=_mosaic_params(interpret),
     )(kf, vf, qf, gf, of, lse)
 
     return (
