@@ -1,0 +1,107 @@
+#!/usr/bin/env python3
+"""Summarize a JAX profiler trace: where does the step time go?
+
+Reads the ``*.xplane.pb`` files a ``--profile-dir`` run produces (e.g.
+``cmd/train_resnet.py --profile-dir``) with ``jax.profiler.ProfileData``
+— no TensorBoard required — and aggregates device-plane event durations
+by op name.  This is the drill-down behind the roofline: the roofline
+says whether the step SHOULD be compute- or memory-bound, this says
+which ops actually spend the time (conv vs batchnorm vs transpose vs
+copy/infeed).
+
+Usage:
+  python cmd/trace_summary.py <profile-dir-or-xplane.pb> [--top 30]
+Prints one JSON line (machine-readable) after a human table.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from collections import defaultdict
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="profile dir or a single .xplane.pb file")
+    p.add_argument("--top", type=int, default=30)
+    return p.parse_args(argv)
+
+
+def _canon(name: str) -> str:
+    """Strip instance suffixes so fusions aggregate by family:
+    'fusion.123' -> 'fusion', 'dot_general.1' -> 'dot_general'."""
+    return re.sub(r"\.\d+$", "", name)
+
+
+def summarize(path: str, top: int = 30):
+    import jax.profiler as jp
+
+    if os.path.isdir(path):
+        files = sorted(
+            glob.glob(os.path.join(path, "**", "*.xplane.pb"), recursive=True)
+        )
+        if not files:
+            raise SystemExit(f"no .xplane.pb under {path}")
+        path = files[-1]  # newest capture
+
+    pd = jp.ProfileData.from_file(path)
+    device_planes = [
+        pl for pl in pd.planes
+        if "TPU" in pl.name or "GPU" in pl.name
+        or pl.name.startswith("/device")
+    ]
+    if not device_planes:  # CPU runs: the PjRt client plane carries ops
+        device_planes = [
+            pl for pl in pd.planes
+            if any("PjRt" in ln.name or "XLA" in ln.name for ln in pl.lines)
+        ]
+    if not device_planes:
+        raise SystemExit(
+            f"no device plane found; planes = {[p.name for p in pd.planes]}"
+        )
+
+    per_op = defaultdict(float)
+    total_ns = 0.0
+    for plane in device_planes:
+        for line in plane.lines:
+            lname = line.name.lower()
+            # Step/framework annotation lines double-count the op time.
+            if "step" in lname or "python" in lname or "source" in lname:
+                continue
+            for ev in line.events:
+                name = ev.name
+                if name.startswith("end:") or not ev.duration_ns:
+                    continue
+                per_op[_canon(name)] += float(ev.duration_ns)
+                total_ns += float(ev.duration_ns)
+
+    rows = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+    width = max((len(n) for n, _ in rows), default=10)
+    print(f"{'op':<{width}}  {'ms':>10}  {'%':>6}", file=sys.stderr)
+    for name, ns in rows:
+        print(f"{name:<{width}}  {ns / 1e6:10.3f}  "
+              f"{100 * ns / max(total_ns, 1):6.2f}", file=sys.stderr)
+    summary = {
+        "xplane": path,
+        "device_planes": [p.name for p in device_planes],
+        "total_device_ms": round(total_ns / 1e6, 3),
+        "top_ops": [
+            {"op": n, "ms": round(ns / 1e6, 3),
+             "pct": round(100 * ns / max(total_ns, 1), 2)}
+            for n, ns in rows
+        ],
+    }
+    print(json.dumps(summary))
+    return summary
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    summarize(args.path, args.top)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,34 @@ def test_roofline_args_and_bw_table():
     assert set(rl.HBM_BW) == set(bench.PEAK_BF16_FLOPS)
 
 
+def test_trace_summary_aggregates_device_ops(tmp_path):
+    """End-to-end on a real (CPU) trace: the summarizer must find the
+    device plane and attribute the bulk of the time to the matmul."""
+    import jax
+    import jax.numpy as jnp
+    import jax.profiler as jp
+
+    ts = _load("trace_summary", "cmd/trace_summary.py")
+    x = jnp.ones((512, 512))
+    f = jax.jit(lambda a: jnp.tanh(a @ a))
+    f(x).block_until_ready()
+    jp.start_trace(str(tmp_path))
+    out = f(x)
+    out.block_until_ready()
+    jp.stop_trace()
+    summary = ts.summarize(str(tmp_path), top=5)
+    assert summary["total_device_ms"] > 0
+    ops = {r["op"] for r in summary["top_ops"]}
+    assert any("dot" in o for o in ops), ops
+
+
+def test_trace_summary_canon():
+    ts = _load("trace_summary2", "cmd/trace_summary.py")
+    assert ts._canon("fusion.123") == "fusion"
+    assert ts._canon("dot_general.1") == "dot_general"
+    assert ts._canon("loop_fusion") == "loop_fusion"
+
+
 def test_chip_peak_ordered_patterns_v5p_vs_v5e():
     """v5p must not be shadowed by a 'v5' prefix match (review finding:
     the attention bench's original inline table returned the v5e peak
