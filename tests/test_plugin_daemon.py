@@ -1,0 +1,109 @@
+"""End-to-end tests of the REAL device-plugin daemon binary.
+
+tests/test_device_plugin.py drives the in-process manager; these spawn
+``cmd/tpu_device_plugin.py`` exactly as the DaemonSet does (subprocess,
+CLI flags, fake node under a tempdir) and play kubelet against it:
+register → ListAndWatch → runtime-mapped fault → Unhealthy →
+kubelet restart → re-register.  Promoted from the round-3 verify drive
+(.claude/skills/verify/SKILL.md surface 1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import grpc
+import pytest
+
+from container_engine_accelerators_tpu.deviceplugin import api
+from container_engine_accelerators_tpu.deviceplugin import (
+    deviceplugin_v1beta1_pb2 as pb,
+)
+from container_engine_accelerators_tpu.health import runtime_map
+from container_engine_accelerators_tpu.tpulib.sysfs import write_fixture
+from tests.kubelet_stub import KubeletStub
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def rig(tmp_path):
+    root = str(tmp_path)
+    write_fixture(root, 4, topology="2x2x1")
+    plugdir = os.path.join(root, "plugins")
+    os.makedirs(plugdir)
+    cfg = os.path.join(root, "tpu_config.json")
+    with open(cfg, "w") as f:
+        json.dump({}, f)
+    stub = KubeletStub(os.path.join(plugdir, api.KUBELET_SOCKET))
+    stub.start()
+    proc = subprocess.Popen(
+        [sys.executable, "cmd/tpu_device_plugin.py",
+         "--plugin-directory", plugdir,
+         "--dev-directory", os.path.join(root, "dev"),
+         "--sysfs-root", root, "--tpu-config", cfg,
+         "--enable-health-monitoring"],
+        cwd=REPO, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        yield root, plugdir, stub, proc
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+        stub.stop()
+
+
+def _dial(plugdir, endpoint):
+    ch = grpc.insecure_channel(f"unix://{os.path.join(plugdir, endpoint)}")
+    return api.DevicePluginClient(ch)
+
+
+def test_daemon_register_watch_fault_unhealthy(rig):
+    root, plugdir, stub, proc = rig
+    reg = stub.requests.get(timeout=30)
+    assert reg.resource_name == "google.com/tpu"
+
+    stream = _dial(plugdir, reg.endpoint).list_and_watch(pb.Empty())
+    first = next(stream)
+    assert {d.ID for d in first.devices} == {f"accel{i}" for i in range(4)}
+    assert all(d.health == "Healthy" for d in first.devices)
+
+    # A captured runtime error, reported through the grounding layer
+    # into the daemon's live event queue.
+    path = runtime_map.report_runtime_error(
+        "INTERNAL: uncorrectable ECC error on accel2 HBM stack",
+        "accel2", os.path.join(root, "var/run/tpu/events"),
+    )
+    assert path is not None
+
+    deadline = time.time() + 30
+    health = {}
+    while time.time() < deadline:
+        resp = next(stream)
+        health = {d.ID: d.health for d in resp.devices}
+        if health.get("accel2") == "Unhealthy":
+            break
+    assert health.get("accel2") == "Unhealthy"
+    assert sum(1 for h in health.values() if h == "Unhealthy") == 1
+
+
+def test_daemon_reregisters_after_kubelet_restart(rig):
+    root, plugdir, stub, proc = rig
+    reg1 = stub.requests.get(timeout=30)
+    sock1 = os.path.join(plugdir, reg1.endpoint)
+    assert os.path.exists(sock1)
+
+    # Kubelet restart: its socket vanishes; the daemon must notice and
+    # re-register on a NEW timestamped endpoint (manager.go:475-481).
+    os.unlink(sock1)
+    reg2 = stub.requests.get(timeout=30)
+    assert reg2.endpoint  # fresh registration
+    client = _dial(plugdir, reg2.endpoint)
+    resp = next(client.list_and_watch(pb.Empty()))
+    assert len(resp.devices) == 4
