@@ -6,6 +6,7 @@ Analog of the reference's label-nodes-daemon
 every 600s, read GCE/TPU metadata and patch this node's topology labels.
 """
 
+import argparse
 import logging
 import os
 import sys
@@ -20,9 +21,24 @@ from container_engine_accelerators_tpu.scheduler.k8s import (
 
 
 def main():
+    parser = argparse.ArgumentParser(prog="label-nodes")
+    parser.add_argument("--api-host", default=None,
+                        help="API server URL override (default: in-cluster)")
+    parser.add_argument("--metadata-base", default=labeler.METADATA_BASE,
+                        help="metadata server base URL (e2e rigs)")
+    parser.add_argument("--once", action="store_true",
+                        help="one label update, then exit (e2e rigs)")
+    args = parser.parse_args()
+
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
-    labeler.run_forever(CoreV1(in_cluster_transport()))
+    api = CoreV1(in_cluster_transport(host=args.api_host))
+    fetch = labeler.metadata_fetcher(args.metadata_base)
+    if args.once:
+        labels = labeler.update_node_labels(api, fetch)
+        print(f"labels: {labels}")
+        return
+    labeler.run_forever(api, fetch)
 
 
 if __name__ == "__main__":

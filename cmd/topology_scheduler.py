@@ -31,17 +31,31 @@ def main():
     parser.add_argument("-i", "--interval", type=float, default=1.0,
                         help="seconds between scheduling passes")
     parser.add_argument("--ignored-namespace", nargs="*", default=[])
+    parser.add_argument("--api-host", default=None,
+                        help="API server URL override (default: in-cluster "
+                             "KUBERNETES_SERVICE_HOST); e2e rigs point this "
+                             "at a fake API server")
+    parser.add_argument("--once", action="store_true",
+                        help="one scheduling pass, then exit (e2e rigs)")
+    parser.add_argument("--settle-seconds", type=float, default=5.0,
+                        help="job-atomicity settle delay before each pass")
     args = parser.parse_args()
 
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
-    api = CoreV1(in_cluster_transport())
-    SchedulerDaemon(
+    api = CoreV1(in_cluster_transport(host=args.api_host))
+    daemon = SchedulerDaemon(
         api,
         gate_prefix=args.gate,
         interval_s=args.interval,
         ignored_namespaces=args.ignored_namespace,
-    ).run_forever()
+        settle_s=args.settle_seconds,
+    )
+    if args.once:
+        bound = daemon.run_once()
+        print(f"bound {bound} pods")
+        return
+    daemon.run_forever()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,194 @@
+"""End-to-end tests of the scheduler-layer daemon BINARIES.
+
+test_scheduler.py covers the logic in-process; these run
+``cmd/topology_scheduler.py`` and ``cmd/label_nodes.py`` as
+subprocesses — the way their Deployment/DaemonSet manifests do — against
+a live fake K8s API server (plain http.server + the real urllib
+transport) and a fake GCE metadata server, asserting pods get bound
+on-slice and nodes get stamped with the exact topology labels the
+scheduler's distance function consumes.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from container_engine_accelerators_tpu.scheduler import topology
+
+REPO = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+)
+GATE = "gke.io/topology-aware-auto-j1"
+
+
+def _node(name, slice_id, coords):
+    return {
+        "metadata": {"name": name, "labels": {
+            topology.PLACEMENT_GROUP_LABEL: "pg0",
+            topology.CLUSTER_LABEL: "c0",
+            topology.RACK_LABEL: "r0",
+            topology.HOST_LABEL: name,
+            topology.SLICE_LABEL: slice_id,
+            topology.COORDS_LABEL: coords,
+            topology.TPU_TOPOLOGY_LABEL: "4x2x1",
+        }},
+        "status": {"allocatable": {"cpu": "8", "memory": "32Gi",
+                                   "google.com/tpu": "4"}},
+        "spec": {},
+    }
+
+
+def _pod(name, index):
+    labels = {"job-name": "j1"}
+    if index is not None:
+        labels["batch.kubernetes.io/job-completion-index"] = str(index)
+    return {
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "labels": labels,
+                     "creationTimestamp": "2026-07-30T00:00:00Z"},
+        "spec": {"schedulingGates": [{"name": GATE}],
+                 "containers": [{"name": "c", "resources": {"requests": {
+                     "cpu": "1", "memory": "1Gi", "google.com/tpu": "4"}}}]},
+    }
+
+
+@pytest.fixture
+def fake_api():
+    state = {
+        "pods": {p["metadata"]["name"]: p
+                 for p in [_pod("j1-0", 0), _pod("j1-1", 1)]},
+        "bound": {},
+        "patched_nodes": {},
+        "nodes": [_node("n0", "s0", "0,0,0"), _node("n1", "s0", "2,0,0"),
+                  _node("far", "s9", "0,0,0")],
+    }
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _send(self, obj, code=200):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/api/v1/namespaces":
+                self._send({"items": [{"metadata": {"name": "default"}}]})
+            elif re.match(r"/api/v1/namespaces/default/pods$", self.path):
+                self._send({"items": list(state["pods"].values())})
+            elif self.path == "/api/v1/nodes":
+                self._send({"items": state["nodes"]})
+            else:
+                m = re.match(r"/api/v1/namespaces/default/pods/(.+)$",
+                             self.path)
+                if m and m.group(1) in state["pods"]:
+                    self._send(state["pods"][m.group(1)])
+                else:
+                    self._send({"kind": "Status"}, 404)
+
+        def do_PUT(self):
+            m = re.match(r"/api/v1/namespaces/default/pods/(.+)$", self.path)
+            n = int(self.headers["Content-Length"])
+            body = json.loads(self.rfile.read(n))
+            state["pods"][m.group(1)] = body
+            terms = body["spec"].get("affinity", {}).get(
+                "nodeAffinity", {}).get(
+                "requiredDuringSchedulingIgnoredDuringExecution", {}).get(
+                "nodeSelectorTerms", [])
+            state["bound"][m.group(1)] = (
+                terms[0]["matchExpressions"][0]["values"][0] if terms
+                else None
+            )
+            self._send(body)
+
+        def do_PATCH(self):
+            m = re.match(r"/api/v1/nodes/(.+)$", self.path)
+            n = int(self.headers["Content-Length"])
+            body = json.loads(self.rfile.read(n))
+            state["patched_nodes"][m.group(1)] = body
+            self._send(body)
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}", state
+    srv.shutdown()
+
+
+def test_scheduler_binary_binds_gated_job(fake_api):
+    host, state = fake_api
+    out = subprocess.run(
+        [sys.executable, "cmd/topology_scheduler.py", "--once",
+         "--api-host", host, "--settle-seconds", "0"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "bound 2 pods" in out.stdout
+    # ICI neighbors in slice s0, not the cross-slice node.
+    assert set(state["bound"].values()) == {"n0", "n1"}
+    for pod in state["pods"].values():
+        assert not pod["spec"].get("schedulingGates")
+
+
+@pytest.fixture
+def fake_metadata():
+    answers = {
+        "/instance/name": "tpu-node-3",
+        "/instance/attributes/physical_host": "/c7/r2/h9",
+        "/instance/attributes/tpu-env": (
+            "TPU_NAME: 'slice-a'\nTOPOLOGY: '4x2x1'\nWORKER_ID: '1'\n"
+        ),
+    }
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            path = self.path.replace("/computeMetadata/v1", "")
+            if self.headers.get("Metadata-Flavor") != "Google":
+                self.send_response(403)
+                self.end_headers()
+                return
+            body = answers.get(path)
+            if body is None:
+                self.send_response(404)
+                self.end_headers()
+                return
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{srv.server_port}/computeMetadata/v1"
+    srv.shutdown()
+
+
+def test_labeler_binary_stamps_topology_labels(fake_api, fake_metadata):
+    host, state = fake_api
+    out = subprocess.run(
+        [sys.executable, "cmd/label_nodes.py", "--once",
+         "--api-host", host, "--metadata-base", fake_metadata],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    patch = state["patched_nodes"]["tpu-node-3"]
+    labels = patch["metadata"]["labels"]
+    assert labels[topology.CLUSTER_LABEL] == "c7"
+    assert labels[topology.RACK_LABEL] == "r2"
+    assert labels[topology.HOST_LABEL] == "h9"
+    assert labels[topology.SLICE_LABEL] == "slice-a"
+    assert labels[topology.TPU_TOPOLOGY_LABEL] == "4x2x1"
+    # worker 1 on a 4x2x1 slice with 2x2x1 per-host sub-mesh -> (2,0,0)
+    assert labels[topology.COORDS_LABEL] == "2,0,0"
