@@ -63,6 +63,12 @@ def parse_args(argv=None):
         default=30.0,
         help="Seconds between metric samples",
     )
+    p.add_argument(
+        "--pod-resources-socket",
+        default=None,
+        help="kubelet PodResources API socket (default: the in-cluster "
+             "path; e2e rigs point this at a stub)",
+    )
     return p.parse_args(argv)
 
 
@@ -105,11 +111,15 @@ def main(argv=None):
         from container_engine_accelerators_tpu.metrics.metrics import MetricServer
 
         log.info("starting metrics server on port %d", args.tpu_metrics_port)
+        extra = {}
+        if args.pod_resources_socket:
+            extra["pod_resources_socket"] = args.pod_resources_socket
         MetricServer(
             lib=lib,
             manager=manager,
             port=args.tpu_metrics_port,
             collection_interval_s=args.tpu_metrics_collection_interval,
+            **extra,
         ).start()
 
     if args.enable_health_monitoring:
