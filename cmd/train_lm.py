@@ -46,6 +46,9 @@ def parse_args(argv=None):
     p.add_argument("--steps-per-eval", type=int, default=20)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-interval", type=int, default=100)
+    p.add_argument("--profile-dir", default=None,
+                   help="capture an XLA profiler trace of steady-state "
+                        "steps here (summarize with cmd/trace_summary.py)")
     return p.parse_args(argv)
 
 
@@ -168,9 +171,20 @@ def main(argv=None):
 
     t0 = time.perf_counter()
     tokens_per_batch = args.train_batch_size * args.seq_len
+    profiling = False
     for step in range(start_step, args.train_steps):
+        # Trace steady-state steps (same window as train_resnet.py).
+        if args.profile_dir and step == max(start_step,
+                                            min(10, args.train_steps - 1)):
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
         toks, labels, mask = batches[step % n_batches]
         state, metrics = step_fn(state, toks, labels, mask)
+        if profiling and step >= min(20, args.train_steps - 1):
+            jax.block_until_ready(state.params)
+            jax.profiler.stop_trace()
+            profiling = False
+            log.info("wrote XLA profile to %s", args.profile_dir)
         if (step + 1) % args.steps_per_eval == 0:
             dt = time.perf_counter() - t0
             log.info(
