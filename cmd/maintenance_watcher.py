@@ -1,0 +1,58 @@
+#!/usr/bin/env python3
+"""Host-maintenance watcher daemon entry point (DaemonSet).
+
+Polls the GCE metadata server for ``/instance/maintenance-event`` and
+proactively drains this TPU node ahead of the window (taint +
+health-queue event).  See
+container_engine_accelerators_tpu/health/maintenance.py for semantics.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.health import maintenance
+from container_engine_accelerators_tpu.scheduler import labeler
+from container_engine_accelerators_tpu.scheduler.k8s import (
+    CoreV1,
+    in_cluster_transport,
+)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="maintenance-watcher")
+    parser.add_argument("--api-host", default=None,
+                        help="API server URL override (default: in-cluster)")
+    parser.add_argument("--metadata-base", default=labeler.METADATA_BASE,
+                        help="metadata server base URL (e2e rigs)")
+    parser.add_argument("--node-name", default=os.environ.get("NODE_NAME"),
+                        help="this node's name (default: NODE_NAME env, "
+                             "the downward-API spelling)")
+    parser.add_argument("--events-dir",
+                        default=maintenance.DEFAULT_EVENTS_DIR)
+    parser.add_argument("--interval", type=float,
+                        default=maintenance.DEFAULT_INTERVAL_S)
+    parser.add_argument("--once", action="store_true",
+                        help="one reconcile pass, then exit (e2e rigs)")
+    args = parser.parse_args(argv)
+    if not args.node_name:
+        raise SystemExit("--node-name or NODE_NAME env required")
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    api = CoreV1(in_cluster_transport(host=args.api_host))
+    fetch = labeler.metadata_fetcher(args.metadata_base)
+    if args.once:
+        event = maintenance.reconcile(api, args.node_name, fetch,
+                                      args.events_dir)
+        print(f"maintenance event: {event}")
+        return
+    maintenance.run_forever(api, args.node_name, fetch, args.interval,
+                            args.events_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,10 @@ TPU error code registry (ours; the Xid-number analog):
   72  TensorCore hang / watchdog timeout
   31  invalid HBM memory access            (the Xid-31 fault-injection demo)
   13  program abort (user error)           (non-critical by default)
+  80  host maintenance imminent            (non-critical by default; the
+                                            maintenance watcher posts it —
+                                            configure via TPU_ERR_CONFIG
+                                            for proactive device drain)
 
 The registry is a PROVISIONAL contract: libtpu publishes no numeric
 fault table, so these codes are defined by this stack and grounded by

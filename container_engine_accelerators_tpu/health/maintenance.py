@@ -1,0 +1,101 @@
+"""Host-maintenance watcher: drain TPU nodes BEFORE the host goes away.
+
+Beyond the reference's scope (GPUs there fail reactively via Xids), but
+a first-class TPU operational concern: Cloud TPU hosts publish upcoming
+maintenance through the GCE metadata server
+(``/instance/maintenance-event`` → ``NONE`` /
+``MIGRATE_ON_HOST_MAINTENANCE`` / ``TERMINATE_ON_HOST_MAINTENANCE``),
+and a TPU slice cannot live-migrate — a terminate event means every
+chip on this host will vanish.  Reacting only after the fact turns into
+the health checker's reactive flow; this watcher converts the advance
+notice into a proactive drain:
+
+1. taint the node ``google.com/tpu-maintenance=<event>:NoSchedule`` so
+   the scheduler stops placing new TPU pods here;
+2. drop a code-80 event into the health queue
+   (``/var/run/tpu/events``).  Code 80 is informational by default —
+   add ``80`` to ``TPU_ERR_CONFIG`` to also flip this node's devices
+   Unhealthy ahead of the window (full drain semantics).
+
+When the event clears back to ``NONE`` the taint is removed, so a
+migrated (non-TPU-impacting) window heals without operator action.
+"""
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+from container_engine_accelerators_tpu.tpulib.sysfs import write_event_file
+
+log = logging.getLogger(__name__)
+
+MAINTENANCE_CODE = 80
+TAINT_KEY = "google.com/tpu-maintenance"
+METADATA_PATH = "/instance/maintenance-event"
+DEFAULT_INTERVAL_S = 60.0
+DEFAULT_EVENTS_DIR = "/var/run/tpu/events"
+
+Fetcher = Callable[[str], Optional[str]]
+
+
+def current_event(fetch: Fetcher) -> Optional[str]:
+    """The pending maintenance event, or None when NONE/unreadable."""
+    raw = fetch(METADATA_PATH)
+    if raw is None:
+        return None
+    value = raw.strip()
+    return value if value and value != "NONE" else None
+
+
+def _with_taint(taints: List[dict], event: str) -> List[dict]:
+    out = [t for t in taints if t.get("key") != TAINT_KEY]
+    out.append({"key": TAINT_KEY, "value": event, "effect": "NoSchedule"})
+    return out
+
+
+def _without_taint(taints: List[dict]) -> List[dict]:
+    return [t for t in taints if t.get("key") != TAINT_KEY]
+
+
+def reconcile(
+    api,
+    node_name: str,
+    fetch: Fetcher,
+    events_dir: str = DEFAULT_EVENTS_DIR,
+) -> Optional[str]:
+    """One pass: read metadata, converge the node taint, emit the event.
+
+    Returns the active maintenance event (None when clear).
+    """
+    event = current_event(fetch)
+    node = api.read_node(node_name)
+    taints = (node.get("spec") or {}).get("taints") or []
+    tainted = any(t.get("key") == TAINT_KEY for t in taints)
+
+    if event and not tainted:
+        api.patch_node_taints(node_name, _with_taint(taints, event))
+        write_event_file(
+            events_dir, MAINTENANCE_CODE, None,
+            f"host maintenance imminent: {event}",
+        )
+        log.warning("maintenance %s: tainted node %s and posted code %d",
+                    event, node_name, MAINTENANCE_CODE)
+    elif not event and tainted:
+        api.patch_node_taints(node_name, _without_taint(taints))
+        log.info("maintenance cleared: untainted node %s", node_name)
+    return event
+
+
+def run_forever(
+    api,
+    node_name: str,
+    fetch: Fetcher,
+    interval_s: float = DEFAULT_INTERVAL_S,
+    events_dir: str = DEFAULT_EVENTS_DIR,
+):
+    while True:
+        try:
+            reconcile(api, node_name, fetch, events_dir)
+        except Exception as e:  # noqa: BLE001 — keep the watcher alive
+            log.error("maintenance reconcile failed: %s", e)
+        time.sleep(interval_s)

@@ -103,3 +103,13 @@ class CoreV1:
         return self._t(
             "PATCH", f"/api/v1/nodes/{name}", {"metadata": {"labels": labels}}
         )
+
+    def read_node(self, name: str) -> dict:
+        return self._t("GET", f"/api/v1/nodes/{name}")
+
+    def patch_node_taints(self, name: str, taints: List[dict]) -> dict:
+        """Replace the node's taint list (strategic merge keys on taint
+        'key', so callers send the full desired list)."""
+        return self._t(
+            "PATCH", f"/api/v1/nodes/{name}", {"spec": {"taints": taints}}
+        )

@@ -87,6 +87,14 @@ def fake_api():
                 self._send({"items": list(state["pods"].values())})
             elif self.path == "/api/v1/nodes":
                 self._send({"items": state["nodes"]})
+            elif re.match(r"/api/v1/nodes/(.+)$", self.path):
+                name = re.match(r"/api/v1/nodes/(.+)$", self.path).group(1)
+                node = next((n for n in state["nodes"]
+                             if n["metadata"]["name"] == name), None)
+                if node is None:
+                    self._send({"kind": "Status"}, 404)
+                else:
+                    self._send(node)
             else:
                 m = re.match(r"/api/v1/namespaces/default/pods/(.+)$",
                              self.path)
@@ -115,6 +123,10 @@ def fake_api():
             n = int(self.headers["Content-Length"])
             body = json.loads(self.rfile.read(n))
             state["patched_nodes"][m.group(1)] = body
+            node = next((x for x in state["nodes"]
+                         if x["metadata"]["name"] == m.group(1)), None)
+            if node is not None and "taints" in body.get("spec", {}):
+                node["spec"]["taints"] = body["spec"]["taints"]
             self._send(body)
 
     srv = HTTPServer(("127.0.0.1", 0), H)
@@ -146,6 +158,7 @@ def fake_metadata():
         "/instance/attributes/tpu-env": (
             "TPU_NAME: 'slice-a'\nTOPOLOGY: '4x2x1'\nWORKER_ID: '1'\n"
         ),
+        "/instance/maintenance-event": "TERMINATE_ON_HOST_MAINTENANCE",
     }
 
     class H(BaseHTTPRequestHandler):
@@ -173,6 +186,29 @@ def fake_metadata():
     threading.Thread(target=srv.serve_forever, daemon=True).start()
     yield f"http://127.0.0.1:{srv.server_port}/computeMetadata/v1"
     srv.shutdown()
+
+
+def test_maintenance_watcher_binary_taints_and_posts(fake_api,
+                                                     fake_metadata,
+                                                     tmp_path):
+    host, state = fake_api
+    ev_dir = str(tmp_path / "events")
+    out = subprocess.run(
+        [sys.executable, "cmd/maintenance_watcher.py", "--once",
+         "--api-host", host, "--metadata-base", fake_metadata,
+         "--node-name", "n0", "--events-dir", ev_dir],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "TERMINATE_ON_HOST_MAINTENANCE" in out.stdout
+    taints = state["patched_nodes"]["n0"]["spec"]["taints"]
+    assert taints == [{"key": "google.com/tpu-maintenance",
+                       "value": "TERMINATE_ON_HOST_MAINTENANCE",
+                       "effect": "NoSchedule"}]
+    import os as _os
+    (fname,) = _os.listdir(ev_dir)
+    event = json.load(open(_os.path.join(ev_dir, fname)))
+    assert event["code"] == 80
 
 
 def test_labeler_binary_stamps_topology_labels(fake_api, fake_metadata):

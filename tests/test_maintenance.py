@@ -1,0 +1,117 @@
+"""Host-maintenance watcher: metadata notice → taint + health event.
+
+Unit tests drive reconcile() against a fake CoreV1; the binary test in
+test_daemon_binaries.py covers the subprocess + HTTP path.
+"""
+
+import json
+import os
+
+from container_engine_accelerators_tpu.health import maintenance as mw
+
+
+class FakeApi:
+    def __init__(self, taints=None):
+        self.node = {"metadata": {"name": "n0"},
+                     "spec": {"taints": taints or []}}
+        self.patches = []
+
+    def read_node(self, name):
+        assert name == "n0"
+        return self.node
+
+    def patch_node_taints(self, name, taints):
+        self.patches.append(taints)
+        self.node["spec"]["taints"] = taints
+        return self.node
+
+
+def fetcher(value):
+    return lambda path: value if path == mw.METADATA_PATH else None
+
+
+def test_terminate_event_taints_and_posts(tmp_path):
+    api = FakeApi()
+    ev_dir = str(tmp_path / "events")
+    got = mw.reconcile(api, "n0", fetcher("TERMINATE_ON_HOST_MAINTENANCE"),
+                       events_dir=ev_dir)
+    assert got == "TERMINATE_ON_HOST_MAINTENANCE"
+    (taints,) = api.patches
+    assert taints == [{"key": mw.TAINT_KEY,
+                       "value": "TERMINATE_ON_HOST_MAINTENANCE",
+                       "effect": "NoSchedule"}]
+    files = os.listdir(ev_dir)
+    assert len(files) == 1
+    event = json.load(open(os.path.join(ev_dir, files[0])))
+    assert event["code"] == mw.MAINTENANCE_CODE
+    assert event["device"] is None  # whole-node signal
+
+
+def test_event_posted_once_while_pending(tmp_path):
+    api = FakeApi()
+    ev_dir = str(tmp_path / "events")
+    fetch = fetcher("TERMINATE_ON_HOST_MAINTENANCE")
+    mw.reconcile(api, "n0", fetch, events_dir=ev_dir)
+    mw.reconcile(api, "n0", fetch, events_dir=ev_dir)  # still pending
+    assert len(api.patches) == 1  # no re-taint
+    assert len(os.listdir(ev_dir)) == 1  # no duplicate event spam
+
+
+def test_clear_event_removes_taint_keeps_others(tmp_path):
+    other = {"key": "dedicated", "value": "ml", "effect": "NoSchedule"}
+    api = FakeApi(taints=[other,
+                          {"key": mw.TAINT_KEY, "value": "x",
+                           "effect": "NoSchedule"}])
+    got = mw.reconcile(api, "n0", fetcher("NONE"),
+                       events_dir=str(tmp_path / "ev"))
+    assert got is None
+    (taints,) = api.patches
+    assert taints == [other]
+
+
+def test_none_and_unreadable_are_noops(tmp_path):
+    api = FakeApi()
+    assert mw.reconcile(api, "n0", fetcher("NONE"),
+                        events_dir=str(tmp_path / "ev")) is None
+    assert mw.reconcile(api, "n0", lambda p: None,
+                        events_dir=str(tmp_path / "ev")) is None
+    assert api.patches == []
+    assert not (tmp_path / "ev").exists()
+
+
+def test_code_80_flows_through_health_checker_when_configured(tmp_path):
+    """Opt-in drain: with 80 in the critical set, the posted event takes
+    every device Unhealthy (device=None ⇒ all), ahead of the window."""
+    from container_engine_accelerators_tpu.deviceplugin.manager import (
+        TpuManager,
+    )
+    from container_engine_accelerators_tpu.health import TpuHealthChecker
+    from container_engine_accelerators_tpu.tpulib import (
+        SysfsTpuLib,
+        write_fixture,
+    )
+    from container_engine_accelerators_tpu.utils.config import TPUConfig
+    from container_engine_accelerators_tpu.utils.device import UNHEALTHY
+
+    root = str(tmp_path)
+    write_fixture(root, 2)
+    cfg = TPUConfig.from_json({})
+    cfg.add_defaults_and_validate()
+    lib = SysfsTpuLib(root)
+    manager = TpuManager(os.path.join(root, "dev"), [], cfg, lib=lib)
+    manager.start()
+
+    api = FakeApi()
+    mw.reconcile(api, "n0", fetcher("TERMINATE_ON_HOST_MAINTENANCE"),
+                 events_dir=os.path.join(root, "var/run/tpu/events"))
+    event = lib.wait_for_event(timeout_s=1.0)
+    assert event is not None and event.code == mw.MAINTENANCE_CODE
+
+    hc = TpuHealthChecker(manager, lib, critical_codes=[mw.MAINTENANCE_CODE])
+    hc.catch_error(event)
+    ids = set()
+    while not manager.health_events.empty():
+        got = manager.health_events.get_nowait()
+        assert got.health == UNHEALTHY
+        ids.add(got.id)
+    assert ids == {"accel0", "accel1"}
