@@ -141,11 +141,11 @@ def _compile_step(jitted, *args):
     return compiled, flops
 
 
-def _run_resnet(on_accel: bool):
+def _run_resnet(on_accel: bool, workload: str = "resnet"):
     import jax
     import jax.numpy as jnp
 
-    from container_engine_accelerators_tpu.models import resnet
+    from container_engine_accelerators_tpu.models import inception_v3, resnet
     from container_engine_accelerators_tpu.models.train import (
         cosine_sgd,
         create_train_state,
@@ -155,9 +155,17 @@ def _run_resnet(on_accel: bool):
     batch = int(os.environ.get("BENCH_BATCH", "128" if on_accel else "16"))
     steps = int(os.environ.get("BENCH_STEPS", "200" if on_accel else "3"))
     depth = int(os.environ.get("BENCH_DEPTH", "50"))
-    image_size = 224 if on_accel else 64
 
-    model = resnet(depth=depth)
+    if workload == "inception":
+        # The demo's second model family
+        # (ref: demo/tpu-training/inception-v3-tpu.yaml:66-73).
+        image_size = 299 if on_accel else 75
+        model = inception_v3()
+        name = "inception_v3"
+    else:
+        image_size = 224 if on_accel else 64
+        model = resnet(depth=depth)
+        name = f"resnet{depth}"
     rng = jax.random.PRNGKey(0)
     # Rotate distinct device-resident batches, seeded from a per-run
     # nonce: the axon tunnel memoizes executions it has already run, so
@@ -188,9 +196,13 @@ def _run_resnet(on_accel: bool):
         jax.jit(train_step, donate_argnums=(0,)), state, xs[0], ys[0]
     )
     if not flops_per_step:
-        # Analytic fallback: ResNet-50 fwd ~= 4.09 GMACs/image at 224px,
-        # train step ~= 3x fwd (bwd ~= 2x), 2 FLOPs per MAC.
-        flops_per_step = 3 * 2 * 4.09e9 * batch * (image_size / 224.0) ** 2
+        # Analytic fallback: fwd GMACs/image at native res (ResNet-50
+        # 4.09 @224, Inception-v3 5.7 @299); train ~= 3x fwd, 2 FLOPs
+        # per MAC; conv cost scales ~quadratically with resolution.
+        if workload == "inception":
+            flops_per_step = 3 * 2 * 5.7e9 * batch * (image_size / 299.0) ** 2
+        else:
+            flops_per_step = 3 * 2 * 4.09e9 * batch * (image_size / 224.0) ** 2
 
     # Compile + warmup; the value fetch drains any async dispatch queue
     # so the timed region starts clean.
@@ -218,7 +230,7 @@ def _run_resnet(on_accel: bool):
     # metric so the ratio is never mistaken for chip-vs-GPU parity.
     suffix = "" if on_accel else f"_cpufallback_{image_size}px"
     return {
-        "metric": f"resnet{depth}_bf16_train_images_per_sec_1chip" + suffix,
+        "metric": f"{name}_bf16_train_images_per_sec_1chip" + suffix,
         "value": round(images_per_sec, 2),
         "unit": "images/sec",
         # CPU fallback times a different workload (64px): no V100 ratio.
@@ -384,7 +396,7 @@ def _latest_logged_tpu(workload: str):
             lines = f.read().splitlines()
     except OSError:
         return None
-    prefix = "lm_" if workload == "lm" else "resnet"
+    prefix = {"lm": "lm_", "inception": "inception"}.get(workload, "resnet")
     for line in reversed(lines):
         line = line.strip()
         if not line:
@@ -409,7 +421,7 @@ def inner_main():
     if workload == "lm":
         result = _run_lm(on_accel)
     else:
-        result = _run_resnet(on_accel)
+        result = _run_resnet(on_accel, workload)
     if on_accel:
         _log_tpu_result(result)
     print(json.dumps(result))
