@@ -78,22 +78,27 @@ _KIND_PATTERNS = (
 )
 
 
-def _chip_peak_flops(device):
-    """(peak bf16 FLOP/s, source) for the attached chip.
-
-    source is "device_kind" / "env" / "default" — "default" marks a
-    GUESSED v5e peak, surfaced in the JSON so an unmatched chip never
-    carries a confident-but-wrong MFU.
-    """
+def chip_generation(device):
+    """(generation key, source) for the attached chip, from the ordered
+    device_kind patterns; source is "device_kind" / "env" / "default" —
+    "default" marks a GUESS, surfaced so an unmatched chip never
+    carries confident-but-wrong numbers.  Shared by the MFU math here
+    and cmd/roofline_resnet.py's bandwidth table."""
     kind = (getattr(device, "device_kind", "") or "").lower()
     kind = kind.replace(" ", "").replace("-", "").replace("_", "")
     for pat, gen in _KIND_PATTERNS:
         if pat in kind:
-            return PEAK_BF16_FLOPS[gen], "device_kind"
+            return gen, "device_kind"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     if gen in PEAK_BF16_FLOPS:
-        return PEAK_BF16_FLOPS[gen], "env"
-    return PEAK_BF16_FLOPS["v5e"], "default"
+        return gen, "env"
+    return "v5e", "default"
+
+
+def _chip_peak_flops(device):
+    """(peak bf16 FLOP/s, source) for the attached chip."""
+    gen, source = chip_generation(device)
+    return PEAK_BF16_FLOPS[gen], source
 
 
 class BenchMeasurementError(RuntimeError):

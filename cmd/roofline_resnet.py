@@ -46,14 +46,10 @@ def parse_args(argv=None):
 
 
 def _hbm_bw(device):
-    from bench import _KIND_PATTERNS  # ordered device_kind patterns
+    from bench import chip_generation
 
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    kind = kind.replace(" ", "").replace("-", "").replace("_", "")
-    for pat, gen in _KIND_PATTERNS:
-        if pat in kind:
-            return HBM_BW[gen], gen
-    return HBM_BW["v5e"], "v5e(default)"
+    gen, source = chip_generation(device)
+    return HBM_BW[gen], gen if source == "device_kind" else f"{gen}({source})"
 
 
 def main(argv=None):

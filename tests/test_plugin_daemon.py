@@ -8,6 +8,7 @@ kubelet restart → re-register.  Promoted from the round-3 verify drive
 (.claude/skills/verify/SKILL.md surface 1).
 """
 
+import contextlib
 import json
 import os
 import socket
@@ -30,8 +31,9 @@ from tests.kubelet_stub import KubeletStub
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.fixture
-def rig(tmp_path):
+@contextlib.contextmanager
+def daemon_rig(tmp_path, extra_args):
+    """Fake node + kubelet stub + the REAL daemon subprocess."""
     root = str(tmp_path)
     write_fixture(root, 4, topology="2x2x1")
     plugdir = os.path.join(root, "plugins")
@@ -45,8 +47,7 @@ def rig(tmp_path):
         [sys.executable, "cmd/tpu_device_plugin.py",
          "--plugin-directory", plugdir,
          "--dev-directory", os.path.join(root, "dev"),
-         "--sysfs-root", root, "--tpu-config", cfg,
-         "--enable-health-monitoring"],
+         "--sysfs-root", root, "--tpu-config", cfg] + extra_args,
         cwd=REPO, stderr=subprocess.PIPE, text=True,
     )
     try:
@@ -59,6 +60,12 @@ def rig(tmp_path):
             proc.kill()
             proc.wait(timeout=10)
         stub.stop()
+
+
+@pytest.fixture
+def rig(tmp_path):
+    with daemon_rig(tmp_path, ["--enable-health-monitoring"]) as r:
+        yield r
 
 
 def _dial(plugdir, endpoint):
@@ -101,32 +108,17 @@ def test_daemon_serves_prometheus_metrics(tmp_path):
     analog), alongside the kubelet-facing gRPC."""
     from tests.test_metrics import PodResourcesStub, make_pod_resources
 
-    root = str(tmp_path)
-    write_fixture(root, 4, topology="2x2x1")
-    plugdir = os.path.join(root, "plugins")
-    os.makedirs(plugdir)
-    cfg = os.path.join(root, "tpu_config.json")
-    with open(cfg, "w") as f:
-        json.dump({}, f)
-    pr_sock = os.path.join(root, "pod-resources.sock")
+    pr_sock = os.path.join(str(tmp_path), "pod-resources.sock")
     PodResourcesStub(pr_sock, make_pod_resources())
-    stub = KubeletStub(os.path.join(plugdir, api.KUBELET_SOCKET))
-    stub.start()
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
-    proc = subprocess.Popen(
-        [sys.executable, "cmd/tpu_device_plugin.py",
-         "--plugin-directory", plugdir,
-         "--dev-directory", os.path.join(root, "dev"),
-         "--sysfs-root", root, "--tpu-config", cfg,
-         "--enable-container-tpu-metrics",
-         "--tpu-metrics-port", str(port),
-         "--tpu-metrics-collection-interval", "0.2",
-         "--pod-resources-socket", pr_sock],
-        cwd=REPO, stderr=subprocess.PIPE, text=True,
-    )
-    try:
+    with daemon_rig(tmp_path, [
+        "--enable-container-tpu-metrics",
+        "--tpu-metrics-port", str(port),
+        "--tpu-metrics-collection-interval", "0.2",
+        "--pod-resources-socket", pr_sock,
+    ]) as (root, plugdir, stub, proc):
         stub.requests.get(timeout=30)
         deadline = time.time() + 30
         text = ""
@@ -148,14 +140,6 @@ def test_daemon_serves_prometheus_metrics(tmp_path):
         assert "memory_total" in text and "duty_cycle_tpu_node" in text
         # Virtual (shared) device ids are skipped for per-container stats.
         assert 'pod="shared-pod"' not in text
-    finally:
-        proc.terminate()
-        try:
-            proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            proc.wait(timeout=10)
-        stub.stop()
 
 
 def test_daemon_reregisters_after_kubelet_restart(rig):
