@@ -117,17 +117,96 @@ def _chunks_of(tk: int) -> tuple:
     return chunk, tk // chunk
 
 
+def _fully_masked(q_pos, k_pos):
+    """True when every (q, k) pair in this chunk is causally masked —
+    the chunk contributes nothing and its matmuls can be skipped."""
+    return k_pos.min() > q_pos.max()
+
+
+# ---- zigzag layout ---------------------------------------------------------
+#
+# Contiguous sequence sharding makes causal ring attention imbalanced:
+# rank 0's queries attend almost nothing, rank n-1's attend everything,
+# and each ring step's wall time is set by the busiest rank (ppermute
+# synchronizes), so skipping masked work buys no wall time.  The zigzag
+# layout (striped ring attention) gives every rank one EARLY and one
+# LATE half-chunk — chunks i and 2n-1-i — so at every ring step every
+# rank has about half a block of real work: combined with the
+# fully-masked-chunk skip, causal attention FLOPs on the critical path
+# drop ~2x at scale, with identical numerics (positions travel with the
+# data; the mask math never assumes contiguity).
+
+
+def zigzag_permutation(t: int, n: int):
+    """Global position -> zigzag storage order for n ring devices.
+
+    Storage order: device i holds chunks i and 2n-1-i of size t/(2n).
+    Returns int32 index array ``perm`` with ``stored = x[..., perm]``;
+    invert with ``jnp.argsort(perm)``.
+    """
+    if t % (2 * n):
+        raise ValueError(f"zigzag needs seq {t} divisible by 2*{n}")
+    half = t // (2 * n)
+    order = []
+    for i in range(n):
+        order.append(jnp.arange(half) + i * half)
+        order.append(jnp.arange(half) + (2 * n - 1 - i) * half)
+    return jnp.concatenate(order).astype(jnp.int32)
+
+
+def to_zigzag(x, n: int, axis: int = 1):
+    """Reorder a GLOBAL sequence axis into zigzag storage order."""
+    return jnp.take(x, zigzag_permutation(x.shape[axis], n), axis=axis)
+
+
+def from_zigzag(x, n: int, axis: int = 1):
+    """Inverse of :func:`to_zigzag`."""
+    perm = zigzag_permutation(x.shape[axis], n)
+    return jnp.take(x, jnp.argsort(perm), axis=axis)
+
+
+def _ring_positions(layout: str, rank, tq: int, n: int):
+    """Global token positions of the shard stored on ``rank``.
+
+    rank may be a traced scalar (lax.axis_index).  contiguous: one run
+    of tq.  zigzag: halves from chunks rank and 2n-1-rank.
+    """
+    if layout == "zigzag":
+        half = tq // 2
+        lo = rank * half + jnp.arange(half)
+        hi = (2 * n - 1 - rank) * half + jnp.arange(half)
+        return jnp.concatenate([lo, hi])
+    return rank * tq + jnp.arange(tq)
+
+
 def _block_attend(q, k, v, m, l, o, q_pos=None, k_pos=None):
     """Accumulate attention of resident Q against one ring K/V block,
     streaming the block in RING_CHUNK-sized K chunks (flash-style inner
     loop) so the score intermediate never materializes [Tq, Tk].
+
+    Under causal masking (positions given), chunks whose every key lies
+    in the queries' future are SKIPPED via ``lax.cond`` — they would
+    contribute only -inf logits.  On the contiguous layout this saves
+    energy but not wall time (ring steps synchronize on the busiest
+    rank); with the zigzag layout it is the ~2x critical-path win.
     """
     chunk, nc = _chunks_of(k.shape[1])
+
+    def attend_or_skip(ks, vs, kp, carry):
+        m, l, o = carry
+        if q_pos is None:
+            return _chunk_attend(q, ks, vs, m, l, o)
+        return lax.cond(
+            _fully_masked(q_pos, kp),
+            lambda c: c,
+            lambda c: _chunk_attend(q, ks, vs, *c, q_pos, kp),
+            (m, l, o),
+        )
+
     if nc == 1:
-        return _chunk_attend(q, k, v, m, l, o, q_pos, k_pos)
+        return attend_or_skip(k, v, k_pos, (m, l, o))
 
     def body(c, carry):
-        m, l, o = carry
         k_blk = lax.dynamic_slice_in_dim(k, c * chunk, chunk, axis=1)
         v_blk = lax.dynamic_slice_in_dim(v, c * chunk, chunk, axis=1)
         kp = (
@@ -135,7 +214,7 @@ def _block_attend(q, k, v, m, l, o, q_pos=None, k_pos=None):
             if k_pos is not None
             else None
         )
-        return _chunk_attend(q, k_blk, v_blk, m, l, o, q_pos, kp)
+        return attend_or_skip(k_blk, v_blk, kp, carry)
 
     return lax.fori_loop(0, nc, body, (m, l, o))
 
@@ -180,8 +259,26 @@ def _block_backward(q_s, do, delta, lse, k_blk, v_blk, scale, axis_name,
         )
         return dq_c, dk_c, dv_c
 
+    def grads_or_skip(ks, vs, kp):
+        """Chunk gradients, skipping fully-masked chunks (see
+        _block_attend): P is exactly 0 there, so all three grads are."""
+        if q_pos is None:
+            return one_chunk(ks, vs, None)
+        # Zeros marked varying so both cond branches agree under the
+        # shard_map type system (one_chunk outputs vary over the ring).
+        zeros = tuple(
+            _pvary(jnp.zeros(s, jnp.float32), axis_name)
+            for s in ((b, tq, h, d), (b, ks.shape[1], h, d),
+                      (b, ks.shape[1], h, d))
+        )
+        return lax.cond(
+            _fully_masked(q_pos, kp),
+            lambda: zeros,
+            lambda: one_chunk(ks, vs, kp),
+        )
+
     if nc == 1:
-        dq, dk, dv = one_chunk(k_blk, v_blk, k_pos)
+        dq, dk, dv = grads_or_skip(k_blk, v_blk, k_pos)
         return dq, dk, dv
 
     def body(c, carry):
@@ -193,7 +290,7 @@ def _block_backward(q_s, do, delta, lse, k_blk, v_blk, scale, axis_name,
             if k_pos is not None
             else None
         )
-        dq_c, dk_c, dv_c = one_chunk(ks, vs, kp)
+        dq_c, dk_c, dv_c = grads_or_skip(ks, vs, kp)
         dk = lax.dynamic_update_slice_in_dim(dk, dk_c, c * chunk, axis=1)
         dv = lax.dynamic_update_slice_in_dim(dv, dv_c, c * chunk, axis=1)
         return dq + dq_c, dk, dv
@@ -205,7 +302,7 @@ def _block_backward(q_s, do, delta, lse, k_blk, v_blk, scale, axis_name,
     return lax.fori_loop(0, nc, body, (dq0, z, z))
 
 
-def _ring_forward(q, k, v, axis_name, causal, scale):
+def _ring_forward(q, k, v, axis_name, causal, scale, layout="contiguous"):
     """Ring forward pass -> (out, lse [B, H, Tq] f32)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -221,7 +318,7 @@ def _ring_forward(q, k, v, axis_name, causal, scale):
     l0 = _pvary(jnp.zeros((b, h, tq), jnp.float32), axis_name)
     o0 = _pvary(jnp.zeros((b, tq, h, d), jnp.float32), axis_name)
 
-    q_pos = idx * tq + jnp.arange(tq)  # global positions of resident Q
+    q_pos = _ring_positions(layout, idx, tq, n)  # resident Q positions
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -229,7 +326,7 @@ def _ring_forward(q, k, v, axis_name, causal, scale):
         # The K/V block resident at ring step s arrived from rank idx - s.
         src = (idx - step_idx) % n
         if causal:
-            k_pos = src * tk + jnp.arange(tk)
+            k_pos = _ring_positions(layout, src, tk, n)
             return _block_attend(q_s, k_blk, v_blk, m, l, o, q_pos, k_pos)
         return _block_attend(q_s, k_blk, v_blk, m, l, o)
 
@@ -251,18 +348,20 @@ def _ring_forward(q, k, v, axis_name, causal, scale):
     return out.astype(q.dtype), m + jnp.log(l)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_attention(q, k, v, axis_name, causal, scale):
-    out, _ = _ring_forward(q, k, v, axis_name, causal, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention(q, k, v, axis_name, causal, scale,
+                    layout="contiguous"):
+    out, _ = _ring_forward(q, k, v, axis_name, causal, scale, layout)
     return out
 
 
-def _ring_attention_fwd(q, k, v, axis_name, causal, scale):
-    out, lse = _ring_forward(q, k, v, axis_name, causal, scale)
+def _ring_attention_fwd(q, k, v, axis_name, causal, scale,
+                        layout="contiguous"):
+    out, lse = _ring_forward(q, k, v, axis_name, causal, scale, layout)
     return out, (q, k, v, out, lse)
 
 
-def _ring_attention_bwd(axis_name, causal, scale, res, do):
+def _ring_attention_bwd(axis_name, causal, scale, layout, res, do):
     """Ring backward: a second ring pass with FA2-style recompute.
 
     Plain AD through the forward scan would save every chunk's [Tq, C]
@@ -283,7 +382,7 @@ def _ring_attention_bwd(axis_name, causal, scale, res, do):
     delta = jnp.einsum(
         "bqhd,bqhd->bhq", do.astype(jnp.float32), o.astype(jnp.float32)
     )
-    q_pos = idx * tq + jnp.arange(tq)
+    q_pos = _ring_positions(layout, idx, tq, n)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     dq0 = _pvary(jnp.zeros((b, tq, h, d), jnp.float32), axis_name)
@@ -294,7 +393,7 @@ def _ring_attention_bwd(axis_name, causal, scale, res, do):
         dq, k_blk, v_blk, dk_blk, dv_blk = carry
         src = (idx - step_idx) % n
         if causal:
-            k_pos = src * tk + jnp.arange(tk)
+            k_pos = _ring_positions(layout, src, tk, n)
             dq_c, dk_c, dv_c = _block_backward(
                 q_s, do, delta, lse, k_blk, v_blk, scale, axis_name,
                 q_pos, k_pos,
@@ -328,6 +427,7 @@ def ring_attention(
     axis_name: str,
     causal: bool = False,
     scale: Optional[float] = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Ring self-attention over a sequence-sharded axis.
 
@@ -337,9 +437,21 @@ def ring_attention(
     steps keeps the jitted program free of Python-level unrolling.
     Differentiable with O(seq/n) memory in BOTH directions via a custom
     VJP (see :func:`_ring_attention_bwd`).
+
+    ``layout="zigzag"``: shards are in zigzag storage order (reorder the
+    GLOBAL sequence with :func:`to_zigzag` before sharding) — balances
+    causal work across ranks so the fully-masked-chunk skip becomes a
+    ~2x critical-path win (see the layout comment above
+    :func:`zigzag_permutation`).  Requires an even per-device shard.
     """
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _ring_attention(q, k, v, axis_name, causal, scale)
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    if layout == "zigzag" and q.shape[1] % 2:
+        raise ValueError(
+            f"zigzag needs an even per-device shard, got {q.shape[1]}"
+        )
+    return _ring_attention(q, k, v, axis_name, causal, scale, layout)
 
 
 def ulysses_attention(
@@ -385,13 +497,16 @@ def make_sequence_parallel_attention(
     kind: str = "ring",
     causal: bool = False,
     axis_name: str = "data",
+    layout: str = "contiguous",
 ):
     """Jit a sequence-parallel attention over ``mesh``.
 
     Returns ``fn(q, k, v) -> out`` taking GLOBAL ``[B, T, H, D]`` arrays
     sharded (or shardable) on ``axis_name`` along T; the wrapper applies
     ``shard_map`` + jit with the sequence axis sharded and batch/heads
-    replicated across that axis.
+    replicated across that axis.  ``layout`` (ring only): see
+    :func:`ring_attention` — callers reorder the global sequence with
+    :func:`to_zigzag` / :func:`from_zigzag`.
     """
     kinds = {"ring": ring_attention, "ulysses": ulysses_attention}
     if kind not in kinds:
@@ -399,6 +514,7 @@ def make_sequence_parallel_attention(
             f"kind must be one of {'|'.join(sorted(kinds))}, got {kind!r}"
         )
     inner = kinds[kind]
+    extra = {"layout": layout} if kind == "ring" else {}
     spec = P(None, axis_name, None, None)
 
     @functools.partial(
@@ -408,7 +524,7 @@ def make_sequence_parallel_attention(
         out_specs=spec,
     )
     def sharded(q, k, v):
-        return inner(q, k, v, axis_name=axis_name, causal=causal)
+        return inner(q, k, v, axis_name=axis_name, causal=causal, **extra)
 
     sharding = NamedSharding(mesh, spec)
     return jax.jit(
