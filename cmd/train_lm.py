@@ -36,9 +36,10 @@ def parse_args(argv=None):
     p.add_argument("--train-batch-size", type=int, default=8,
                    help="GLOBAL batch size")
     p.add_argument("--seq-parallel", default="none",
-                   choices=("none", "ring", "ulysses"),
+                   choices=("none", "ring", "ring-zigzag", "ulysses"),
                    help="sequence/context parallelism scheme over the "
-                        "mesh data axis")
+                        "mesh data axis (ring-zigzag = causal-balanced "
+                        "ring; inputs are reordered automatically)")
     p.add_argument("--model-par", type=int, default=1,
                    help="tensor-parallel degree of the mesh (dense mode)")
     p.add_argument("--learning-rate", type=float, default=3e-4)
@@ -154,6 +155,18 @@ def main(argv=None):
             global_np.shape, data_sh, lambda idx: global_np[idx]
         )
 
+    # ring-zigzag: reorder the GLOBAL sequence into zigzag storage order
+    # (after labels/mask derive from the original order) so contiguous
+    # GSPMD sharding lands the balanced chunk pairs on each rank.
+    zz_perm = None
+    if seq_parallel == "ring-zigzag":
+        from container_engine_accelerators_tpu.parallel.seq import (
+            zigzag_permutation,
+        )
+
+        sp_degree = mesh.devices.shape[0]
+        zz_perm = np.asarray(zigzag_permutation(args.seq_len, sp_degree))
+
     np_rng = np.random.default_rng(0)  # same seed everywhere: global batch
     n_batches = 4
     batches = []
@@ -165,6 +178,10 @@ def main(argv=None):
         labels = np.roll(toks, -1, axis=1)
         mask = np.ones(toks.shape, np.float32)
         mask[:, -1] = 0.0
+        if zz_perm is not None:
+            toks, labels, mask = (
+                x[:, zz_perm] for x in (toks, labels, mask)
+            )
         batches.append(
             (globalize(toks), globalize(labels), globalize(mask))
         )

@@ -23,6 +23,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from container_engine_accelerators_tpu.models.train import TrainState
 from container_engine_accelerators_tpu.parallel.mesh import DATA_AXIS
+from container_engine_accelerators_tpu.parallel.seq import (
+    _ring_positions,
+    to_zigzag,
+)
 
 
 def next_token_targets(
@@ -32,6 +36,30 @@ def next_token_targets(
     labels = jnp.roll(tokens, -1, axis=1)
     mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
     return labels, mask
+
+
+def prepare_seq_parallel_batch(
+    tokens: jax.Array,
+    seq_parallel: Optional[str] = None,
+    n_shards: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(tokens', labels', mask') ready for ``make_lm_train_step``.
+
+    Labels/mask always derive from the ORIGINAL sequence order (a
+    shard's last label lives in the next shard); for ``ring-zigzag``
+    all three are then reordered into zigzag storage order so plain
+    contiguous GSPMD sharding lands chunk pair (i, 2n-1-i) on rank i
+    (``n_shards`` = sequence-parallel degree).  Loss/metrics are
+    position sums, so they are invariant to the reorder.
+    """
+    labels, mask = next_token_targets(tokens)
+    if seq_parallel == "ring-zigzag":
+        if n_shards is None:
+            raise ValueError("ring-zigzag batch prep needs n_shards")
+        tokens, labels, mask = (
+            to_zigzag(x, n_shards) for x in (tokens, labels, mask)
+        )
+    return tokens, labels, mask
 
 
 def create_lm_train_state(
@@ -92,9 +120,11 @@ def make_lm_train_step(
 
     Returns (step_fn, placed_state); ``step_fn(state, tokens, labels,
     mask) -> (state, metrics)``.  ``seq_parallel`` None shards the batch
-    axis (pure dp); "ring"/"ulysses" shard the sequence axis across
-    DATA_AXIS (the model must have been built with the matching
-    ``seq_parallel=`` so its attention uses the axis).
+    axis (pure dp); "ring"/"ring-zigzag"/"ulysses" shard the sequence
+    axis across DATA_AXIS (the model must have been built with the
+    matching ``seq_parallel=`` so its attention uses the axis).
+    ring-zigzag additionally expects inputs in zigzag storage order —
+    build them with :func:`prepare_seq_parallel_batch`.
     """
     rep = NamedSharding(mesh, P())
     apply_fn = state.apply_fn
@@ -157,7 +187,13 @@ def make_lm_train_step(
 
     def shard_loss_grad(params, tokens, labels, mask):
         tq = tokens.shape[1]
-        positions = lax.axis_index(DATA_AXIS) * tq + jnp.arange(tq)
+        # Positions must match the storage layout: contiguous shards for
+        # ring/ulysses; zigzag chunk pairs for ring-zigzag (the rotary
+        # embedding and the ring mask both consume these).
+        layout = "zigzag" if seq_parallel == "ring-zigzag" else "contiguous"
+        positions = _ring_positions(
+            layout, lax.axis_index(DATA_AXIS), tq, lax.axis_size(DATA_AXIS)
+        )
 
         def loss_fn(p):
             num, den = _loss(apply_fn, p, tokens, labels, mask, positions)

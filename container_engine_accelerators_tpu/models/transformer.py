@@ -96,8 +96,16 @@ class Attention(nn.Module):
                 self._decode_attend(q, k, v, positions)
             )
 
-        if self.seq_parallel == "ring":
-            o = ring_attention(q, k, v, axis_name=self.seq_axis, causal=True)
+        if self.seq_parallel in ("ring", "ring-zigzag"):
+            # ring-zigzag: shards are in zigzag storage order (the
+            # balanced causal layout, parallel/seq.py); rotary above
+            # already used the matching positions the caller passed.
+            layout = (
+                "zigzag" if self.seq_parallel == "ring-zigzag"
+                else "contiguous"
+            )
+            o = ring_attention(q, k, v, axis_name=self.seq_axis,
+                               causal=True, layout=layout)
         elif self.seq_parallel == "ulysses":
             o = ulysses_attention(
                 q, k, v, axis_name=self.seq_axis, causal=True

@@ -15,7 +15,10 @@ mesh axis (no new infrastructure):
   softmax statistics for its resident Q block.  Per-step traffic is one
   K/V block to the ICI neighbor, overlapping compute and transfer the
   way the scaling-book recipe prescribes; memory per device is
-  O(seq/n_devices).
+  O(seq/n_devices).  ``layout="zigzag"`` adds the causally-balanced
+  striped layout + fully-masked-chunk skipping (~2x causal critical
+  path at scale; see the layout comment above
+  :func:`zigzag_permutation`).
 - :func:`ulysses_attention` — ``lax.all_to_all`` reshuffles the
   sequence shard into a head shard so each device runs *dense* attention
   over the full sequence for heads/n_devices heads, then shuffles back.

@@ -13,6 +13,7 @@ from container_engine_accelerators_tpu.models.lm_train import (
     create_lm_train_state,
     make_lm_train_step,
     next_token_targets,
+    prepare_seq_parallel_batch,
 )
 from container_engine_accelerators_tpu.models.transformer import (
     transformer_lm,
@@ -58,7 +59,7 @@ def test_dense_lm_trains(tokens):
     assert int(jax.device_get(s.step)) == 5
 
 
-@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("kind", ["ring", "ulysses", "ring-zigzag"])
 def test_seq_parallel_matches_dense(tokens, kind):
     mesh = create_mesh(data=4, model=2)
     labels, mask = next_token_targets(tokens)
@@ -72,7 +73,10 @@ def test_seq_parallel_matches_dense(tokens, kind):
     sp_state = _state(sp_model, tokens)
     sp_step, sp_placed = make_lm_train_step(mesh, sp_state,
                                             seq_parallel=kind)
-    s_state, s_metrics = sp_step(sp_placed, tokens, labels, mask)
+    sp_toks, sp_labels, sp_mask = prepare_seq_parallel_batch(
+        tokens, kind, n_shards=4
+    )
+    s_state, s_metrics = sp_step(sp_placed, sp_toks, sp_labels, sp_mask)
 
     np.testing.assert_allclose(
         float(s_metrics["loss"]), float(d_metrics["loss"]),
