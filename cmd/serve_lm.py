@@ -1,0 +1,176 @@
+#!/usr/bin/env python3
+"""Minimal LM generation server — the serving demo's second workload.
+
+Same shape as cmd/serve_resnet.py (stdlib HTTP, duty-cycle-driven HPA
+compatible), serving autoregressive decode from the KV-cache path
+(models/generate.py):
+
+    POST /generate  {"prompt_ids": [[...ints...], ...],
+                     "max_new_tokens": N, "temperature": t}
+                    -> {"tokens": [[...]], "latency_ms": t}
+    GET  /healthz   -> ok
+
+Loads trained params from --checkpoint-dir (cmd/train_lm.py's orbax
+output) when given; otherwise serves randomly-initialized weights
+(device-load generator for the autoscaling demo, like serve_resnet).
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+log = logging.getLogger("serve-lm")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description="JAX transformer-LM serving demo")
+    p.add_argument("--port", type=int, default=9001)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--num-layers", type=int, default=12)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--mlp-dim", type=int, default=2048)
+    p.add_argument("--max-prompt-len", type=int, default=64,
+                   help="longest accepted prompt; each distinct prompt "
+                        "length compiles once (cached thereafter)")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="orbax checkpoint dir from cmd/train_lm.py")
+    return p.parse_args(argv)
+
+
+def build_generate(args):
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from container_engine_accelerators_tpu.models.generate import generate
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+
+    cfg = dict(
+        vocab_size=args.vocab_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        head_dim=args.head_dim,
+        mlp_dim=args.mlp_dim,
+    )
+    sample = jnp.zeros((1, 8), jnp.int32)
+    state = create_lm_train_state(
+        transformer_lm(**cfg), jax.random.PRNGKey(0), sample,
+        tx=optax.sgd(0.1),
+    )
+    params = state.params
+    if args.checkpoint_dir:
+        from container_engine_accelerators_tpu.models.checkpoint import (
+            TrainCheckpointer,
+        )
+
+        ck = TrainCheckpointer(os.path.abspath(args.checkpoint_dir))
+        state, step = ck.restore_latest(state)
+        ck.close()
+        if step is not None:
+            params = state.params
+            log.info("loaded step-%d params from %s", step,
+                     args.checkpoint_dir)
+        else:
+            log.info("no checkpoint found; serving random params")
+    else:
+        log.info("serving randomly-initialized params (demo mode)")
+
+    decode_model = transformer_lm(**cfg, decode=True)
+
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def run(prompt, max_new, temperature):
+        return generate(decode_model, params, prompt, max_new,
+                        temperature=temperature)
+
+    # Warm the compile cache for a representative shape.
+    run(jnp.zeros((1, min(8, args.max_prompt_len)), jnp.int32),
+        args.max_new_tokens, 0.0).block_until_ready()
+    return run
+
+
+def make_handler(run, args):
+    import jax.numpy as jnp
+    import numpy as np
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *a):
+            log.debug(fmt, *a)
+
+        def _send(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self._send(404, {"error": "not found"})
+                return
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                prompts = req.get("prompt_ids") or [[1]]
+                max_new = int(req.get("max_new_tokens",
+                                      args.max_new_tokens))
+                max_new = min(max_new, args.max_new_tokens)
+                temperature = float(req.get("temperature", 0.0))
+                # One generate per prompt at its EXACT length: no pad
+                # tokens ever enter the KV cache (a mixed-length batch
+                # would attend its padding).  Compiles cache per
+                # distinct (length, max_new) pair.
+                t0 = time.perf_counter()
+                toks = []
+                for p in prompts:
+                    ids = [int(t) % args.vocab_size
+                           for t in p][: args.max_prompt_len] or [0]
+                    out = np.asarray(run(
+                        jnp.asarray([ids], jnp.int32), max_new,
+                        temperature,
+                    ))
+                    toks.append(out[0].tolist())
+                dt = (time.perf_counter() - t0) * 1e3
+                self._send(200, {"tokens": toks,
+                                 "latency_ms": round(dt, 2)})
+            except Exception as e:  # noqa: BLE001 — serving surface
+                log.exception("generate failed")
+                self._send(400, {"error": str(e)})
+
+    return Handler
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    args = parse_args(argv)
+    run = build_generate(args)
+    server = ThreadingHTTPServer(("0.0.0.0", args.port),
+                                 make_handler(run, args))
+    log.info("serving LM on :%d", server.server_address[1])
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

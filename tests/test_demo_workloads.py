@@ -88,6 +88,45 @@ def test_serve_resnet_http_roundtrip(tmp_path):
         srv.shutdown()
 
 
+@pytest.mark.slow
+def test_serve_lm_http_roundtrip(tmp_path):
+    serve = _load("serve_lm_main", "cmd", "serve_lm.py")
+    args = serve.parse_args([
+        "--vocab-size", "64", "--num-layers", "1", "--num-heads", "2",
+        "--head-dim", "8", "--mlp-dim", "32", "--max-prompt-len", "8",
+        "--max-new-tokens", "4", "--port", "0",
+    ])
+    run = serve.build_generate(args)
+
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0),
+                              serve.make_handler(run, args))
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert json.load(r)["status"] == "ok"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt_ids": [[1, 2, 3], [5]],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = json.load(r)
+        assert len(body["tokens"]) == 2
+        assert body["tokens"][0][:3] == [1, 2, 3]  # prompt teacher-forced
+        assert len(body["tokens"][0]) == 7  # 3 prompt + 4 generated
+        assert len(body["tokens"][1]) == 5  # 1 prompt + 4 generated
+        assert all(0 <= t < 64 for seq in body["tokens"] for t in seq)
+        assert body["latency_ms"] > 0
+    finally:
+        srv.shutdown()
+
+
 def test_inject_error_event_consumed_by_tpulib(tmp_path):
     from container_engine_accelerators_tpu.tpulib.sysfs import (
         SysfsTpuLib,
