@@ -136,23 +136,33 @@ def main(argv=None):
     peak_flops, peak_src = _chip_peak_flops(jax.devices()[0])
     peak = peak_flops / 1e12 if peak_src != "default" else None
 
+    # The check compares on the WARMUP input set (argsets[-1], never
+    # timed): executing a timed set here would poison the tunnel's
+    # execution cache and inflate the first timed dispatch — the exact
+    # hazard _time_fn exists to prevent.  The dense reference runs once,
+    # hoisted out of the per-config sweep.
+    ref_out = ref_grads = None
+    if args.check:
+        qc, kc, vc = argsets[-1]
+        ref_fwd = jax.jit(functools.partial(dense_attention, causal=True))
+        ref_grad = jax.jit(jax.grad(
+            loss_of(functools.partial(dense_attention, causal=True)),
+            argnums=(0, 1, 2)))
+        ref_out = ref_fwd(qc, kc, vc).astype(jnp.float32)
+        ref_grads = [g.astype(jnp.float32) for g in ref_grad(qc, kc, vc)]
+        jax.block_until_ready((ref_out, ref_grads))
+
     rows = []
     for name, attn in configs:
         fwd = jax.jit(lambda q, k, v, a=attn: a(q, k, v))
         grad = jax.jit(jax.grad(loss_of(attn), argnums=(0, 1, 2)))
         if args.check and name != "xla_dense":
-            ref_fwd = jax.jit(functools.partial(dense_attention, causal=True))
-            ref_grad = jax.jit(jax.grad(
-                loss_of(functools.partial(dense_attention, causal=True)),
-                argnums=(0, 1, 2)))
-            qc, kc, vc = argsets[0]
+            qc, kc, vc = argsets[-1]
             err_o = float(jnp.max(jnp.abs(
-                fwd(qc, kc, vc).astype(jnp.float32)
-                - ref_fwd(qc, kc, vc).astype(jnp.float32))))
+                fwd(qc, kc, vc).astype(jnp.float32) - ref_out)))
             errs_g = [
-                float(jnp.max(jnp.abs(a.astype(jnp.float32)
-                                      - b.astype(jnp.float32))))
-                for a, b in zip(grad(qc, kc, vc), ref_grad(qc, kc, vc))
+                float(jnp.max(jnp.abs(a.astype(jnp.float32) - b)))
+                for a, b in zip(grad(qc, kc, vc), ref_grads)
             ]
             print(json.dumps({"config": name, "check_max_abs_err_out": err_o,
                               "check_max_abs_err_dqkv": errs_g}))

@@ -92,14 +92,21 @@ def build_generate(args):
 
     decode_model = transformer_lm(**cfg, decode=True)
 
-    @functools.partial(jax.jit, static_argnums=(1, 2))
-    def run(prompt, max_new, temperature):
-        return generate(decode_model, params, prompt, max_new,
-                        temperature=temperature)
+    # Only greedy-vs-sampling is a compile-cache key: the temperature
+    # VALUE and the seed are traced operands, so clients sweeping
+    # temperatures (or every request carrying a fresh seed) never
+    # trigger recompiles.
+    @functools.partial(jax.jit, static_argnums=(3, 4))
+    def run(prompt, temperature, seed, max_new, sample):
+        return generate(
+            decode_model, params, prompt, max_new,
+            temperature=temperature if sample else 0.0,
+            rng=jax.random.PRNGKey(seed),
+        )
 
     # Warm the compile cache for a representative shape.
     run(jnp.zeros((1, min(8, args.max_prompt_len)), jnp.int32),
-        args.max_new_tokens, 0.0).block_until_ready()
+        0.0, 0, args.max_new_tokens, False).block_until_ready()
     return run
 
 
@@ -137,18 +144,21 @@ def make_handler(run, args):
                                       args.max_new_tokens))
                 max_new = min(max_new, args.max_new_tokens)
                 temperature = float(req.get("temperature", 0.0))
+                # Per-request seed (overridable for reproducibility) so
+                # sampled output differs across requests and replicas.
+                seed = int(req.get("seed", time.time_ns() & 0x7FFFFFFF))
                 # One generate per prompt at its EXACT length: no pad
                 # tokens ever enter the KV cache (a mixed-length batch
                 # would attend its padding).  Compiles cache per
-                # distinct (length, max_new) pair.
+                # distinct (length, max_new, sample?) tuple.
                 t0 = time.perf_counter()
                 toks = []
-                for p in prompts:
+                for i, p in enumerate(prompts):
                     ids = [int(t) % args.vocab_size
                            for t in p][: args.max_prompt_len] or [0]
                     out = np.asarray(run(
-                        jnp.asarray([ids], jnp.int32), max_new,
-                        temperature,
+                        jnp.asarray([ids], jnp.int32), temperature,
+                        seed + i, max_new, temperature > 0,
                     ))
                     toks.append(out[0].tolist())
                 dt = (time.perf_counter() - t0) * 1e3

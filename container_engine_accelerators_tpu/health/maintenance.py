@@ -70,9 +70,15 @@ def reconcile(
     event = current_event(fetch)
     node = api.read_node(node_name)
     taints = (node.get("spec") or {}).get("taints") or []
-    tainted = any(t.get("key") == TAINT_KEY for t in taints)
+    current = next(
+        (t.get("value") for t in taints if t.get("key") == TAINT_KEY), None
+    )
 
-    if event and not tainted:
+    if event and current != event:
+        # New maintenance notice OR an escalation (e.g. MIGRATE ->
+        # TERMINATE) while already tainted: converge the taint value and
+        # post a fresh event — consumers selecting on TERMINATE must see
+        # the escalation, not the stale first notice.
         api.patch_node_taints(node_name, _with_taint(taints, event))
         write_event_file(
             events_dir, MAINTENANCE_CODE, None,
@@ -80,7 +86,7 @@ def reconcile(
         )
         log.warning("maintenance %s: tainted node %s and posted code %d",
                     event, node_name, MAINTENANCE_CODE)
-    elif not event and tainted:
+    elif not event and current is not None:
         api.patch_node_taints(node_name, _without_taint(taints))
         log.info("maintenance cleared: untainted node %s", node_name)
     return event

@@ -39,10 +39,14 @@ def generate(
     """Generate ``max_new_tokens`` past ``prompt`` [B, P] -> [B, P+N].
 
     ``model`` must be constructed with ``decode=True``.  Jittable with
-    static ``max_new_tokens``/``temperature``.
+    static ``max_new_tokens``; ``temperature`` may be a TRACED scalar
+    when sampling (only greedy-vs-sampling is structural — a Python
+    0 / 0.0 selects greedy; anything else, including a tracer, samples),
+    so servers can take the value from the request without recompiling.
     """
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
+    greedy = isinstance(temperature, (int, float)) and temperature == 0
     b, plen = prompt.shape
     max_len = plen + max_new_tokens
     cache = init_cache(model, b, max_len)
@@ -58,11 +62,11 @@ def generate(
             mutable=["cache"],
         )
         nxt_logits = logits[:, 0, :]
-        if temperature > 0:
+        if greedy:
+            sampled = jnp.argmax(nxt_logits, axis=-1)
+        else:
             rng, sub = jax.random.split(rng)
             sampled = jax.random.categorical(sub, nxt_logits / temperature)
-        else:
-            sampled = jnp.argmax(nxt_logits, axis=-1)
         sampled = sampled.astype(prompt.dtype)
         # Teacher-force while still inside the prompt.
         in_prompt = i + 1 < plen

@@ -57,6 +57,23 @@ def test_event_posted_once_while_pending(tmp_path):
     assert len(os.listdir(ev_dir)) == 1  # no duplicate event spam
 
 
+def test_escalation_updates_taint_and_reposts(tmp_path):
+    """MIGRATE -> TERMINATE while tainted must converge the taint value
+    and post a fresh event (consumers keying on TERMINATE must see it)."""
+    api = FakeApi()
+    ev_dir = str(tmp_path / "events")
+    mw.reconcile(api, "n0", fetcher("MIGRATE_ON_HOST_MAINTENANCE"),
+                 events_dir=ev_dir)
+    mw.reconcile(api, "n0", fetcher("TERMINATE_ON_HOST_MAINTENANCE"),
+                 events_dir=ev_dir)
+    assert len(api.patches) == 2
+    assert api.patches[-1][-1]["value"] == "TERMINATE_ON_HOST_MAINTENANCE"
+    events = sorted(os.listdir(ev_dir))
+    assert len(events) == 2
+    last = json.load(open(os.path.join(ev_dir, events[-1])))
+    assert "TERMINATE" in last["message"]
+
+
 def test_clear_event_removes_taint_keeps_others(tmp_path):
     other = {"key": "dedicated", "value": "ml", "effect": "NoSchedule"}
     api = FakeApi(taints=[other,
