@@ -68,9 +68,11 @@ def build_generate(args):
         mlp_dim=args.mlp_dim,
     )
     sample = jnp.zeros((1, 8), jnp.int32)
+    # Optimizer must match cmd/train_lm.py's (adamw) so the checkpoint's
+    # opt_state tree restores; serving only reads the params.
     state = create_lm_train_state(
         transformer_lm(**cfg), jax.random.PRNGKey(0), sample,
-        tx=optax.sgd(0.1),
+        tx=optax.adamw(3e-4, weight_decay=0.1),
     )
     params = state.params
     if args.checkpoint_dir:

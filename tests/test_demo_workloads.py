@@ -89,6 +89,29 @@ def test_serve_resnet_http_roundtrip(tmp_path):
 
 
 @pytest.mark.slow
+def test_serve_lm_loads_trained_checkpoint(tmp_path):
+    """Train-then-serve contract for the LM: cmd/train_lm.py's orbax
+    output loads into cmd/serve_lm.py and generation runs on it."""
+    tiny = ["--num-layers", "1", "--num-heads", "2", "--head-dim", "8",
+            "--mlp-dim", "32", "--vocab-size", "64"]
+    train = _load("train_lm_for_serve", "cmd", "train_lm.py")
+    train.main(tiny + [
+        "--seq-len", "16", "--train-batch-size", "8", "--train-steps", "2",
+        "--steps-per-eval", "1", "--checkpoint-dir", str(tmp_path / "ck"),
+        "--checkpoint-interval", "2",
+    ])
+    serve = _load("serve_lm_ckpt", "cmd", "serve_lm.py")
+    args = serve.parse_args(tiny + [
+        "--max-prompt-len", "8", "--max-new-tokens", "2", "--port", "0",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+    ])
+    run = serve.build_generate(args)
+    import jax.numpy as jnp
+    out = run(jnp.asarray([[1, 2]], jnp.int32), 0.0, 0, 2, False)
+    assert out.shape == (1, 4)
+
+
+@pytest.mark.slow
 def test_serve_lm_http_roundtrip(tmp_path):
     serve = _load("serve_lm_main", "cmd", "serve_lm.py")
     args = serve.parse_args([
