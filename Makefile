@@ -51,6 +51,18 @@ presubmit:
 	bash build/check_boilerplate.sh
 	bash build/check_shell.sh
 
+# Full on-chip evidence suite (needs a reachable TPU; results append to
+# BENCH_TPU_LOG.jsonl). Each stage is independent; failures don't stop
+# the rest.
+.PHONY: bench-hw
+bench-hw:
+	-python bench.py
+	-BENCH_WORKLOAD=lm python bench.py
+	-BENCH_WORKLOAD=inception python bench.py
+	-python cmd/bench_attention.py --seq 4096 --check
+	-python cmd/roofline_resnet.py --batches 128,256,512
+	-python demo/tpu-error/hbm-oom/inject_error.py --real-oom --events-dir /tmp/oom_events
+
 # Sanitizer build + test of the native daemon — the `go test -race`
 # analog for our C++ surface (ref: Makefile:20-22 runs the unit suite
 # under the race detector on every CI run).
