@@ -1,0 +1,299 @@
+#!/usr/bin/env python3
+"""Hardware-evidence watcher: probe the TPU tunnel, fire the evidence
+suite on first contact.
+
+Round 3's lesson (VERDICT.md round 3, "what's missing" item 2): the
+one mechanism that can convert a mid-round tunnel window into committed
+evidence must be a *committed tool*, not an ad-hoc shell loop that dies
+with its terminal.  The reference commits its harness rigs the same way
+(gpudirect-tcpxo/nccl-test.yaml:33-40 bakes the benchmark invocation
+into the manifest rather than leaving it to an operator's history).
+
+Behavior:
+
+- every ``--interval`` seconds (default 180), probe the accelerator
+  backend in a subprocess with a hard timeout (never inline — the axon
+  tunnel's hang mode blocks ``jax.devices()`` indefinitely, and an
+  inline probe would wedge the watcher itself);
+- on a down->up transition, run the evidence stages (default: the
+  ``make bench-hw`` suite, in its order) sequentially, each under its
+  own generous timeout; stage failures don't stop later stages;
+- every probe/stage outcome is appended to ``--state`` (JSONL) the
+  moment it happens, so a crash loses at most one event.  Successful
+  bench stages append to BENCH_TPU_LOG.jsonl themselves (bench.py);
+- the loop survives probe and stage crashes: any exception is logged
+  and the next tick proceeds;
+- ``--daemonize`` double-forks, writes ``--pidfile``, and redirects
+  output to ``--logfile`` so ``make watch-hw`` can start it detached
+  and ``make watch-hw-stop`` can kill it by exact pid (a pkill by
+  pattern self-matches the launching shell — seen in round 3).
+
+The suite is edge-triggered: it runs once per down->up transition
+(plus optionally once at start if the backend is already up), so a
+stable tunnel doesn't re-run benchmarks every 3 minutes; pass
+``--rearm`` to re-run on every later transition after a wedge.
+"""
+
+import argparse
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE_CMD = (
+    f"{shlex.quote(sys.executable)} -c "
+    "'import jax; d = jax.devices(); print(d[0].platform, len(d))'"
+)
+
+# The `make bench-hw` suite, in VERDICT round-3 priority order: the
+# ResNet number first (validates the log path end-to-end), then the
+# open perf questions.
+# bench.py's worst case is BENCH_RETRY_BUDGET (900 s) + the CPU
+# fallback (up to 1800 s); stage timeouts sit above that so the watcher
+# never SIGKILLs bench below its own runtime envelope (that would
+# recreate the round-3 evidence-loss mode this tool exists to close).
+_BENCH_STAGE_TIMEOUT = 3600
+
+DEFAULT_STAGES = [
+    {"name": "bench_resnet", "cmd": [sys.executable, "bench.py"],
+     "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_lm", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "lm"}, "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "flash_vs_xla",
+     "cmd": [sys.executable, "cmd/bench_attention.py", "--seq", "4096",
+             "--check"],
+     "timeout": 1800},
+    {"name": "roofline",
+     "cmd": [sys.executable, "cmd/roofline_resnet.py", "--batches",
+             "128,256,512"],
+     "timeout": 1800},
+    {"name": "bench_inception", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "inception"}, "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "real_oom",
+     "cmd": [sys.executable, "demo/tpu-error/hbm-oom/inject_error.py",
+             "--real-oom", "--events-dir", "/tmp/oom_events"],
+     "timeout": 900},
+]
+
+
+def _run_stage_cmd(cmd, cwd, env, timeout, grace=30.0):
+    """(rc, stdout) with a SIGTERM-first timeout.
+
+    On timeout the child gets SIGTERM and ``grace`` seconds to finish —
+    bench.py converts exactly that signal into a final evidence line —
+    and only then SIGKILL.  Captured stdout survives every path.
+    """
+    proc = subprocess.Popen(
+        cmd, cwd=cwd, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, out or ""
+    except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            out, _ = proc.communicate(timeout=grace)
+            return "timeout", out or ""
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            return "timeout-killed", out or ""
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+class Watcher:
+    def __init__(self, probe_cmd, stages, state_path, interval=180.0,
+                 probe_timeout=120.0, rearm=False, run_if_up_at_start=True):
+        self.probe_cmd = probe_cmd
+        self.stages = stages
+        self.state_path = state_path
+        self.interval = interval
+        self.probe_timeout = probe_timeout
+        self.rearm = rearm
+        self.run_if_up_at_start = run_if_up_at_start
+        self.last_up = None  # None = no probe yet (start edge)
+        self.suite_runs = 0
+
+    def _record(self, event: dict) -> None:
+        event = {"ts": _now(), **event}
+        line = json.dumps(event)
+        print(f"hw_watcher: {line}", file=sys.stderr, flush=True)
+        try:
+            with open(self.state_path, "a") as f:
+                f.write(line + "\n")
+        except OSError as e:
+            print(f"hw_watcher: state append failed: {e}", file=sys.stderr)
+
+    def probe(self) -> bool:
+        """One subprocess probe under a hard timeout; False on ANY
+        failure mode (nonzero, timeout, spawn error)."""
+        try:
+            proc = subprocess.run(
+                self.probe_cmd, shell=isinstance(self.probe_cmd, str),
+                cwd=_REPO_ROOT, capture_output=True, text=True,
+                timeout=self.probe_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            self._record({"event": "probe", "up": False, "mode": "hang",
+                          "timeout_s": self.probe_timeout})
+            return False
+        except Exception as e:  # noqa: BLE001 — the loop must survive
+            self._record({"event": "probe", "up": False, "mode": "crash",
+                          "error": repr(e)})
+            return False
+        up = proc.returncode == 0
+        self._record({
+            "event": "probe", "up": up,
+            "mode": "ok" if up else "init-failed",
+            "detail": (proc.stdout if up else proc.stderr)
+            .strip().splitlines()[-1:],
+        })
+        return up
+
+    def run_suite(self) -> None:
+        self.suite_runs += 1
+        self._record({"event": "suite-start", "run": self.suite_runs,
+                      "stages": [s["name"] for s in self.stages]})
+        for stage in self.stages:
+            name = stage["name"]
+            env = dict(os.environ)
+            env.update(stage.get("env", {}))
+            t0 = time.monotonic()
+            try:
+                rc, out = _run_stage_cmd(
+                    stage["cmd"], cwd=_REPO_ROOT, env=env,
+                    timeout=stage.get("timeout", 1800),
+                )
+                tail = out.strip().splitlines()[-1:]
+            except Exception as e:  # noqa: BLE001 — keep going
+                rc, tail = "crash", [repr(e)]
+            self._record({
+                "event": "stage", "run": self.suite_runs, "name": name,
+                "rc": rc, "secs": round(time.monotonic() - t0, 1),
+                "stdout_tail": tail,
+            })
+        self._record({"event": "suite-done", "run": self.suite_runs})
+
+    def tick(self) -> None:
+        """One probe + (maybe) suite run.  Exceptions stay inside."""
+        try:
+            up = self.probe()
+        except Exception as e:  # noqa: BLE001 — belt and braces
+            self._record({"event": "probe", "up": False, "mode": "crash",
+                          "error": repr(e)})
+            up = False
+        was_up = self.last_up
+        self.last_up = up
+        if not up:
+            return
+        is_edge = was_up is False or (was_up is None
+                                      and self.run_if_up_at_start)
+        if not is_edge:
+            return
+        if self.suite_runs > 0 and not self.rearm:
+            self._record({"event": "suite-skipped",
+                          "reason": "already ran; --rearm not set"})
+            return
+        try:
+            self.run_suite()
+        except Exception as e:  # noqa: BLE001
+            self._record({"event": "suite-crash", "error": repr(e)})
+
+    def loop(self, max_ticks=None) -> None:
+        n = 0
+        while max_ticks is None or n < max_ticks:
+            self.tick()
+            n += 1
+            if max_ticks is not None and n >= max_ticks:
+                break
+            time.sleep(self.interval)
+
+
+def _live_watcher_pid(pidfile: str):
+    """Pid from the pidfile if that process is still alive, else None."""
+    try:
+        pid = int(open(pidfile).read().strip())
+        os.kill(pid, 0)
+        return pid
+    except (OSError, ValueError):
+        return None
+
+
+def _daemonize(logfile: str, pidfile: str) -> None:
+    """Classic double-fork so the watcher survives the launching shell
+    and session (make target / agent harness)."""
+    if os.fork() > 0:
+        os._exit(0)
+    os.setsid()
+    if os.fork() > 0:
+        os._exit(0)
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()) + "\n")
+    log = open(logfile, "a")
+    os.dup2(log.fileno(), sys.stdout.fileno())
+    os.dup2(log.fileno(), sys.stderr.fileno())
+    devnull = open(os.devnull)
+    os.dup2(devnull.fileno(), sys.stdin.fileno())
+    signal.signal(signal.SIGHUP, signal.SIG_IGN)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=180.0)
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--probe-cmd", default=PROBE_CMD,
+                    help="shell command; rc 0 within the timeout = up")
+    ap.add_argument("--stages-json", default=None,
+                    help="path to a JSON list of stage dicts "
+                    "({name, cmd, env?, timeout?}) replacing the "
+                    "default bench-hw suite (tests use this)")
+    ap.add_argument("--state",
+                    default=os.path.join(_REPO_ROOT, "HW_WATCH_STATE.jsonl"))
+    ap.add_argument("--max-ticks", type=int, default=None,
+                    help="stop after N probes (tests); default: forever")
+    ap.add_argument("--rearm", action="store_true",
+                    help="re-run the suite on every down->up transition")
+    ap.add_argument("--no-initial-run", action="store_true",
+                    help="only fire on a down->up transition, not when "
+                    "the backend is already up at the first probe")
+    ap.add_argument("--daemonize", action="store_true")
+    ap.add_argument("--logfile",
+                    default=os.path.join(_REPO_ROOT, "hw_watcher.log"))
+    ap.add_argument("--pidfile",
+                    default=os.path.join(_REPO_ROOT, ".hw_watcher.pid"))
+    args = ap.parse_args(argv)
+
+    stages = DEFAULT_STAGES
+    if args.stages_json:
+        with open(args.stages_json) as f:
+            stages = json.load(f)
+    if args.daemonize:
+        live = _live_watcher_pid(args.pidfile)
+        if live is not None:
+            # Two watchers would double-fire the suite on the same edge
+            # and the stop target would only know about one of them.
+            print(f"hw_watcher: already running (pid {live}); refusing "
+                  f"to start a second — `make watch-hw-stop` first",
+                  file=sys.stderr)
+            return 1
+        _daemonize(args.logfile, args.pidfile)
+    w = Watcher(
+        probe_cmd=args.probe_cmd, stages=stages, state_path=args.state,
+        interval=args.interval, probe_timeout=args.probe_timeout,
+        rearm=args.rearm, run_if_up_at_start=not args.no_initial_run,
+    )
+    w.loop(max_ticks=args.max_ticks)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
