@@ -25,6 +25,7 @@ import logging
 import time
 from typing import Callable, List, Optional
 
+from container_engine_accelerators_tpu.scheduler.k8s import ApiException
 from container_engine_accelerators_tpu.tpulib.sysfs import write_event_file
 
 log = logging.getLogger(__name__)
@@ -57,6 +58,9 @@ def _without_taint(taints: List[dict]) -> List[dict]:
     return [t for t in taints if t.get("key") != TAINT_KEY]
 
 
+_CONFLICT_RETRIES = 3
+
+
 def reconcile(
     api,
     node_name: str,
@@ -65,30 +69,54 @@ def reconcile(
 ) -> Optional[str]:
     """One pass: read metadata, converge the node taint, emit the event.
 
+    The taint update is a read-modify-write of the FULL taint list
+    (``spec.taints`` is atomic under strategic merge — see
+    ``patch_node_taints``), so each write carries the read's
+    ``resourceVersion`` and retries on 409 Conflict: a taint added
+    concurrently by another controller between our read and patch must
+    re-enter the list we send, not get silently wiped.
+
     Returns the active maintenance event (None when clear).
     """
     event = current_event(fetch)
-    node = api.read_node(node_name)
-    taints = (node.get("spec") or {}).get("taints") or []
-    current = next(
-        (t.get("value") for t in taints if t.get("key") == TAINT_KEY), None
-    )
-
-    if event and current != event:
-        # New maintenance notice OR an escalation (e.g. MIGRATE ->
-        # TERMINATE) while already tainted: converge the taint value and
-        # post a fresh event — consumers selecting on TERMINATE must see
-        # the escalation, not the stale first notice.
-        api.patch_node_taints(node_name, _with_taint(taints, event))
-        write_event_file(
-            events_dir, MAINTENANCE_CODE, None,
-            f"host maintenance imminent: {event}",
+    for attempt in range(_CONFLICT_RETRIES):
+        node = api.read_node(node_name)
+        taints = (node.get("spec") or {}).get("taints") or []
+        rv = (node.get("metadata") or {}).get("resourceVersion")
+        current = next(
+            (t.get("value") for t in taints if t.get("key") == TAINT_KEY),
+            None,
         )
-        log.warning("maintenance %s: tainted node %s and posted code %d",
-                    event, node_name, MAINTENANCE_CODE)
-    elif not event and current is not None:
-        api.patch_node_taints(node_name, _without_taint(taints))
-        log.info("maintenance cleared: untainted node %s", node_name)
+        try:
+            if event and current != event:
+                # New maintenance notice OR an escalation (e.g. MIGRATE
+                # -> TERMINATE) while already tainted: converge the
+                # taint value and post a fresh event — consumers
+                # selecting on TERMINATE must see the escalation, not
+                # the stale first notice.
+                api.patch_node_taints(
+                    node_name, _with_taint(taints, event),
+                    resource_version=rv,
+                )
+                write_event_file(
+                    events_dir, MAINTENANCE_CODE, None,
+                    f"host maintenance imminent: {event}",
+                )
+                log.warning(
+                    "maintenance %s: tainted node %s and posted code %d",
+                    event, node_name, MAINTENANCE_CODE,
+                )
+            elif not event and current is not None:
+                api.patch_node_taints(
+                    node_name, _without_taint(taints), resource_version=rv,
+                )
+                log.info("maintenance cleared: untainted node %s", node_name)
+        except ApiException as e:
+            if e.status == 409 and attempt < _CONFLICT_RETRIES - 1:
+                log.info("taint update conflicted (409); re-reading node")
+                continue
+            raise
+        break
     return event
 
 

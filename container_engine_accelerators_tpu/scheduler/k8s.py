@@ -107,9 +107,22 @@ class CoreV1:
     def read_node(self, name: str) -> dict:
         return self._t("GET", f"/api/v1/nodes/{name}")
 
-    def patch_node_taints(self, name: str, taints: List[dict]) -> dict:
-        """Replace the node's taint list (strategic merge keys on taint
-        'key', so callers send the full desired list)."""
-        return self._t(
-            "PATCH", f"/api/v1/nodes/{name}", {"spec": {"taints": taints}}
-        )
+    def patch_node_taints(
+        self, name: str, taints: List[dict],
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        """Replace the node's taint list wholesale.
+
+        ``spec.taints`` is an ATOMIC list under strategic-merge-patch
+        (it has no patchMergeKey), so this patch overwrites whatever is
+        there — it does NOT merge per taint key.  Callers doing a
+        read-modify-write must pass the ``metadata.resourceVersion``
+        from their read: the API server then rejects the patch with 409
+        Conflict if the node changed in between, instead of silently
+        wiping a concurrently-added taint (e.g.
+        ``node.kubernetes.io/not-ready`` from the node controller).
+        """
+        body: dict = {"spec": {"taints": taints}}
+        if resource_version is not None:
+            body["metadata"] = {"resourceVersion": resource_version}
+        return self._t("PATCH", f"/api/v1/nodes/{name}", body)

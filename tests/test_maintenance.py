@@ -4,25 +4,43 @@ Unit tests drive reconcile() against a fake CoreV1; the binary test in
 test_daemon_binaries.py covers the subprocess + HTTP path.
 """
 
+import copy
 import json
 import os
 
 from container_engine_accelerators_tpu.health import maintenance as mw
+from container_engine_accelerators_tpu.scheduler.k8s import ApiException
 
 
 class FakeApi:
+    """Honours resourceVersion like the real API server: a patch built
+    from a stale read gets 409, spec.taints is replaced atomically."""
+
     def __init__(self, taints=None):
-        self.node = {"metadata": {"name": "n0"},
+        self.node = {"metadata": {"name": "n0", "resourceVersion": "1"},
                      "spec": {"taints": taints or []}}
         self.patches = []
 
     def read_node(self, name):
         assert name == "n0"
-        return self.node
+        return copy.deepcopy(self.node)
 
-    def patch_node_taints(self, name, taints):
+    def mutate_concurrently(self, taint):
+        """Another controller adds a taint: resourceVersion advances."""
+        self.node["spec"]["taints"].append(taint)
+        self._bump()
+
+    def _bump(self):
+        md = self.node["metadata"]
+        md["resourceVersion"] = str(int(md["resourceVersion"]) + 1)
+
+    def patch_node_taints(self, name, taints, resource_version=None):
+        if resource_version is not None and \
+                resource_version != self.node["metadata"]["resourceVersion"]:
+            raise ApiException(409, "Conflict")
         self.patches.append(taints)
         self.node["spec"]["taints"] = taints
+        self._bump()
         return self.node
 
 
@@ -55,6 +73,37 @@ def test_event_posted_once_while_pending(tmp_path):
     mw.reconcile(api, "n0", fetch, events_dir=ev_dir)  # still pending
     assert len(api.patches) == 1  # no re-taint
     assert len(os.listdir(ev_dir)) == 1  # no duplicate event spam
+
+
+def test_concurrent_taint_survives_via_conflict_retry(tmp_path):
+    """ADVICE r03: spec.taints is atomic — a taint added by another
+    controller between our read and patch must survive.  The stale
+    first patch gets 409; the retry re-reads and re-sends the full
+    list including the concurrent taint."""
+    api = FakeApi()
+    not_ready = {"key": "node.kubernetes.io/not-ready", "value": "",
+                 "effect": "NoExecute"}
+
+    stale_read = api.read_node  # capture, then interpose
+
+    reads = {"n": 0}
+
+    def racing_read(name):
+        node = stale_read(name)
+        if reads["n"] == 0:
+            # Concurrent controller lands AFTER our read: our first
+            # patch is now stale.
+            api.mutate_concurrently(dict(not_ready))
+        reads["n"] += 1
+        return node
+
+    api.read_node = racing_read
+    mw.reconcile(api, "n0", fetcher("TERMINATE_ON_HOST_MAINTENANCE"),
+                 events_dir=str(tmp_path / "events"))
+    final = api.node["spec"]["taints"]
+    assert not_ready in final, "concurrent taint was wiped"
+    assert any(t["key"] == mw.TAINT_KEY for t in final)
+    assert reads["n"] == 2  # one retry after the 409
 
 
 def test_escalation_updates_taint_and_reposts(tmp_path):
