@@ -106,7 +106,10 @@ class DcnXferClient:
         """Read back staged bytes (what a peer daemon landed into the
         flow, or what ``put`` staged locally).  Base64 over the control
         socket; reads larger than the daemon's 512 KiB per-call cap are
-        chunked by offset."""
+        chunked by offset.  The daemon bounds reads by the last
+        completed frame's length (``frame_bytes`` in each response), so
+        a read past the staged payload returns short rather than stale
+        buffer tail."""
         out = bytearray()
         while len(out) < nbytes:
             chunk = min(nbytes - len(out), self.READ_CHUNK)
@@ -116,6 +119,15 @@ class DcnXferClient:
             if not data:
                 break
             out.extend(data)
+            if len(data) < chunk:
+                break  # clamped at the staged frame's end
+            frame = int(resp.get("frame_bytes", 0))
+            if frame and offset + len(out) >= frame:
+                # Exactly at the frame boundary: the next chunk's offset
+                # would be rejected by the daemon, so stop here (a frame
+                # that is an exact multiple of READ_CHUNK otherwise
+                # turns a legitimate short read into an error).
+                break
         return bytes(out)
 
     def put(self, flow: str, data: bytes, host: str = "127.0.0.1",

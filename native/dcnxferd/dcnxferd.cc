@@ -171,6 +171,12 @@ struct Flow {
   void* buffer = nullptr;
   unsigned long long transferred = 0;  // bytes sent / recorded by owner
   unsigned long long rx_bytes = 0;     // bytes landed via the data plane
+  // Bytes of the most recent COMPLETED frame that landed in the staging
+  // buffer (clamped to buffer_bytes).  Reads are bounded by this, not
+  // by buffer_bytes: before any frame lands the buffer holds zeros, and
+  // after a shorter second frame the previous frame's tail is stale —
+  // neither must be readable as if it were payload.
+  unsigned long long frame_bytes = 0;
 };
 
 // Data-plane frame header: magic + flow-name length + payload length.
@@ -205,6 +211,18 @@ class Daemon {
   }
 
   void set_data_port(int port) { data_port_ = port; }
+
+  // A data-plane frame finished: remember how much of it actually
+  // landed in the staging buffer so reads can be clamped to real data.
+  void RecordFrameComplete(const std::string& flow,
+                           unsigned long long frame_len) {
+    auto it = flows_.find(flow);
+    if (it == flows_.end()) return;
+    unsigned long long landed = frame_len;
+    if (landed > it->second.buffer_bytes)
+      landed = it->second.buffer_bytes;
+    it->second.frame_bytes = landed;
+  }
 
   // Data-plane landing: account a received chunk against its flow (or
   // the unmatched counter when no local flow has that name).
@@ -469,8 +487,18 @@ class Daemon {
     }
     if (offset >= it->second.buffer_bytes)
       return Err("'offset' beyond staging buffer");
-    if (nbytes > it->second.buffer_bytes - offset)
-      nbytes = it->second.buffer_bytes - offset;
+    // Clamp to the last COMPLETED frame, not the buffer: before any
+    // frame lands the buffer is zeros, and after a shorter frame the
+    // previous frame's tail is stale — returning either as payload
+    // gives callers torn data with an ok=true response (ADVICE r03).
+    unsigned long long staged = it->second.frame_bytes;
+    if (staged == 0)
+      return Err("no completed frame staged in flow '" +
+                 JsonEscape(fit->second) + "'");
+    if (offset >= staged)
+      return Err("'offset' beyond staged data (frame_bytes=" +
+                 std::to_string(staged) + ")");
+    if (nbytes > staged - offset) nbytes = staged - offset;
     if (nbytes > (512ull << 10))
       return Err("read capped at 512 KiB per call");
 
@@ -478,6 +506,7 @@ class Daemon {
         Base64((const unsigned char*)it->second.buffer + offset,
                (size_t)nbytes);
     std::string extra = "\"bytes\":" + std::to_string(nbytes) +
+                        ",\"frame_bytes\":" + std::to_string(staged) +
                         ",\"data\":\"" + b64 + "\"";
     return Ok(extra);
   }
@@ -486,13 +515,15 @@ class Daemon {
     std::string detail = "[";
     bool first = true;
     for (const auto& kv : flows_) {
-      char item[384];  // names are <=64 chars (IsValidName), so this fits
+      char item[448];  // names are <=64 chars (IsValidName), so this fits
       snprintf(item, sizeof(item),
                "%s{\"flow\":\"%s\",\"peer\":\"%s\",\"buffer_bytes\":%zu,"
-               "\"transferred\":%llu,\"rx_bytes\":%llu}",
+               "\"transferred\":%llu,\"rx_bytes\":%llu,"
+               "\"frame_bytes\":%llu}",
                first ? "" : ",", kv.second.name.c_str(),
                kv.second.peer.c_str(), kv.second.buffer_bytes,
-               kv.second.transferred, kv.second.rx_bytes);
+               kv.second.transferred, kv.second.rx_bytes,
+               kv.second.frame_bytes);
       detail += item;
       first = false;
     }
@@ -648,6 +679,7 @@ bool PumpDataConn(DataConn* dc, Daemon* daemon) {
         unsigned long long micros = NowMicros() - dc->t0;
         logf(1, "frame complete: flow '%s' in %llu us", dc->flow.c_str(),
              micros ? micros : 1);
+        daemon->RecordFrameComplete(dc->flow, dc->frame_len);
         dc->state = DataConn::HDR;
         dc->acc.clear();
       }

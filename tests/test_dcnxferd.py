@@ -260,6 +260,49 @@ class TestDataPlane:
             _wait_rx(b, "x", len(payload))
             assert b.read("x", len(payload)) == payload
 
+    def test_read_before_any_frame_is_an_error(self, daemon):
+        """ADVICE r03: reading an empty staging buffer must not return
+        zeros with ok=true — there is no data, say so."""
+        with DcnXferClient(daemon) as c:
+            c.register_flow("empty", bytes=4096)
+            with pytest.raises(DcnXferError, match="no completed frame"):
+                c.read("empty", 16)
+
+    def test_shorter_second_frame_clamps_stale_tail(self, daemon):
+        """After a shorter second frame, the first frame's tail beyond
+        frame_bytes is stale and must not be readable."""
+        big = bytes(range(256)) * 16     # 4096
+        small = b"\xaa" * 512
+        with DcnXferClient(daemon) as c:
+            c.register_flow("clamp", bytes=len(big))
+            c.put("clamp", big)
+            _wait_rx(c, "clamp", len(big))
+            assert c.read("clamp", len(big)) == big
+            c.put("clamp", small)
+            _wait_rx(c, "clamp", len(big) + len(small))
+            # Full-size read comes back clamped to the new frame.
+            assert c.read("clamp", len(big)) == small
+            flow = next(f for f in c.stats()["flows"]
+                        if f["flow"] == "clamp")
+            assert flow["frame_bytes"] == len(small)
+            # Offsets past the staged frame error instead of returning
+            # the stale first-frame tail.
+            with pytest.raises(DcnXferError, match="beyond staged data"):
+                c.read("clamp", 16, offset=len(small))
+
+    def test_read_frame_exact_chunk_multiple(self, daemon):
+        """A frame that is an exact multiple of the client's READ_CHUNK
+        must read back fully — the chunk loop has to stop AT the frame
+        boundary rather than issue one more call the daemon rejects."""
+        payload = os.urandom(1 << 20)  # exactly 2 x READ_CHUNK
+        with DcnXferClient(daemon) as c:
+            c.register_flow("exact", bytes=len(payload))
+            c.put("exact", payload)
+            _wait_rx(c, "exact", len(payload))
+            assert c.read("exact", len(payload)) == payload
+            # Asking for MORE than staged also returns short, not error.
+            assert c.read("exact", len(payload) + 4096) == payload
+
     def test_read_respects_ownership_and_bounds(self, daemon):
         c1 = DcnXferClient(daemon)
         c1.register_flow("own", bytes=4096)
