@@ -37,11 +37,18 @@ def parse_args(argv=None):
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--mlp-dim", type=int, default=2048)
     p.add_argument("--max-prompt-len", type=int, default=64,
-                   help="longest accepted prompt; each distinct prompt "
-                        "length compiles once (cached thereafter)")
-    p.add_argument("--max-new-tokens", type=int, default=32)
+                   help="longest accepted prompt; prompts are padded to "
+                        "power-of-two buckets, so ~log2 of this many "
+                        "compiles total")
+    p.add_argument("--max-new-tokens", type=int, default=32,
+                   help="tokens generated per prompt (pinned: requests "
+                        "asking for more are capped, fewer are sliced)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint dir from cmd/train_lm.py")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree: shard params Megatron-"
+                        "style over this many local devices (decode "
+                        "output is exactly the single-device tokens)")
     return p.parse_args(argv)
 
 
@@ -94,22 +101,55 @@ def build_generate(args):
 
     decode_model = transformer_lm(**cfg, decode=True)
 
-    # Only greedy-vs-sampling is a compile-cache key: the temperature
-    # VALUE and the seed are traced operands, so clients sweeping
-    # temperatures (or every request carrying a fresh seed) never
-    # trigger recompiles.
-    @functools.partial(jax.jit, static_argnums=(3, 4))
-    def run(prompt, temperature, seed, max_new, sample):
+    if args.tp > 1:
+        # Megatron-style tensor parallelism for serving: params sharded
+        # over a 1 x tp mesh's model axis; GSPMD inserts the collectives
+        # in the decode step.  Validated against single-device greedy in
+        # __graft_entry__.dryrun_multichip (tp decode regime).
+        from container_engine_accelerators_tpu.parallel import (
+            create_mesh,
+            shard_params,
+        )
+
+        devs = jax.devices()[: args.tp]
+        if len(devs) < args.tp:
+            raise SystemExit(
+                f"--tp {args.tp} needs {args.tp} devices, have {len(devs)}"
+            )
+        tp_mesh = create_mesh(data=1, model=args.tp, devices=devs)
+        params = jax.device_put(params, shard_params(params, tp_mesh))
+        log.info("params sharded %d-way tensor parallel", args.tp)
+
+    # The compile-cache key is (prompt BUCKET, sample?) only — nothing
+    # a client controls beyond ~log2(max_prompt_len)*2 entries (ADVICE
+    # r03: per-exact-length keys plus an honored per-request max_new
+    # let one client sweep ~64*32*2 compiles and starve the serving
+    # threads).  Temperature value, seed, and true prompt length are
+    # traced operands; max_new_tokens is pinned to the server config.
+    @functools.partial(jax.jit, static_argnums=(4,))
+    def run(prompt, prompt_len, temperature, seed, sample):
         return generate(
-            decode_model, params, prompt, max_new,
+            decode_model, params, prompt, args.max_new_tokens,
             temperature=temperature if sample else 0.0,
             rng=jax.random.PRNGKey(seed),
+            prompt_len=prompt_len,
         )
 
     # Warm the compile cache for a representative shape.
-    run(jnp.zeros((1, min(8, args.max_prompt_len)), jnp.int32),
-        0.0, 0, args.max_new_tokens, False).block_until_ready()
+    warm = bucket_len(1, args.max_prompt_len)
+    run(jnp.zeros((1, warm), jnp.int32), 1, 0.0, 0,
+        False).block_until_ready()
     return run
+
+
+def bucket_len(n: int, cap: int) -> int:
+    """Smallest power of two >= n, capped at ``cap`` (the configured
+    max prompt length is always an allowed bucket even when it is not
+    itself a power of two)."""
+    b = 1
+    while b < n and b < cap:
+        b <<= 1
+    return min(b, cap)
 
 
 def make_handler(run, args):
@@ -149,20 +189,26 @@ def make_handler(run, args):
                 # Per-request seed (overridable for reproducibility) so
                 # sampled output differs across requests and replicas.
                 seed = int(req.get("seed", time.time_ns() & 0x7FFFFFFF))
-                # One generate per prompt at its EXACT length: no pad
-                # tokens ever enter the KV cache (a mixed-length batch
-                # would attend its padding).  Compiles cache per
-                # distinct (length, max_new, sample?) tuple.
+                # One generate per prompt, padded to its power-of-two
+                # BUCKET with the true length passed as a traced scalar:
+                # compile cache stays ~log2(max_prompt_len)*2 entries,
+                # and generate()'s teacher-forcing cutoff keeps pad
+                # tokens out of the KV cache entirely.  The model runs
+                # the server-pinned max_new_tokens; the response is
+                # sliced to the (capped) requested amount.
                 t0 = time.perf_counter()
                 toks = []
                 for i, p in enumerate(prompts):
                     ids = [int(t) % args.vocab_size
                            for t in p][: args.max_prompt_len] or [0]
+                    plen = len(ids)
+                    bucket = bucket_len(plen, args.max_prompt_len)
+                    padded = ids + [0] * (bucket - plen)
                     out = np.asarray(run(
-                        jnp.asarray([ids], jnp.int32), temperature,
-                        seed + i, max_new, temperature > 0,
+                        jnp.asarray([padded], jnp.int32), plen,
+                        temperature, seed + i, temperature > 0,
                     ))
-                    toks.append(out[0].tolist())
+                    toks.append(out[0][: plen + max_new].tolist())
                 dt = (time.perf_counter() - t0) * 1e3
                 self._send(200, {"tokens": toks,
                                  "latency_ms": round(dt, 2)})

@@ -35,6 +35,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    prompt_len=None,
 ):
     """Generate ``max_new_tokens`` past ``prompt`` [B, P] -> [B, P+N].
 
@@ -43,11 +44,23 @@ def generate(
     when sampling (only greedy-vs-sampling is structural — a Python
     0 / 0.0 selects greedy; anything else, including a tracer, samples),
     so servers can take the value from the request without recompiling.
+
+    ``prompt_len`` (optional, may be a TRACED scalar) is the number of
+    leading ``prompt`` tokens that are real; the rest of the prompt
+    array is free padding that never enters the computation — teacher
+    forcing stops at ``prompt_len`` and the model generates its own
+    continuation from there.  This is the seam that lets a server
+    bucket prompt lengths (pad to a power of two) without a compile per
+    exact length AND without pad tokens ever reaching the KV cache:
+    every token fed is either real prompt or previously generated.
+    Defaults to the full (static) prompt width.
     """
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
     greedy = isinstance(temperature, (int, float)) and temperature == 0
     b, plen = prompt.shape
+    if prompt_len is None:
+        prompt_len = plen
     max_len = plen + max_new_tokens
     cache = init_cache(model, b, max_len)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -68,8 +81,10 @@ def generate(
             rng, sub = jax.random.split(rng)
             sampled = jax.random.categorical(sub, nxt_logits / temperature)
         sampled = sampled.astype(prompt.dtype)
-        # Teacher-force while still inside the prompt.
-        in_prompt = i + 1 < plen
+        # Teacher-force while still inside the (possibly traced-length)
+        # prompt; the index clamp keeps the gather in-bounds — the
+        # gathered value is unused once past prompt_len.
+        in_prompt = i + 1 < prompt_len
         nxt = jnp.where(
             in_prompt,
             jax.lax.dynamic_index_in_dim(

@@ -67,6 +67,39 @@ def test_sampled_decode_valid_and_seeded(params):
     assert np.asarray(a).min() >= 0 and np.asarray(a).max() < 97
 
 
+def test_bucketed_prompt_matches_exact_length(params):
+    """The serving bucket seam (ADVICE r03): a prompt padded to a
+    larger bucket with prompt_len passed must produce the SAME tokens
+    over [0, prompt_len + max_new) as the exact-length call — pads must
+    neither enter the KV cache nor perturb the continuation."""
+    model = transformer_lm(**CFG, decode=True)
+    prompt = jnp.asarray([[5, 17, 42]], jnp.int32)
+    exact = generate(model, params, prompt, 5)
+    padded = jnp.asarray([[5, 17, 42, 0, 0, 0, 0, 0]], jnp.int32)
+    bucketed = generate(model, params, padded, 5, prompt_len=3)
+    np.testing.assert_array_equal(
+        np.asarray(exact), np.asarray(bucketed)[:, : 3 + 5]
+    )
+
+
+def test_serve_lm_bucket_len():
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "serve_lm_buckets", os.path.join(repo, "cmd", "serve_lm.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert [mod.bucket_len(n, 64) for n in (1, 2, 3, 5, 8, 9, 64)] == \
+        [1, 2, 4, 8, 8, 16, 64]
+    # The cap itself is always an allowed bucket, even when not 2**k.
+    assert mod.bucket_len(50, 48) == 48
+    # Total distinct buckets stays logarithmic in the cap.
+    assert len({mod.bucket_len(n, 64) for n in range(1, 65)}) <= 7
+
+
 def test_generate_requires_decode_model(params):
     with pytest.raises(ValueError, match="decode=True"):
         generate(transformer_lm(**CFG), params,
