@@ -468,15 +468,14 @@ def _probe_backend(timeout: int):
     tunnel already known to be wedged).
     """
     try:
-        proc = subprocess.run(
+        proc = _run_tracked(
             [
                 sys.executable,
                 "-c",
                 "import jax; d = jax.devices(); "
                 "print(d[0].platform, len(d))",
             ],
-            cwd=_REPO_ROOT, capture_output=True, text=True,
-            timeout=timeout,
+            timeout, cwd=_REPO_ROOT,
         )
     except subprocess.TimeoutExpired:
         print(
@@ -495,6 +494,37 @@ def _probe_backend(timeout: int):
 
 
 _BEST_LINE = None  # last JSON line printed; SIGTERM re-emits it
+_CHILD = None      # in-flight benchmark subprocess; SIGTERM kills it
+
+
+class _RunResult:
+    def __init__(self, returncode, stdout, stderr):
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def _run_tracked(cmd, timeout, **popen_kw):
+    """subprocess.run-alike that exposes the child to the SIGTERM
+    handler: exiting the orchestrator must not orphan a benchmark child
+    that would keep the chip busy for up to BENCH_ATTEMPT_TIMEOUT after
+    the parent is gone (the next watcher stage would then fail
+    backend-init against its own predecessor)."""
+    global _CHILD
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        **popen_kw,
+    )
+    _CHILD = proc
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        raise
+    finally:
+        _CHILD = None
+    return _RunResult(proc.returncode, out, err)
 
 
 def _emit(result: dict) -> None:
@@ -506,12 +536,16 @@ def _emit(result: dict) -> None:
 
 def _handle_term(signum, frame):  # noqa: ARG001 — signal signature
     """``timeout(1)`` sends SIGTERM before SIGKILL — a free last chance
-    to leave a parseable artifact.  Re-emit the best-known line and
-    exit 0 immediately (``os._exit``: the handler may fire inside
-    ``subprocess.run`` and must not unwind into more work)."""
+    to leave a parseable artifact.  Kill any in-flight child, re-emit
+    the best-known line, exit 0 immediately (``os._exit``: the handler
+    may fire inside ``communicate`` and must not unwind into more
+    work)."""
     sys.stderr.write(
         "bench: SIGTERM received — re-emitting best-known result line\n"
     )
+    child = _CHILD
+    if child is not None and child.poll() is None:
+        child.kill()
     if _BEST_LINE is not None:
         sys.stdout.write(_BEST_LINE + "\n")
         sys.stdout.flush()
@@ -625,10 +659,7 @@ def orchestrate() -> int:
         env = dict(os.environ)
         env["BENCH_INNER"] = "1"
         try:
-            proc = subprocess.run(
-                cmd, env=env, cwd=_REPO_ROOT, capture_output=True,
-                text=True, timeout=timeout,
-            )
+            proc = _run_tracked(cmd, timeout, env=env, cwd=_REPO_ROOT)
         except subprocess.TimeoutExpired:
             print(
                 f"bench attempt {attempt + 1}/{attempts}: timed out after "
@@ -687,10 +718,8 @@ def orchestrate() -> int:
     standing_note = ("provisional line stands" if last_tpu is not None
                      else "no measurement produced")
     try:
-        proc = subprocess.run(
-            cmd, env=_cpu_env(), cwd=_REPO_ROOT, capture_output=True,
-            text=True, timeout=cpu_timeout,
-        )
+        proc = _run_tracked(cmd, cpu_timeout, env=_cpu_env(),
+                            cwd=_REPO_ROOT)
     except subprocess.TimeoutExpired:
         print(f"bench: CPU fallback timed out; {standing_note}",
               file=sys.stderr)

@@ -10,6 +10,7 @@ import importlib.util
 import json
 import os
 import sys
+import time
 
 import pytest
 
@@ -164,6 +165,9 @@ def test_sigterm_reemits_line_and_exits_zero():
     env.update({
         "BENCH_RETRY_BUDGET": "300",   # long enough to be mid-loop
         "BENCH_MAX_ATTEMPTS": "40",
+        # Long probe: at SIGTERM time the orchestrator is mid-probe with
+        # a live child, exercising the handler's kill-the-child path.
+        "BENCH_PROBE_TIMEOUT": "300",
     })
     proc = subprocess.Popen(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -173,6 +177,19 @@ def test_sigterm_reemits_line_and_exits_zero():
     try:
         first = proc.stdout.readline()  # blocks until provisional emit
         assert json.loads(first)["provisional"] is True
+        # Find THIS orchestrator's probe child (other bench/watcher
+        # processes on the host run identical probes — match by parent).
+        child_pids = []
+        for _ in range(50):
+            got = subprocess.run(
+                ["pgrep", "-P", str(proc.pid)], capture_output=True,
+                text=True,
+            ).stdout.split()
+            if got:
+                child_pids = [int(p) for p in got]
+                break
+            time.sleep(0.2)
+        assert child_pids, "probe child never spawned"
         proc.send_signal(_signal.SIGTERM)
         rc = proc.wait(timeout=30)
         rest = proc.stdout.read()
@@ -185,6 +202,25 @@ def test_sigterm_reemits_line_and_exits_zero():
     reemitted = json.loads(lines[-1])
     assert reemitted["last_tpu"]["value"] == json.loads(first)[
         "last_tpu"]["value"]
+    # The in-flight probe child must not outlive the orchestrator — an
+    # orphan would keep the chip/tunnel busy into the next bench stage.
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        alive = [p for p in child_pids if _pid_alive(p)]
+        if not alive:
+            break
+        time.sleep(0.2)
+    assert not alive, f"orphaned probe children: {alive}"
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
 
 
 def test_committed_log_is_valid_and_has_tpu_entry():
