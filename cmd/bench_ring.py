@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Ring-attention layout microbenchmark: contiguous vs zigzag wall clock.
+
+Times causal ring attention over a device mesh in both layouts and
+compares the measured speedup against the analytic critical-path ratio
+(:func:`container_engine_accelerators_tpu.parallel.seq.ring_skip_stats`,
+closed form 4n/(2n+1) ≈ 2x).  The skip is a ``lax.cond`` per
+(q-half, k-chunk) pair, so the saving is real executed work on every
+backend — on the 8-device virtual CPU mesh this is the wall-clock
+companion to the chunk-count tests
+(tests/test_seq_parallel.py::test_zigzag_skip_halves_critical_path_at_scale);
+on a TPU slice it is the on-chip timing VERDICT r03 item 8 asks for.
+
+Prints one JSON line:
+  {"metric": "ring_zigzag_speedup", "value": <contig_s / zigzag_s>,
+   "predicted": <analytic ratio>, ...}
+
+Run on the virtual mesh with
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python cmd/bench_ring.py --seq 16384
+(launch with the TPU harness env unset — see tests/conftest.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--devices", type=int, default=0,
+                   help="sequence-parallel degree (0 = all local devices)")
+    p.add_argument("--seq", type=int, default=16384, help="GLOBAL seq len")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--check", action="store_true",
+                   help="also verify both layouts agree numerically")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+
+    from container_engine_accelerators_tpu.parallel.seq import (
+        from_zigzag,
+        make_sequence_parallel_attention,
+        ring_skip_stats,
+        to_zigzag,
+    )
+    from container_engine_accelerators_tpu.parallel import create_mesh
+
+    n = args.devices or len(jax.devices())
+    if len(jax.devices()) < n:
+        raise SystemExit(f"need {n} devices, have {len(jax.devices())}")
+    if args.seq % (2 * n):
+        raise SystemExit(f"--seq must divide by 2*{n}")
+    mesh = create_mesh(data=n, model=1, devices=jax.devices()[:n])
+
+    # One distinct nonce-seeded Q PER dispatch (shared K/V): byte-
+    # identical dispatches are replayed from the axon tunnel's
+    # execution cache (the round-1 failure mode documented in
+    # BENCH_HW.md), so no timed iteration may repeat an input.  Same
+    # discipline as cmd/bench_attention.py's _time_fn.
+    nonce = int(time.time_ns()) & 0x7FFFFFFF
+    shape = (args.batch, args.seq, args.heads, args.head_dim)
+    kk, kv = jax.random.split(jax.random.PRNGKey(nonce), 2)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+    n_sets = 3 * args.iters  # 3 timing rounds, median-of-3
+    qs = [
+        jax.random.normal(jax.random.PRNGKey(nonce + 1 + i), shape,
+                          jnp.bfloat16)
+        for i in range(n_sets + 1)  # last = warmup/check set, never timed
+    ]
+    jax.block_until_ready((qs, k, v))
+
+    results = {}
+    outs = {}
+    for layout in ("contiguous", "zigzag"):
+        fn = make_sequence_parallel_attention(
+            mesh, kind="ring", causal=True, layout=layout
+        )
+        if layout == "zigzag":
+            kz, vz = to_zigzag(k, n), to_zigzag(v, n)
+            argsets = [(to_zigzag(q, n), kz, vz) for q in qs]
+        else:
+            argsets = [(q, k, v) for q in qs]
+        jax.block_until_ready(argsets)
+        out = fn(*argsets[-1])
+        jax.block_until_ready(out)  # compile outside the clock
+        for _ in range(args.warmup):
+            out = fn(*argsets[-1])
+        # Sync with a host value fetch (tunneled backends can ack
+        # block_until_ready early — BENCH_HW.md).
+        float(jnp.sum(out.astype(jnp.float32)))
+        times = []
+        for r in range(3):
+            t0 = time.perf_counter()
+            for i in range(args.iters):
+                out = fn(*argsets[r * args.iters + i])
+            checksum = float(jnp.sum(out.astype(jnp.float32)))
+            times.append((time.perf_counter() - t0) / args.iters)
+        dt = sorted(times)[1]
+        results[layout] = dt
+        out = fn(*argsets[-1])  # check on the never-timed warmup set
+        outs[layout] = from_zigzag(out, n) if layout == "zigzag" else out
+        print(f"bench_ring: {layout:10s} {dt * 1e3:8.1f} ms/iter "
+              f"median-of-3 (checksum {checksum:.1f})", file=sys.stderr)
+
+    if args.check:
+        import numpy as np
+
+        a = np.asarray(outs["contiguous"], np.float32)
+        b = np.asarray(outs["zigzag"], np.float32)
+        err = float(np.max(np.abs(a - b)))
+        print(f"bench_ring: layout agreement max abs err {err:.5f}",
+              file=sys.stderr)
+        if err >= 0.05:
+            raise SystemExit(f"layouts disagree: {err}")
+
+    stats_c = ring_skip_stats(args.seq, n, "contiguous")
+    stats_z = ring_skip_stats(args.seq, n, "zigzag")
+    predicted = stats_c["critical"] / stats_z["critical"]
+    speedup = results["contiguous"] / results["zigzag"]
+    print(json.dumps({
+        "metric": "ring_zigzag_speedup",
+        "value": round(speedup, 3),
+        "unit": "x (contiguous/zigzag wall clock)",
+        "predicted": round(predicted, 3),
+        "vs_baseline": round(speedup / predicted, 3),
+        "seq": args.seq,
+        "devices": n,
+        "contiguous_ms": round(results["contiguous"] * 1e3, 2),
+        "zigzag_ms": round(results["zigzag"] * 1e3, 2),
+        "platform": jax.devices()[0].platform,
+        "nonce": nonce,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

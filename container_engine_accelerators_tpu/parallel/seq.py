@@ -211,10 +211,27 @@ def ring_skip_stats(t: int, n: int, layout: str = "contiguous",
     is the layout's claimed ~2x (→ 4n/(2n+1), asymptotically 2).
     """
     tq = tk = t // n
-    chunk, nc = _chunks_of(tk)
-    if ring_chunk is not None:
-        chunk = ring_chunk if tk % ring_chunk == 0 else tk
-        nc = tk // chunk
+
+    def _k_chunks(k_pos):
+        """K ranges exactly as the implementation cuts them: zigzag
+        splits at the half boundary first (both halves always — see
+        _ring_forward.attend), then RING_CHUNK within each piece."""
+        pieces = (
+            [k_pos[: tk // 2], k_pos[tk // 2:]]
+            if layout == "zigzag" else [k_pos]
+        )
+        out = []
+        for piece in pieces:
+            size = int(piece.shape[0])
+            chunk, nc = _chunks_of(size)
+            if ring_chunk is not None:
+                chunk = ring_chunk if size % ring_chunk == 0 else size
+                nc = size // chunk
+            out.extend(
+                piece[c * chunk:(c + 1) * chunk] for c in range(nc)
+            )
+        return out
+
     per_step_max = []
     total = 0.0
     for s in range(n):
@@ -229,8 +246,7 @@ def ring_skip_stats(t: int, n: int, layout: str = "contiguous",
             )
             cost = 0
             for qp in q_blocks:
-                for c in range(nc):
-                    kp = k_pos[c * chunk:(c + 1) * chunk]
+                for kp in _k_chunks(k_pos):
                     if not bool(_fully_masked(qp, kp)):
                         cost += int(qp.shape[0]) * int(kp.shape[0])
             worst = max(worst, cost)
@@ -394,25 +410,28 @@ def _ring_forward(q, k, v, axis_name, causal, scale, layout="contiguous"):
         k_pos = _ring_positions(layout, src, tk, n)
         if layout != "zigzag":
             return _block_attend(q_s, k_blk, v_blk, m, l, o, q_pos, k_pos)
-        # Zigzag: attend each Q HALF separately.  The resident shard is
-        # one EARLY and one LATE global half-chunk whose position ranges
-        # are disjoint; run together, the late half's huge max position
-        # makes _fully_masked almost never fire (the busiest rank holds
-        # the global tail and would attend every chunk — no critical-
-        # path win at any chunk granularity).  Split, each (q-half,
-        # k-chunk) pair skips independently: exactly 2 of the 4 half-
-        # pair matmuls survive per ring step (3 on the diagonal), which
-        # IS the ~2x claimed by the layout comment above
+        # Zigzag: attend each (Q half × K half) pair separately.  The
+        # resident shard is one EARLY and one LATE global half-chunk
+        # whose position ranges are disjoint; run whole-block, the late
+        # half's huge max position makes _fully_masked almost never
+        # fire (the busiest rank holds the global tail and would attend
+        # every chunk — no critical-path win at any chunk granularity).
+        # Split on BOTH sides, each half-pair skips independently
+        # regardless of RING_CHUNK vs shard size: exactly 2 of the 4
+        # half-pair matmuls survive per ring step (3 on the diagonal),
+        # which IS the ~2x claimed by the layout comment above
         # :func:`zigzag_permutation` (accounting:
         # :func:`ring_skip_stats`).
-        half = tq // 2
+        half_q, half_k = tq // 2, tk // 2
         outs = []
-        for qs, qe in ((0, half), (half, tq)):
-            outs.append(_block_attend(
-                q_s[:, qs:qe], k_blk, v_blk,
-                m[:, :, qs:qe], l[:, :, qs:qe], o[:, qs:qe],
-                q_pos[qs:qe], k_pos,
-            ))
+        for qs, qe in ((0, half_q), (half_q, tq)):
+            c = (m[:, :, qs:qe], l[:, :, qs:qe], o[:, qs:qe])
+            for ks, ke in ((0, half_k), (half_k, tk)):
+                c = _block_attend(
+                    q_s[:, qs:qe], k_blk[:, ks:ke], v_blk[:, ks:ke],
+                    *c, q_pos[qs:qe], k_pos[ks:ke],
+                )
+            outs.append(c)
         (m0_, l0_, o0_), (m1_, l1_, o1_) = outs
         return (
             jnp.concatenate([m0_, m1_], axis=2),
@@ -483,22 +502,32 @@ def _ring_attention_bwd(axis_name, causal, scale, layout, res, do):
         dq, k_blk, v_blk, dk_blk, dv_blk = carry
         src = (idx - step_idx) % n
         if causal and layout == "zigzag":
-            # Per-Q-half backward, mirroring the forward's split (see
-            # _ring_forward.attend): each half's fully-masked chunks
-            # contribute exact zeros and are skipped.
+            # Per-(Q half × K half) backward, mirroring the forward's
+            # split (see _ring_forward.attend): each half-pair's fully-
+            # masked chunks contribute exact zeros and are skipped.
             k_pos = _ring_positions(layout, src, tk, n)
-            half = tq // 2
-            dq_parts, dk_c, dv_c = [], 0.0, 0.0
-            for qs, qe in ((0, half), (half, tq)):
-                dq_h, dk_h, dv_h = _block_backward(
-                    q_s[:, qs:qe], do[:, qs:qe], delta[:, :, qs:qe],
-                    lse[:, :, qs:qe], k_blk, v_blk, scale, axis_name,
-                    q_pos[qs:qe], k_pos,
-                )
+            half_q, half_k = tq // 2, tk // 2
+            dq_parts = []
+            dk_halves = [0.0, 0.0]
+            dv_halves = [0.0, 0.0]
+            for qs, qe in ((0, half_q), (half_q, tq)):
+                dq_h = 0.0
+                for ki, (ks, ke) in enumerate(
+                    ((0, half_k), (half_k, tk))
+                ):
+                    dq_p, dk_p, dv_p = _block_backward(
+                        q_s[:, qs:qe], do[:, qs:qe], delta[:, :, qs:qe],
+                        lse[:, :, qs:qe], k_blk[:, ks:ke],
+                        v_blk[:, ks:ke], scale, axis_name,
+                        q_pos[qs:qe], k_pos[ks:ke],
+                    )
+                    dq_h = dq_h + dq_p
+                    dk_halves[ki] = dk_halves[ki] + dk_p
+                    dv_halves[ki] = dv_halves[ki] + dv_p
                 dq_parts.append(dq_h)
-                dk_c = dk_c + dk_h
-                dv_c = dv_c + dv_h
             dq_c = jnp.concatenate(dq_parts, axis=1)
+            dk_c = jnp.concatenate(dk_halves, axis=1)
+            dv_c = jnp.concatenate(dv_halves, axis=1)
         elif causal:
             k_pos = _ring_positions(layout, src, tk, n)
             dq_c, dk_c, dv_c = _block_backward(

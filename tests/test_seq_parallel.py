@@ -289,3 +289,34 @@ def test_zigzag_skip_ratio_survives_fine_chunking():
     cont = ring_skip_stats(t, n, layout="contiguous", ring_chunk=128)
     zig = ring_skip_stats(t, n, layout="zigzag", ring_chunk=128)
     assert cont["critical"] / zig["critical"] > 1.75
+
+
+@pytest.mark.slow
+def test_bench_ring_cli_runs_and_layouts_agree():
+    """cmd/bench_ring.py end-to-end on the virtual mesh: both layouts
+    execute, agree numerically (--check), and the JSON line carries the
+    analytic prediction alongside the measurement."""
+    import importlib.util
+    import json as _json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_ring_cli", os.path.join(repo, "cmd", "bench_ring.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main(["--devices", "4", "--seq", "512", "--heads", "2",
+                       "--head-dim", "16", "--iters", "2", "--warmup", "1",
+                       "--check"])
+    assert rc == 0
+    line = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["metric"] == "ring_zigzag_speedup"
+    assert line["predicted"] == pytest.approx(16 / 9, abs=0.01)  # 4n/(2n+1)
+    assert line["value"] > 0
