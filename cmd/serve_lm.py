@@ -36,6 +36,9 @@ def parse_args(argv=None):
     p.add_argument("--num-heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--mlp-dim", type=int, default=2048)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="GQA KV heads (0 = MHA); shrinks the KV cache "
+                        "and the per-token HBM read by heads/kv-heads")
     p.add_argument("--max-prompt-len", type=int, default=64,
                    help="longest accepted prompt; prompts are padded to "
                         "power-of-two buckets, so ~log2 of this many "
@@ -73,6 +76,7 @@ def build_generate(args):
         num_heads=args.num_heads,
         head_dim=args.head_dim,
         mlp_dim=args.mlp_dim,
+        num_kv_heads=args.kv_heads or None,
     )
     sample = jnp.zeros((1, 8), jnp.int32)
     # Optimizer must match cmd/train_lm.py's (adamw) so the checkpoint's

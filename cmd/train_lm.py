@@ -30,6 +30,8 @@ def parse_args(argv=None):
     p.add_argument("--num-heads", type=int, default=8)
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--mlp-dim", type=int, default=2048)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="GQA KV heads (0 = MHA)")
     p.add_argument("--seq-len", type=int, default=2048,
                    help="GLOBAL sequence length (sharded across the mesh "
                         "under --seq-parallel)")
@@ -110,6 +112,7 @@ def main(argv=None):
         num_heads=args.num_heads,
         head_dim=args.head_dim,
         mlp_dim=args.mlp_dim,
+        num_kv_heads=args.kv_heads or None,
         seq_parallel=seq_parallel,
     )
     sample = jnp.ones((args.train_batch_size, args.seq_len), jnp.int32)

@@ -12,8 +12,10 @@ can run dense (single device), ring (ppermute over ICI), or Ulysses
 TPU-first choices: bf16 compute / f32 params, RMSNorm (one fused
 rsqrt, no mean subtraction), SwiGLU MLP (two matmuls feed one
 elementwise gate — MXU-dense), rotary position embeddings computed
-with static shapes, and no data-dependent control flow anywhere, so
-the whole step jits and shards under GSPMD.
+with static shapes, grouped-query attention (``num_kv_heads``) so the
+decode KV cache — the HBM-bandwidth term that bounds serving
+tokens/sec — shrinks by the group factor, and no data-dependent
+control flow anywhere, so the whole step jits and shards under GSPMD.
 """
 
 import functools
@@ -73,16 +75,27 @@ class Attention(nn.Module):
     seq_axis: str = "data"
     use_flash: Optional[bool] = None  # None = auto: TPU + tile-aligned
     decode: bool = False  # autoregressive KV-cache mode
+    # Grouped-query attention (GQA): project K/V to this many heads
+    # (None = MHA).  The KV cache and the K/V projections shrink by
+    # num_heads/num_kv_heads — on TPU the decode step is HBM-bound on
+    # the cache read, so this is a direct tokens/sec and
+    # max-context-length lever for serving.
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions):
         dense = functools.partial(
             nn.DenseGeneral, use_bias=False, dtype=self.dtype
         )
-        features = (self.num_heads, self.head_dim)
-        q = dense(features, name="q")(x)
-        k = dense(features, name="k")(x)
-        v = dense(features, name="v")(x)
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % kv_heads:
+            raise ValueError(
+                f"num_heads={self.num_heads} not divisible by "
+                f"num_kv_heads={kv_heads}"
+            )
+        q = dense((self.num_heads, self.head_dim), name="q")(x)
+        k = dense((kv_heads, self.head_dim), name="k")(x)
+        v = dense((kv_heads, self.head_dim), name="v")(x)
         q = rotary_embedding(q, positions)
         k = rotary_embedding(k, positions)
 
@@ -95,6 +108,17 @@ class Attention(nn.Module):
             return dense(x.shape[-1], axis=(-2, -1), name="out")(
                 self._decode_attend(q, k, v, positions)
             )
+
+        if kv_heads != self.num_heads:
+            # Training/prefill paths share MHA kernels (flash, ring,
+            # Ulysses all assume equal Q/KV heads): broadcast K/V up.
+            # XLA fuses the repeat into the consuming matmul, so no
+            # materialized copy; the projection/optimizer savings stand.
+            # Decode does NOT take this path — its cache stays at
+            # kv_heads and the einsums group instead (_decode_attend).
+            rep = self.num_heads // kv_heads
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
         if self.seq_parallel in ("ring", "ring-zigzag"):
             # ring-zigzag: shards are in zigzag storage order (the
@@ -137,15 +161,21 @@ class Attention(nn.Module):
         length is fixed by the shape used at ``init`` (flax's standard
         cache-variable pattern), so the decode step jits once and is
         reused for every token.
+
+        Under GQA the cache holds only ``num_kv_heads`` heads and the
+        score/value einsums group the query heads over them — the
+        repeat is never materialized, so the HBM read per decoded
+        token shrinks by the group factor.
         """
         b, t, h, d = q.shape
+        kvh = k.shape[2]
         cached_k = self.variable(
             "cache", "cached_key",
-            lambda: jnp.zeros((b, t, h, d), k.dtype),
+            lambda: jnp.zeros((b, t, kvh, d), k.dtype),
         )
         cached_v = self.variable(
             "cache", "cached_value",
-            lambda: jnp.zeros((b, t, h, d), v.dtype),
+            lambda: jnp.zeros((b, t, kvh, d), v.dtype),
         )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -164,21 +194,26 @@ class Attention(nn.Module):
         )
         cache_index.value = idx + t
 
+        # Group query heads over the (possibly fewer) cached KV heads:
+        # q head g*i+j attends KV head i.  With kvh == h the reshape is
+        # the identity grouping and this is plain MHA.
+        group = h // kvh
+        qg = q.reshape(b, t, kvh, group, d)
         s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q * (self.head_dim**-0.5), cached_k.value,
-            preferred_element_type=jnp.float32,
+            "bqhgd,bkhd->bhgqk", qg * (self.head_dim**-0.5),
+            cached_k.value, preferred_element_type=jnp.float32,
         )
         # Key j is visible to query at global position p when j <= p;
         # queries in this call sit at `positions` (shape [t]).
         key_pos = jnp.arange(max_len)
         mask = key_pos[None, :] <= positions[:, None]  # [t, max_len]
-        s = jnp.where(mask[None, None], s, -1e30)
+        s = jnp.where(mask[None, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum(
-            "bhqk,bkhd->bqhd", p, cached_v.value,
+            "bhgqk,bkhd->bqhgd", p, cached_v.value,
             preferred_element_type=jnp.float32,
         )
-        return o.astype(q.dtype)
+        return o.reshape(b, t, h, d).astype(q.dtype)
 
 
 class Block(nn.Module):
@@ -191,6 +226,7 @@ class Block(nn.Module):
     use_flash: Optional[bool] = None
     decode: bool = False
     num_experts: int = 0  # >0: MoE FFN (Switch top-1) instead of dense
+    num_kv_heads: Optional[int] = None  # GQA (None = MHA)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -203,6 +239,7 @@ class Block(nn.Module):
             self.seq_axis,
             self.use_flash,
             self.decode,
+            num_kv_heads=self.num_kv_heads,
             name="attn",
         )(y, positions)
         y = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
@@ -236,6 +273,7 @@ class _ScanBlock(nn.Module):
     use_flash: Optional[bool]
     decode: bool
     num_experts: int = 0
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, positions):
@@ -249,6 +287,7 @@ class _ScanBlock(nn.Module):
             self.use_flash,
             self.decode,
             self.num_experts,
+            num_kv_heads=self.num_kv_heads,
             name="block",
         )(x, positions)
         return x, aux
@@ -272,6 +311,7 @@ class TransformerLM(nn.Module):
     use_flash: Optional[bool] = None
     decode: bool = False
     num_experts: int = 0  # >0: MoE-LM (Switch FFN in every block)
+    num_kv_heads: Optional[int] = None  # GQA (None = MHA)
     remat: bool = True  # rematerialize blocks in backward (saves HBM)
 
     @nn.compact
@@ -296,6 +336,7 @@ class TransformerLM(nn.Module):
             self.use_flash,
             self.decode,
             self.num_experts,
+            self.num_kv_heads,
         )
         # Scan over a single stacked Block: compile time is O(1) in depth
         # instead of O(num_layers) — with a Python loop the 12-layer

@@ -104,3 +104,58 @@ def test_generate_requires_decode_model(params):
     with pytest.raises(ValueError, match="decode=True"):
         generate(transformer_lm(**CFG), params,
                  jnp.zeros((1, 2), jnp.int32), 1)
+
+
+GQA_CFG = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+
+
+class TestGQA:
+    """Grouped-query attention: the decode path groups query heads over
+    a kv_heads-sized cache (never materializing the repeat) while the
+    train path broadcasts K/V up to MHA kernels — greedy decode equal to
+    iterated train-mode argmax proves the two factorizations agree."""
+
+    @pytest.fixture(scope="class")
+    def gqa_params(self):
+        state = create_lm_train_state(
+            transformer_lm(**GQA_CFG), jax.random.PRNGKey(3),
+            jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+        )
+        return state.params
+
+    def test_greedy_decode_matches_train_mode(self, gqa_params):
+        model = transformer_lm(**GQA_CFG)
+        prompt = jnp.asarray([[5, 17, 42], [88, 3, 9]], jnp.int32)
+        toks = prompt
+        for _ in range(5):
+            logits = model.apply(
+                {"params": gqa_params}, toks,
+                positions=jnp.arange(toks.shape[1]),
+            )
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+        got = generate(transformer_lm(**GQA_CFG, decode=True),
+                       gqa_params, prompt, 5)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(toks))
+
+    def test_cache_and_projections_shrink_to_kv_heads(self, gqa_params):
+        model = transformer_lm(**GQA_CFG, decode=True)
+        prompt = jnp.asarray([[5, 17, 42]], jnp.int32)
+        variables = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )
+        cache = variables["cache"]["blocks"]["block"]["attn"]
+        # [layers, batch, max_len, KV heads, head_dim]
+        assert cache["cached_key"].shape[3] == 2
+        assert cache["cached_value"].shape[3] == 2
+        k_kernel = gqa_params["blocks"]["block"]["attn"]["k"]["kernel"]
+        q_kernel = gqa_params["blocks"]["block"]["attn"]["q"]["kernel"]
+        assert k_kernel.shape[-2] == 2 and q_kernel.shape[-2] == 4
+
+    def test_kv_heads_must_divide_heads(self):
+        bad = dict(GQA_CFG, num_kv_heads=3)
+        with pytest.raises(ValueError, match="not divisible"):
+            transformer_lm(**bad).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+            )
