@@ -750,23 +750,37 @@ int MakeListener(const std::string& sock_path) {
     perror("socket");
     return -1;
   }
+  // Bind under a temp name and rename() into place only after listen():
+  // clients (and the readiness checks in the install DS and tests) treat
+  // the socket file's existence as "accepting connections", so the path
+  // must never be visible in the bound-but-not-listening window.
+  const std::string tmp_path = sock_path + ".tmp";
   unlink(sock_path.c_str());
+  unlink(tmp_path.c_str());
   sockaddr_un addr{};
   addr.sun_family = AF_UNIX;
-  if (sock_path.size() >= sizeof(addr.sun_path)) {
-    fprintf(stderr, "dcnxferd: socket path too long: %s\n", sock_path.c_str());
+  if (tmp_path.size() >= sizeof(addr.sun_path)) {
+    fprintf(stderr, "dcnxferd: socket path too long (with .tmp suffix): %s\n",
+            tmp_path.c_str());
     close(fd);
     return -1;
   }
-  strncpy(addr.sun_path, sock_path.c_str(), sizeof(addr.sun_path) - 1);
+  strncpy(addr.sun_path, tmp_path.c_str(), sizeof(addr.sun_path) - 1);
   if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
     perror("bind");
     close(fd);
     return -1;
   }
-  chmod(sock_path.c_str(), 0666);  // workload pods connect unprivileged
+  chmod(tmp_path.c_str(), 0666);  // workload pods connect unprivileged
   if (listen(fd, 64) != 0) {
     perror("listen");
+    unlink(tmp_path.c_str());
+    close(fd);
+    return -1;
+  }
+  if (rename(tmp_path.c_str(), sock_path.c_str()) != 0) {
+    perror("rename");
+    unlink(tmp_path.c_str());
     close(fd);
     return -1;
   }
