@@ -79,6 +79,21 @@ PEAK_BF16_FLOPS = {
     "v6e": 918e12,
 }
 
+# HBM bandwidth (bytes/s) per chip generation — the roofline's other
+# axis.  Single source of truth; cmd/roofline_resnet.py imports this.
+HBM_BW = {
+    "v4": 1228e9,
+    "v5e": 819e9,
+    "v5p": 2765e9,
+    "v6e": 1640e9,
+}
+
+
+def _chip_hbm_bw(device):
+    """(HBM bytes/s, source) for the attached chip."""
+    gen, source = chip_generation(device)
+    return HBM_BW[gen], source
+
 # Ordered patterns against the normalized device_kind ("TPU v5 lite" ->
 # "tpuv5lite", "TPU v5p" -> "tpuv5p", ...).  "lite" forms first so v5p
 # never shadows them.
@@ -119,21 +134,26 @@ class BenchMeasurementError(RuntimeError):
     """The measurement is physically impossible — do not report it."""
 
 
-def _validate_mfu(mfu: float, on_accel: bool) -> float:
-    """Refuse to report >100% MFU.
+def _validate_utilization(value: float, name: str, ceiling: str,
+                          on_accel: bool) -> float:
+    """Refuse to report >100% utilization (MFU, MBU, ...).
 
-    A measured FLOP rate above the chip's peak means the timed region
-    did not actually execute (an upstream execution cache replayed
-    results, or the backend acked without completing).  Round 1's first
-    'successful' number was 9.4 MFU — worse than no number.  Raising
-    makes the orchestrator retry with a fresh nonce.
+    A measured rate above the chip's physical ceiling means the timed
+    region did not actually execute (an upstream execution cache
+    replayed results, or the backend acked without completing).
+    Round 1's first 'successful' number was 9.4 MFU — worse than no
+    number.  Raising makes the orchestrator retry with a fresh nonce.
     """
-    if on_accel and mfu > 1.0:
+    if on_accel and value > 1.0:
         raise BenchMeasurementError(
-            f"measured MFU {mfu:.2f} exceeds chip peak — execution was "
-            f"cached or not synchronized; rerun with fresh data"
+            f"measured {name} {value:.2f} exceeds {ceiling} — execution "
+            f"was cached or not synchronized; rerun with fresh data"
         )
-    return mfu
+    return value
+
+
+def _validate_mfu(mfu: float, on_accel: bool) -> float:
+    return _validate_utilization(mfu, "MFU", "chip peak", on_accel)
 
 
 def _compile_step(jitted, *args):
@@ -378,6 +398,133 @@ def _run_lm(on_accel: bool):
     }
 
 
+def _run_decode(on_accel: bool):
+    """Serving-side KV-cache decode: tokens/sec on one chip, with
+    memory-bandwidth utilization (MBU) as ``vs_baseline``.
+
+    Decode is HBM-bound, not MXU-bound: every generated token re-reads
+    the whole parameter set plus the layer KV caches, so the ceiling is
+    HBM_BW / bytes_per_token — MBU (measured/ceiling) is the serving
+    counterpart of training MFU.  ``BENCH_DECODE_KV`` selects
+    grouped-query attention (0 = MHA): the cache term shrinks by
+    heads/kv_heads, which is exactly the lever GQA pulls; running the
+    MHA and GQA stages back-to-back on-chip measures that lever.
+
+    Reference altitude: the serving demo + duty-cycle HPA
+    (/root/reference/demo/serving/tensorflow-serving.yaml:63-79); the
+    reference ships no decode benchmark, so the baseline here is the
+    chip roofline rather than a published number.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from container_engine_accelerators_tpu.models.generate import generate
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_accel else "2"))
+    prompt_len = int(
+        os.environ.get("BENCH_DECODE_PROMPT", "64" if on_accel else "4")
+    )
+    new_tokens = int(
+        os.environ.get("BENCH_DECODE_NEW", "192" if on_accel else "4")
+    )
+    layers = int(os.environ.get("BENCH_LM_LAYERS", "12" if on_accel else "2"))
+    kv = int(os.environ.get("BENCH_DECODE_KV", "0"))
+    calls = int(os.environ.get("BENCH_STEPS", "3" if on_accel else "1"))
+    heads, head_dim = (16, 64) if on_accel else (4, 8)
+    vocab = 32_768 if on_accel else 128
+
+    lm_kw = dict(
+        vocab_size=vocab,
+        num_layers=layers,
+        num_heads=heads,
+        head_dim=head_dim,
+        mlp_dim=4096 if on_accel else 32,
+        num_kv_heads=kv or None,
+    )
+    state = create_lm_train_state(
+        transformer_lm(**lm_kw), jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+    )
+    params = state.params
+    model = transformer_lm(**lm_kw, decode=True)
+    run = jax.jit(lambda p: generate(model, params, p, new_tokens))
+
+    # Nonce-seeded prompts, one per timed call (identical dispatches
+    # replay from the tunnel's execution cache; see _run_resnet).  The
+    # last prompt is the warmup/compile set and is never timed.
+    nonce = int(time.time_ns()) & 0x7FFFFFFF
+    prompts = [
+        jax.random.randint(
+            jax.random.PRNGKey(nonce + i), (batch, prompt_len), 0, vocab,
+            jnp.int32,
+        )
+        for i in range(calls + 1)
+    ]
+    jax.block_until_ready(prompts)
+    out = run(prompts[-1])
+    int(jax.device_get(out[0, -1]))  # compile + true sync (host fetch)
+
+    t0 = time.perf_counter()
+    for i in range(calls):
+        out = run(prompts[i])
+    int(jax.device_get(out[0, -1]))
+    dt = time.perf_counter() - t0
+
+    # Every scan iteration is a single-token step (prompt tokens are
+    # teacher-forced through the same decode step); the scan runs
+    # max_len - 1 iterations (the first prompt token is consumed as the
+    # initial carry, never as a step), so each call executes
+    # prompt_len + new_tokens - 1 decode-shaped steps.
+    steps = prompt_len + new_tokens - 1
+    tokens_per_sec = batch * steps * calls / dt
+
+    # HBM bytes per decode step: the full parameter set (read once,
+    # shared across the batch) + each sequence's K and V cache buffers.
+    # The cache einsums read the whole fixed-length buffer every step
+    # (masked, not sliced — static shapes), so the buffer length, not
+    # the current position, is the traffic term.
+    leaves = jax.tree_util.tree_leaves(params)
+    n_params = sum(x.size for x in leaves)
+    param_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+    kvh = kv or heads
+    max_len = prompt_len + new_tokens  # fixed cache buffer length
+    cache_bytes = layers * 2 * max_len * kvh * head_dim * 2  # bf16 K+V
+    bytes_per_step = param_bytes + batch * cache_bytes
+    bw, bw_src = _chip_hbm_bw(jax.devices()[0])
+    mbu = _validate_utilization(
+        bytes_per_step * (steps * calls / dt) / bw,
+        "MBU", "HBM bandwidth", on_accel,
+    )
+
+    suffix = "" if on_accel else "_cpufallback"
+    gqa = f"_gqa{kv}" if kv else ""
+    return {
+        "metric": f"decode_{layers}L{gqa}_bf16_tokens_per_sec_1chip"
+        + suffix,
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mbu, 4) if on_accel else None,
+        "mbu": round(mbu, 4) if on_accel else None,
+        "params": int(n_params),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "kv_heads": kvh,
+        "hbm_bw_gbps": bw / 1e9,
+        "bw_source": bw_src,
+        "bytes_per_step": int(bytes_per_step),
+        "calls": calls,
+        "nonce": nonce,
+    }
+
+
 TPU_LOG = os.path.join(_REPO_ROOT, "BENCH_TPU_LOG.jsonl")
 
 
@@ -415,7 +562,15 @@ def _latest_logged_tpu(workload: str):
             lines = f.read().splitlines()
     except OSError:
         return None
-    prefix = {"lm": "lm_", "inception": "inception"}.get(workload, "resnet")
+    prefix = {"lm": "lm_", "inception": "inception",
+              "decode": "decode_"}.get(workload, "resnet")
+    # The decode workload has MHA and GQA variants distinguished only
+    # by BENCH_DECODE_KV; their entries must not stand in for each
+    # other (the paired watcher stages exist to CONTRAST them).
+    gqa_tag = None
+    if workload == "decode":
+        kv = int(os.environ.get("BENCH_DECODE_KV", "0"))
+        gqa_tag = f"_gqa{kv}_" if kv else ""
     for line in reversed(lines):
         line = line.strip()
         if not line:
@@ -425,8 +580,14 @@ def _latest_logged_tpu(workload: str):
         except ValueError:
             continue
         metric = entry.get("metric", "")
-        if metric.startswith(prefix) and "cpufallback" not in metric:
-            return entry
+        if not metric.startswith(prefix) or "cpufallback" in metric:
+            continue
+        if gqa_tag is not None and (
+            (gqa_tag and gqa_tag not in metric)
+            or (not gqa_tag and "_gqa" in metric)
+        ):
+            continue
+        return entry
     return None
 
 
@@ -439,6 +600,8 @@ def inner_main():
     workload = os.environ.get("BENCH_WORKLOAD", "resnet")
     if workload == "lm":
         result = _run_lm(on_accel)
+    elif workload == "decode":
+        result = _run_decode(on_accel)
     else:
         result = _run_resnet(on_accel, workload)
     if on_accel:

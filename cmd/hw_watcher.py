@@ -67,6 +67,11 @@ DEFAULT_STAGES = [
      "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "bench_lm", "cmd": [sys.executable, "bench.py"],
      "env": {"BENCH_WORKLOAD": "lm"}, "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_decode", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "decode"}, "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_decode_gqa", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "4"},
+     "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "flash_vs_xla",
      "cmd": [sys.executable, "cmd/bench_attention.py", "--seq", "4096",
              "--check"],

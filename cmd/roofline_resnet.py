@@ -25,13 +25,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Peak dense bf16 FLOP/s and HBM bandwidth (bytes/s) per chip generation.
-HBM_BW = {
-    "v4": 1228e9,
-    "v5e": 819e9,
-    "v5p": 2765e9,
-    "v6e": 1640e9,
-}
+# HBM bandwidth (bytes/s) per chip generation — single source of truth
+# lives in bench.py (shared with the decode bench's MBU math).
+from bench import HBM_BW  # noqa: E402 — needs the sys.path insert above
 
 
 def parse_args(argv=None):

@@ -223,6 +223,45 @@ def _pid_alive(pid: int) -> bool:
         return True
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("kv,want", [(0, "decode_2L_bf16"),
+                                     (2, "decode_2L_gqa2_bf16")])
+def test_decode_workload_cpu_smoke(bench, monkeypatch, kv, want):
+    """BENCH_WORKLOAD=decode end-to-end at toy shapes: the serving
+    tokens/sec + MBU workload must produce a well-formed result (MHA
+    and GQA variants) without hardware."""
+    monkeypatch.setenv("BENCH_DECODE_KV", str(kv))
+    r = bench._run_decode(on_accel=False)
+    assert r["metric"] == want + "_tokens_per_sec_1chip_cpufallback"
+    assert r["value"] > 0 and r["unit"] == "tokens/sec"
+    assert r["vs_baseline"] is None and r["mbu"] is None  # CPU: no MBU
+    assert r["kv_heads"] == (kv or 4)
+    assert r["bytes_per_step"] > 0 and r["calls"] == 1
+    # GQA shrinks the cache term but never the param read.
+    if kv:
+        assert r["params"] < 60_000  # k/v projections shrank
+
+
+def test_decode_prefix_roundtrip(bench, monkeypatch):
+    """_latest_logged_tpu('decode') must find decode entries, never
+    cross-match the lm training prefix, and never let the MHA and GQA
+    decode variants stand in for each other (the paired watcher stages
+    exist to CONTRAST them)."""
+    bench._log_tpu_result({"metric": "lm_12L_flash_bf16_train_tokens_per_sec_1chip",
+                           "value": 1.0})
+    bench._log_tpu_result({"metric": "decode_12L_bf16_tokens_per_sec_1chip",
+                           "value": 2.0})
+    bench._log_tpu_result({"metric": "decode_12L_gqa4_bf16_tokens_per_sec_1chip",
+                           "value": 3.0})
+    monkeypatch.delenv("BENCH_DECODE_KV", raising=False)
+    assert bench._latest_logged_tpu("decode")["value"] == 2.0  # MHA only
+    assert bench._latest_logged_tpu("lm")["value"] == 1.0
+    monkeypatch.setenv("BENCH_DECODE_KV", "4")
+    assert bench._latest_logged_tpu("decode")["value"] == 3.0  # GQA only
+    monkeypatch.setenv("BENCH_DECODE_KV", "8")
+    assert bench._latest_logged_tpu("decode") is None  # no gqa8 entry
+
+
 def test_committed_log_is_valid_and_has_tpu_entry():
     """The repo-root log must stay parseable — the fallback path and the
     judge both read it."""

@@ -182,10 +182,12 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
     target (same tools), so the two evidence paths can't drift."""
     mk = open(os.path.join(_REPO, "Makefile")).read()
     joined = " ".join(
-        " ".join(s["cmd"]) + " " + " ".join(s.get("env", {}).values())
+        " ".join(s["cmd"]) + " "
+        + " ".join(f"{k}={v}" for k, v in s.get("env", {}).items())
         for s in watcher_mod.DEFAULT_STAGES
     )
     for tool in ("bench.py", "bench_attention.py", "roofline_resnet.py",
-                 "inject_error.py", "lm", "inception"):
+                 "inject_error.py", "lm", "decode", "BENCH_DECODE_KV",
+                 "inception"):
         assert tool in joined, tool
         assert tool in mk
