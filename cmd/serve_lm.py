@@ -196,10 +196,11 @@ def make_handler(run, args):
                 # One generate per prompt, padded to its power-of-two
                 # BUCKET with the true length passed as a traced scalar:
                 # compile cache stays ~log2(max_prompt_len)*2 entries,
-                # and generate()'s teacher-forcing cutoff keeps pad
-                # tokens out of the KV cache entirely.  The model runs
-                # the server-pinned max_new_tokens; the response is
-                # sliced to the (capped) requested amount.
+                # and generate()'s prefill pad-safety invariant (causal
+                # mask + cache-cursor rewind to prompt_len) keeps pads
+                # from ever influencing the continuation.  The model
+                # runs the server-pinned max_new_tokens; the response
+                # is sliced to the (capped) requested amount.
                 t0 = time.perf_counter()
                 toks = []
                 for i, p in enumerate(prompts):

@@ -2,11 +2,20 @@
 
 Serving-side counterpart of models/lm_train.py: the model is rebuilt
 with ``decode=True`` so attention appends to fixed-length cache
-variables, and one jitted single-token step is scanned over the target
-length — prompt tokens teacher-forced, the rest sampled (greedy at
-``temperature=0``, categorical otherwise).  The scan keeps the whole
-loop on-device: no per-token host round-trips, static shapes
-throughout, one compile for any prompt of the same padded length.
+variables.  Generation is two-phase, the shape TPU serving wants:
+
+1. **Batched prefill** — ONE forward over the whole (padded) prompt
+   fills every layer's KV cache and yields the first next-token
+   logits.  This is MXU-dense work (prompt-length matmuls), replacing
+   the prompt-length chain of single-token steps a naive decode loop
+   would serialize.
+2. **Decode scan** — one jitted single-token step scanned over the
+   remaining ``max_new_tokens - 1`` positions (greedy at
+   ``temperature=0``, categorical otherwise).
+
+The scan keeps the whole loop on-device: no per-token host
+round-trips, static shapes throughout, one compile for any prompt of
+the same padded length.
 """
 
 from typing import Optional
@@ -28,6 +37,25 @@ def init_cache(model, batch: int, max_len: int):
     )
 
 
+def _rewind_cache_index(cache, position):
+    """Set every layer's ``cache_index`` to ``position`` (traced ok).
+
+    After a prefill over a PADDED prompt the write cursor sits past the
+    pad slots; rewinding it to the true prompt length makes decode
+    overwrite those slots in order, and the visibility mask (key slot
+    <= query position) hides any slot not yet overwritten — so pads
+    never influence the continuation.
+    """
+    def rewind(path, leaf):
+        if path and getattr(path[-1], "key", None) == "cache_index":
+            return jnp.zeros_like(leaf) + jnp.asarray(
+                position, leaf.dtype
+            )
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(rewind, cache)
+
+
 def generate(
     model,
     params,
@@ -47,13 +75,20 @@ def generate(
 
     ``prompt_len`` (optional, may be a TRACED scalar) is the number of
     leading ``prompt`` tokens that are real; the rest of the prompt
-    array is free padding that never enters the computation — teacher
-    forcing stops at ``prompt_len`` and the model generates its own
-    continuation from there.  This is the seam that lets a server
-    bucket prompt lengths (pad to a power of two) without a compile per
-    exact length AND without pad tokens ever reaching the KV cache:
-    every token fed is either real prompt or previously generated.
-    Defaults to the full (static) prompt width.
+    array is bucket padding.  Pad K/V do land in cache slots during the
+    batched prefill, but they are dead on arrival: the causal mask
+    keeps them out of every real prompt position's attention, the write
+    cursor is rewound to ``prompt_len`` so decode overwrites them in
+    order, and slots beyond the current position are always masked.
+    This is the seam that lets a server bucket prompt lengths (pad to a
+    power of two) with one compile per bucket and numerics identical to
+    the exact-length call.
+
+    Output layout: positions ``[0, prompt_len)`` echo the real prompt,
+    ``[prompt_len, prompt_len + max_new_tokens)`` are generated.  With
+    bucket padding (``prompt_len < P``) the tail beyond that range is
+    meaningless — consumers slice ``[:, :prompt_len + max_new_tokens]``
+    (cmd/serve_lm.py does).
     """
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
@@ -64,41 +99,57 @@ def generate(
     max_len = plen + max_new_tokens
     cache = init_cache(model, b, max_len)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    padded_prompt = prompt
 
-    def step(carry, i):
+    def sample_from(nxt_logits, rng):
+        if greedy:
+            return jnp.argmax(nxt_logits, axis=-1).astype(prompt.dtype), rng
+        rng, sub = jax.random.split(rng)
+        tok = jax.random.categorical(sub, nxt_logits / temperature)
+        return tok.astype(prompt.dtype), rng
+
+    # Phase 1: batched prefill — one MXU-dense forward over the padded
+    # prompt writes all prompt K/V.  Only the LAST real position's
+    # logits are needed, so skip the model's B*T*vocab LM-head
+    # (project=False), gather that one hidden row, and project it here
+    # with the model's exact tied-weights dtype rules (bf16 operands,
+    # f32 accumulation — transformer.py TransformerLM.__call__).
+    hidden, mutated = model.apply(
+        {"params": params, "cache": cache},
+        prompt,
+        positions=jnp.arange(plen),
+        mutable=["cache"],
+        project=False,
+    )
+    cache = _rewind_cache_index(mutated["cache"], prompt_len)
+    h_last = jax.lax.dynamic_index_in_dim(
+        hidden, jnp.maximum(prompt_len - 1, 0), axis=1, keepdims=False
+    )
+    emb = params["embed"]["embedding"]
+    last = jnp.dot(
+        h_last, emb.T.astype(h_last.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    tok0, rng = sample_from(last, rng)
+
+    # Phase 2: decode scan over the remaining max_new_tokens - 1 steps.
+    def step(carry, pos):
         cache, tok, rng = carry
-        logits, mutated = model.apply(
+        step_logits, mutated = model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
-            positions=jnp.full((1,), i, jnp.int32),
+            positions=jnp.full((1,), pos, jnp.int32),
             mutable=["cache"],
         )
-        nxt_logits = logits[:, 0, :]
-        if greedy:
-            sampled = jnp.argmax(nxt_logits, axis=-1)
-        else:
-            rng, sub = jax.random.split(rng)
-            sampled = jax.random.categorical(sub, nxt_logits / temperature)
-        sampled = sampled.astype(prompt.dtype)
-        # Teacher-force while still inside the (possibly traced-length)
-        # prompt; the index clamp keeps the gather in-bounds — the
-        # gathered value is unused once past prompt_len.
-        in_prompt = i + 1 < prompt_len
-        nxt = jnp.where(
-            in_prompt,
-            jax.lax.dynamic_index_in_dim(
-                padded_prompt, jnp.minimum(i + 1, plen - 1), axis=1,
-                keepdims=False,
-            ),
-            sampled,
-        )
+        nxt, rng = sample_from(step_logits[:, 0, :], rng)
         return (mutated["cache"], nxt, rng), nxt
 
-    (cache, _, _), toks = jax.lax.scan(
-        step,
-        (cache, prompt[:, 0], rng),
-        jnp.arange(max_len - 1),
+    # Step j feeds the token at output position prompt_len + j (tok0
+    # first), so the scan covers max_new_tokens - 1 further positions.
+    positions = prompt_len + jnp.arange(max_new_tokens - 1, dtype=jnp.int32)
+    (_, _, _), rest = jax.lax.scan(step, (cache, tok0, rng), positions)
+    gen = jnp.concatenate([tok0[:, None], rest.transpose(1, 0)], axis=1)
+
+    out = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1
     )
-    # toks[i] is the token at position i+1.
-    return jnp.concatenate([prompt[:, :1], toks.transpose(1, 0)], axis=1)
+    return jax.lax.dynamic_update_slice(out, gen, (0, prompt_len))

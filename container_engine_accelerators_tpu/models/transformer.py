@@ -315,7 +315,8 @@ class TransformerLM(nn.Module):
     remat: bool = True  # rematerialize blocks in backward (saves HBM)
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = True):
+    def __call__(self, tokens, positions=None, train: bool = True,
+                 project: bool = True):
         del train  # no dropout: demo parity with the reference trainers
         if positions is None:
             positions = jnp.arange(tokens.shape[1])
@@ -370,6 +371,13 @@ class TransformerLM(nn.Module):
             # mutable=["losses"] (lm_train adds it to the CE loss).
             self.sow("losses", "moe_aux", jnp.sum(layer_aux))
         x = RMSNorm(dtype=self.dtype, name="ln_f")(x)
+        if not project:
+            # Pre-projection hidden states: callers that consume only a
+            # few positions (batched prefill gathers ONE row) skip the
+            # B*T*vocab LM-head matmul and project the gathered rows
+            # themselves against params["embed"]["embedding"] with the
+            # same dtype rules as below (models/generate.py does).
+            return x
         # Final projection with TRUE f32 logits for a numerically stable
         # softmax loss: Embed.attend would promote the query back to the
         # module dtype (bf16), so tie the weights manually.  Operands stay

@@ -234,7 +234,8 @@ def test_decode_workload_cpu_smoke(bench, monkeypatch, kv, want):
     r = bench._run_decode(on_accel=False)
     assert r["metric"] == want + "_tokens_per_sec_1chip_cpufallback"
     assert r["value"] > 0 and r["unit"] == "tokens/sec"
-    assert r["vs_baseline"] is None and r["mbu"] is None  # CPU: no MBU
+    # CPU: no roofline fraction (the tables are per-TPU-generation).
+    assert r["vs_baseline"] is None and r["roofline_util"] is None
     assert r["kv_heads"] == (kv or 4)
     assert r["bytes_per_step"] > 0 and r["calls"] == 1
     # GQA shrinks the cache term but never the param read.
