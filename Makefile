@@ -57,9 +57,10 @@ presubmit:
 .PHONY: bench-hw
 bench-hw:
 	-python bench.py
+	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=f32 python bench.py
+	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=4 BENCH_DECODE_WEIGHTS=f32 python bench.py
+	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=int8 python bench.py
 	-BENCH_WORKLOAD=lm python bench.py
-	-BENCH_WORKLOAD=decode python bench.py
-	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=4 python bench.py
 	-BENCH_WORKLOAD=inception python bench.py
 	-python cmd/bench_attention.py --seq 4096 --check
 	-python cmd/roofline_resnet.py --batches 128,256,512

@@ -438,6 +438,7 @@ def _run_decode(on_accel: bool):
     )
     layers = int(os.environ.get("BENCH_LM_LAYERS", "12" if on_accel else "2"))
     kv = int(os.environ.get("BENCH_DECODE_KV", "0"))
+    weights = os.environ.get("BENCH_DECODE_WEIGHTS", "f32")
     calls = int(os.environ.get("BENCH_STEPS", "3" if on_accel else "1"))
     heads, head_dim = (16, 64) if on_accel else (4, 8)
     vocab = 32_768 if on_accel else 128
@@ -454,8 +455,12 @@ def _run_decode(on_accel: bool):
         transformer_lm(**lm_kw), jax.random.PRNGKey(0),
         jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
     )
-    params = state.params
-    model = transformer_lm(**lm_kw, decode=True)
+    from container_engine_accelerators_tpu.models.quant import (
+        serving_params,
+    )
+
+    params = serving_params(state.params, weights)
+    model = transformer_lm(**lm_kw, decode=True, quant=weights == "int8")
     run = jax.jit(lambda p: generate(model, params, p, new_tokens))
 
     # Nonce-seeded prompts, one per timed call (identical dispatches
@@ -517,8 +522,9 @@ def _run_decode(on_accel: bool):
 
     suffix = "" if on_accel else "_cpufallback"
     gqa = f"_gqa{kv}" if kv else ""
+    wtag = f"_w{weights}" if weights != "f32" else ""
     return {
-        "metric": f"decode_{layers}L{gqa}_bf16_tokens_per_sec_1chip"
+        "metric": f"decode_{layers}L{gqa}{wtag}_bf16_tokens_per_sec_1chip"
         + suffix,
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/sec",
@@ -576,13 +582,15 @@ def _latest_logged_tpu(workload: str):
         return None
     prefix = {"lm": "lm_", "inception": "inception",
               "decode": "decode_"}.get(workload, "resnet")
-    # The decode workload has MHA and GQA variants distinguished only
-    # by BENCH_DECODE_KV; their entries must not stand in for each
-    # other (the paired watcher stages exist to CONTRAST them).
-    gqa_tag = None
+    # The decode workload has MHA/GQA and weight-precision variants
+    # distinguished only by env; their entries must not stand in for
+    # each other (the paired watcher stages exist to CONTRAST them).
+    gqa_tag = wtag = None
     if workload == "decode":
         kv = int(os.environ.get("BENCH_DECODE_KV", "0"))
         gqa_tag = f"_gqa{kv}_" if kv else ""
+        w = os.environ.get("BENCH_DECODE_WEIGHTS", "f32")
+        wtag = f"_w{w}_" if w != "f32" else ""
     for line in reversed(lines):
         line = line.strip()
         if not line:
@@ -597,6 +605,11 @@ def _latest_logged_tpu(workload: str):
         if gqa_tag is not None and (
             (gqa_tag and gqa_tag not in metric)
             or (not gqa_tag and "_gqa" in metric)
+        ):
+            continue
+        if wtag is not None and (
+            (wtag and wtag not in metric)
+            or (not wtag and "_w" in metric)
         ):
             continue
         return entry

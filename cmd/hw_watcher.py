@@ -65,13 +65,27 @@ _BENCH_STAGE_TIMEOUT = 4200
 DEFAULT_STAGES = [
     {"name": "bench_resnet", "cmd": [sys.executable, "bench.py"],
      "timeout": _BENCH_STAGE_TIMEOUT},
+    # Cheap stages right after the path validator: the decode stages
+    # compile small graphs and time seconds of work, so even a short
+    # tunnel window converts into several distinct measurements before
+    # the compile-heavy LM train stage gets its turn.  Each stage pins
+    # BOTH decode knobs — stage env merges over os.environ, and an
+    # inherited BENCH_DECODE_* would silently turn the f32/GQA/int8
+    # contrast into three copies of one variant.
+    {"name": "bench_decode", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "0",
+             "BENCH_DECODE_WEIGHTS": "f32"},
+     "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_decode_gqa", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "4",
+             "BENCH_DECODE_WEIGHTS": "f32"},
+     "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_decode_int8", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "0",
+             "BENCH_DECODE_WEIGHTS": "int8"},
+     "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "bench_lm", "cmd": [sys.executable, "bench.py"],
      "env": {"BENCH_WORKLOAD": "lm"}, "timeout": _BENCH_STAGE_TIMEOUT},
-    {"name": "bench_decode", "cmd": [sys.executable, "bench.py"],
-     "env": {"BENCH_WORKLOAD": "decode"}, "timeout": _BENCH_STAGE_TIMEOUT},
-    {"name": "bench_decode_gqa", "cmd": [sys.executable, "bench.py"],
-     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "4"},
-     "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "flash_vs_xla",
      "cmd": [sys.executable, "cmd/bench_attention.py", "--seq", "4096",
              "--check"],

@@ -39,6 +39,11 @@ def parse_args(argv=None):
     p.add_argument("--kv-heads", type=int, default=0,
                    help="GQA KV heads (0 = MHA); shrinks the KV cache "
                         "and the per-token HBM read by heads/kv-heads")
+    p.add_argument("--weights", choices=("f32", "bf16", "int8"),
+                   default="f32",
+                   help="serving weight precision (models/quant.py): "
+                        "bf16 halves, int8 quarters the per-token "
+                        "parameter HBM read")
     p.add_argument("--max-prompt-len", type=int, default=64,
                    help="longest accepted prompt; prompts are padded to "
                         "power-of-two buckets, so ~log2 of this many "
@@ -103,7 +108,16 @@ def build_generate(args):
     else:
         log.info("serving randomly-initialized params (demo mode)")
 
-    decode_model = transformer_lm(**cfg, decode=True)
+    if args.weights != "f32":
+        from container_engine_accelerators_tpu.models.quant import (
+            serving_params,
+        )
+
+        params = serving_params(params, args.weights)
+        log.info("serving weights cast to %s", args.weights)
+    decode_model = transformer_lm(
+        **cfg, decode=True, quant=args.weights == "int8"
+    )
 
     if args.tp > 1:
         # Megatron-style tensor parallelism for serving: params sharded

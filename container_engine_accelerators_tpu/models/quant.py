@@ -1,0 +1,165 @@
+"""Weight quantization for serving: int8 kernels, f32 scales.
+
+Decode reads the whole parameter set from HBM for every generated
+token, so weight precision is a first-order tokens/sec lever on TPU —
+the param-traffic twin of GQA's cache-traffic lever:
+
+- ``cast_floats(params, bf16)``: 2x less traffic than f32, numerics
+  near-identical (the compute path already runs bf16).
+- ``quantize_params(params)``: 4x less traffic — per-output-channel
+  symmetric int8 for every attention/MLP kernel, dequantized inside
+  the matmul (XLA fuses the int8 load + scale into the operand read,
+  so the stored int8 array is what crosses HBM).
+
+The reference has no quantization story (its serving demo is a stock
+TF-Serving pod, demo/serving/tensorflow-serving.yaml); this is
+TPU-first serving design, validated hardware-free by an exactness
+test: the quantized model must produce token-identical greedy decodes
+to a float model loaded with the DEQUANTIZED weights.
+
+Embedding table and RMSNorm scales stay float (the embed read is a
+per-token row gather, and norm scales are vectors — neither is a
+traffic term); MoE expert FFNs keep their own float path.
+"""
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def _flatten_axes(shape, axis):
+    """Normalize DenseGeneral-style ``axis`` to a tuple of positive dims."""
+    if isinstance(axis, int):
+        axis = (axis,)
+    return tuple(a % len(shape) for a in axis)
+
+
+def quantize_kernel(
+    w: jax.Array, contract_axes: Sequence[int]
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-output-channel symmetric int8 quantization.
+
+    ``contract_axes`` are the kernel's contraction (input) dims —
+    explicit rather than positional, because kernels stacked by
+    ``nn.scan`` carry a leading layer axis that must NOT be reduced.
+    One f32 scale per remaining (layer x output) channel:
+    ``scale = max|w| / 127`` over the contraction dims.
+    """
+    w = jnp.asarray(w, jnp.float32)
+    contract_axes = tuple(contract_axes)
+    amax = jnp.max(jnp.abs(w), axis=contract_axes, keepdims=True)
+    scale_k = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale_k), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale_k, contract_axes).astype(jnp.float32)
+
+
+def dequantize_kernel(
+    q: jax.Array, scale: jax.Array, contract_axes: Sequence[int]
+) -> jax.Array:
+    """f32 kernel carrying exactly the values the quantized matmul uses."""
+    return q.astype(jnp.float32) * jnp.expand_dims(
+        scale, tuple(contract_axes)
+    )
+
+
+class QDenseGeneral(nn.Module):
+    """Drop-in for ``nn.DenseGeneral(use_bias=False)`` with int8 kernel.
+
+    Declares ``kernel_q`` (int8, the float kernel's shape) and
+    ``scale`` (f32, one per output channel) and contracts exactly as
+    DenseGeneral does: dequantize in f32, cast to the compute dtype,
+    ``lax.dot_general`` over ``axis``.  Parameters are produced by
+    :func:`quantize_params` from a trained float tree — this module's
+    own initializer exists only to give ``init`` the right shapes.
+    """
+
+    features: Union[int, Sequence[int]]
+    axis: Union[int, Sequence[int]] = -1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        features = (
+            (self.features,) if isinstance(self.features, int)
+            else tuple(self.features)
+        )
+        axis = _flatten_axes(x.shape, self.axis)
+        in_dims = tuple(x.shape[a] for a in axis)
+        kernel_shape = in_dims + features
+        kernel_q = self.param(
+            "kernel_q",
+            lambda _, s: jnp.zeros(s, jnp.int8), kernel_shape,
+        )
+        scale = self.param(
+            "scale", lambda _, s: jnp.ones(s, jnp.float32), features
+        )
+        w = dequantize_kernel(
+            kernel_q, scale, range(len(axis))
+        ).astype(self.dtype)
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), w,
+            ((axis, tuple(range(len(axis)))), ((), ())),
+        )
+        return y
+
+
+def quantize_params(params) -> Any:
+    """Trained float param tree -> the matching ``quant=True`` tree.
+
+    Every module dict holding a ``kernel`` (attention q/k/v/out, MLP
+    gate/up/down) becomes ``{kernel_q, scale}``; everything else
+    (embed, norms, MoE experts) passes through unchanged.  Contraction
+    dims are identified the way the model declares them — the ``out``
+    projection contracts its (heads, head_dim) pair, every other
+    kernel its first module-level dim — offset by one inside the
+    ``blocks`` scan stack, whose kernels carry a leading layer axis.
+    """
+    def walk(tree, name="", stacked=False):
+        if not isinstance(tree, dict):
+            return tree
+        if name == "moe":
+            return tree  # MoE expert FFNs keep their float path
+        stacked = stacked or name == "blocks"
+        if "kernel" in tree and len(tree) == 1:
+            w = tree["kernel"]
+            off = 1 if stacked else 0
+            n = 2 if name == "out" else 1
+            q, scale = quantize_kernel(w, range(off, off + n))
+            return {"kernel_q": q, "scale": scale}
+        return {k: walk(v, k, stacked) for k, v in tree.items()}
+
+    return walk(params)
+
+
+def cast_floats(tree, dtype=jnp.bfloat16):
+    """Cast float leaves (f32/f64) to ``dtype``; ints pass through."""
+    def cast(x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return jnp.asarray(x, dtype)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+def serving_params(params, weights: str):
+    """Trained params -> serving weights (``f32`` | ``bf16`` | ``int8``).
+
+    ``int8`` quantizes every kernel (scales stay f32) and carries the
+    rest — embed, norms — in bf16; pair it with a model built with
+    ``quant=True``.
+    """
+    if weights == "f32":
+        return params
+    if weights == "bf16":
+        return cast_floats(params)
+    if weights == "int8":
+        return quantize_params(cast_floats(params))
+    raise ValueError(f"unknown weights mode {weights!r}")
+
+
+def param_bytes(tree) -> int:
+    return sum(
+        x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree)
+    )

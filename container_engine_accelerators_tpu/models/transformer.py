@@ -67,6 +67,19 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
+def _dense_factory(quant: bool, dtype):
+    """Projection-module factory: nn.DenseGeneral, or the int8
+    QDenseGeneral when serving quantized weights (models/quant.py).
+    One seam so the quant wiring can't diverge between sublayers."""
+    if quant:
+        from container_engine_accelerators_tpu.models.quant import (
+            QDenseGeneral,
+        )
+
+        return functools.partial(QDenseGeneral, dtype=dtype)
+    return functools.partial(nn.DenseGeneral, use_bias=False, dtype=dtype)
+
+
 class Attention(nn.Module):
     num_heads: int
     head_dim: int
@@ -81,12 +94,13 @@ class Attention(nn.Module):
     # the cache read, so this is a direct tokens/sec and
     # max-context-length lever for serving.
     num_kv_heads: Optional[int] = None
+    # int8 kernels + f32 scales (models/quant.py): 4x less param HBM
+    # traffic per decoded token.  Params come from quantize_params().
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
-        dense = functools.partial(
-            nn.DenseGeneral, use_bias=False, dtype=self.dtype
-        )
+        dense = _dense_factory(self.quant, self.dtype)
         kv_heads = self.num_kv_heads or self.num_heads
         if self.num_heads % kv_heads:
             raise ValueError(
@@ -227,6 +241,7 @@ class Block(nn.Module):
     decode: bool = False
     num_experts: int = 0  # >0: MoE FFN (Switch top-1) instead of dense
     num_kv_heads: Optional[int] = None  # GQA (None = MHA)
+    quant: bool = False  # int8 kernels (models/quant.py)
 
     @nn.compact
     def __call__(self, x, positions):
@@ -240,6 +255,7 @@ class Block(nn.Module):
             self.use_flash,
             self.decode,
             num_kv_heads=self.num_kv_heads,
+            quant=self.quant,
             name="attn",
         )(y, positions)
         y = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
@@ -253,7 +269,9 @@ class Block(nn.Module):
                 name="moe",
             )(y)
             return x + out, aux
-        dense = functools.partial(nn.Dense, use_bias=False, dtype=self.dtype)
+        # nn.DenseGeneral with int features == nn.Dense (same kernel
+        # shape and param name), so the factory serves the MLP too.
+        dense = _dense_factory(self.quant, self.dtype)
         gate = dense(self.mlp_dim, name="gate")(y)
         up = dense(self.mlp_dim, name="up")(y)
         x = x + dense(x.shape[-1], name="down")(nn.silu(gate) * up)
@@ -274,6 +292,7 @@ class _ScanBlock(nn.Module):
     decode: bool
     num_experts: int = 0
     num_kv_heads: Optional[int] = None
+    quant: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -288,6 +307,7 @@ class _ScanBlock(nn.Module):
             self.decode,
             self.num_experts,
             num_kv_heads=self.num_kv_heads,
+            quant=self.quant,
             name="block",
         )(x, positions)
         return x, aux
@@ -312,6 +332,7 @@ class TransformerLM(nn.Module):
     decode: bool = False
     num_experts: int = 0  # >0: MoE-LM (Switch FFN in every block)
     num_kv_heads: Optional[int] = None  # GQA (None = MHA)
+    quant: bool = False  # int8 serving kernels (models/quant.py)
     remat: bool = True  # rematerialize blocks in backward (saves HBM)
 
     @nn.compact
@@ -338,6 +359,7 @@ class TransformerLM(nn.Module):
             self.decode,
             self.num_experts,
             self.num_kv_heads,
+            self.quant,
         )
         # Scan over a single stacked Block: compile time is O(1) in depth
         # instead of O(num_layers) — with a Python loop the 12-layer

@@ -224,13 +224,17 @@ def _pid_alive(pid: int) -> bool:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("kv,want", [(0, "decode_2L_bf16"),
-                                     (2, "decode_2L_gqa2_bf16")])
-def test_decode_workload_cpu_smoke(bench, monkeypatch, kv, want):
+@pytest.mark.parametrize("kv,weights,want", [
+    (0, "f32", "decode_2L_bf16"),
+    (2, "f32", "decode_2L_gqa2_bf16"),
+    (0, "int8", "decode_2L_wint8_bf16"),
+])
+def test_decode_workload_cpu_smoke(bench, monkeypatch, kv, weights, want):
     """BENCH_WORKLOAD=decode end-to-end at toy shapes: the serving
-    tokens/sec + MBU workload must produce a well-formed result (MHA
-    and GQA variants) without hardware."""
+    tokens/sec workload must produce a well-formed result (MHA, GQA,
+    and int8-weight variants) without hardware."""
     monkeypatch.setenv("BENCH_DECODE_KV", str(kv))
+    monkeypatch.setenv("BENCH_DECODE_WEIGHTS", weights)
     r = bench._run_decode(on_accel=False)
     assert r["metric"] == want + "_tokens_per_sec_1chip_cpufallback"
     assert r["value"] > 0 and r["unit"] == "tokens/sec"

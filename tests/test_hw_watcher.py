@@ -188,6 +188,6 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
     )
     for tool in ("bench.py", "bench_attention.py", "roofline_resnet.py",
                  "inject_error.py", "lm", "decode", "BENCH_DECODE_KV",
-                 "inception"):
+                 "BENCH_DECODE_WEIGHTS=int8", "inception"):
         assert tool in joined, tool
         assert tool in mk

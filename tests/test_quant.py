@@ -1,0 +1,130 @@
+"""int8 serving quantization (models/quant.py).
+
+The load-bearing property: the quantized model computes with EXACTLY
+``dequantize(kernel_q, scale)`` as its effective weights — so greedy
+decode from the quant model must be token-identical to the float model
+evaluated at those dequantized weights.  That pins the whole int8 path
+(param layout, contraction dims, dtype order) without needing a
+tolerance; closeness to the ORIGINAL float weights is then purely a
+quantization-error question, bounded separately.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from flax import linen as nn
+
+from container_engine_accelerators_tpu.models.generate import generate
+from container_engine_accelerators_tpu.models.lm_train import (
+    create_lm_train_state,
+)
+from container_engine_accelerators_tpu.models.quant import (
+    QDenseGeneral,
+    cast_floats,
+    dequantize_kernel,
+    param_bytes,
+    quantize_kernel,
+    quantize_params,
+)
+from container_engine_accelerators_tpu.models.transformer import (
+    transformer_lm,
+)
+
+CFG = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+           mlp_dim=32, num_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    state = create_lm_train_state(
+        transformer_lm(**CFG), jax.random.PRNGKey(7),
+        jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+    )
+    return state.params
+
+
+def _dequant_tree(tree, name="", stacked=False):
+    """Float tree whose kernels carry the quantized path's exact values
+    (same name/stack rules as quantize_params)."""
+    if not isinstance(tree, dict):
+        return tree
+    stacked = stacked or name == "blocks"
+    if set(tree) == {"kernel_q", "scale"}:
+        off = 1 if stacked else 0
+        n = 2 if name == "out" else 1
+        return {"kernel": dequantize_kernel(
+            tree["kernel_q"], tree["scale"], range(off, off + n)
+        )}
+    return {k: _dequant_tree(v, k, stacked) for k, v in tree.items()}
+
+
+def test_qdense_matches_densegeneral_on_dequantized_kernel():
+    """QDenseGeneral's contraction must equal nn.DenseGeneral evaluated
+    at the dequantized kernel, for both layouts the model uses."""
+    x3 = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 16))
+    x4 = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 4, 8))
+    for features, axis, x in (((4, 8), -1, x3), (16, (-2, -1), x4)):
+        ref = nn.DenseGeneral(features, axis=axis, use_bias=False,
+                              dtype=jnp.bfloat16)
+        fp = ref.init(jax.random.PRNGKey(2), x)["params"]
+        axes = range(1 if axis == -1 else 2)
+        q, scale = quantize_kernel(fp["kernel"], axes)
+        qmod = QDenseGeneral(features, axis=axis, dtype=jnp.bfloat16)
+        got = qmod.apply({"params": {"kernel_q": q, "scale": scale}}, x)
+        want = ref.apply(
+            {"params": {"kernel": dequantize_kernel(q, scale, axes)}}, x
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_quantized_greedy_decode_exact_vs_dequantized_float(params):
+    qparams = quantize_params(params)
+    prompt = jnp.asarray([[5, 17, 42], [88, 3, 9]], jnp.int32)
+    got = generate(transformer_lm(**CFG, decode=True, quant=True),
+                   qparams, prompt, 6)
+    want = generate(transformer_lm(**CFG, decode=True),
+                    _dequant_tree(qparams), prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_quantization_error_bounded(params):
+    """Round-trip error per weight <= scale/2 (symmetric rounding),
+    scale per (layer, head, head_dim) channel — never reduced over the
+    scan's layer axis."""
+    qparams = quantize_params(params)
+    w = params["blocks"]["block"]["attn"]["q"]["kernel"]
+    qd = qparams["blocks"]["block"]["attn"]["q"]
+    assert qd["scale"].shape == (w.shape[0],) + w.shape[2:]  # [L, h, d]
+    back = dequantize_kernel(qd["kernel_q"], qd["scale"], (1,))
+    err = np.abs(np.asarray(w, np.float32) - np.asarray(back))
+    bound = np.asarray(jnp.expand_dims(qd["scale"], 1)) / 2 + 1e-7
+    assert (err <= bound).all()
+    assert qd["kernel_q"].dtype == jnp.int8
+
+
+def test_param_bytes_shrink(params):
+    """Every kernel drops to int8 + a per-channel scale vector; at this
+    toy size the float embed dominates, so assert the kernels
+    specifically and the bf16 cast globally."""
+    qparams = quantize_params(params)
+    orig = param_bytes(params["blocks"])
+    quant = param_bytes(qparams["blocks"])
+    assert quant < 0.35 * orig  # f32 kernels -> int8 + small scales
+    assert param_bytes(cast_floats(params)) == pytest.approx(
+        param_bytes(params) / 2, rel=0.01
+    )
+
+
+def test_bf16_cast_decode_close_to_f32(params):
+    """bf16 weights: same greedy tokens on a short horizon (serving's
+    default deployment cast)."""
+    prompt = jnp.asarray([[5, 17, 42]], jnp.int32)
+    a = generate(transformer_lm(**CFG, decode=True), params, prompt, 4)
+    b = generate(transformer_lm(**CFG, decode=True), cast_floats(params),
+                 prompt, 4)
+    # bf16 rounding can flip near-tie argmaxes; require agreement on
+    # the first generated token and full shape validity.
+    assert np.asarray(a)[0, 3] == np.asarray(b)[0, 3]
+    assert b.shape == a.shape
