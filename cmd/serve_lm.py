@@ -53,6 +53,13 @@ def parse_args(argv=None):
                         "asking for more are capped, fewer are sliced)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint dir from cmd/train_lm.py")
+    p.add_argument("--slots", type=int, default=0,
+                   help="continuous batching: N decode lanes share one "
+                        "compiled step (models/batching.py); greedy "
+                        "requests join/leave mid-flight, sampled "
+                        "requests fall back to per-request generate. "
+                        "0 = per-request serving; incompatible with "
+                        "--tp > 1")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree: shard params Megatron-"
                         "style over this many local devices (decode "
@@ -145,13 +152,21 @@ def build_generate(args):
     # threads).  Temperature value, seed, and true prompt length are
     # traced operands; max_new_tokens is pinned to the server config.
     @functools.partial(jax.jit, static_argnums=(4,))
-    def run(prompt, prompt_len, temperature, seed, sample):
+    def _run(prompt, prompt_len, temperature, seed, sample):
         return generate(
             decode_model, params, prompt, args.max_new_tokens,
             temperature=temperature if sample else 0.0,
             rng=jax.random.PRNGKey(seed),
             prompt_len=prompt_len,
         )
+
+    def run(*a):
+        return _run(*a)
+
+    # The continuous-batching engine (main, --slots) reuses the exact
+    # model/params this closure serves.
+    run.decode_model = decode_model
+    run.params = params
 
     # Warm the compile cache for a representative shape.
     warm = bucket_len(1, args.max_prompt_len)
@@ -160,17 +175,16 @@ def build_generate(args):
     return run
 
 
-def bucket_len(n: int, cap: int) -> int:
-    """Smallest power of two >= n, capped at ``cap`` (the configured
-    max prompt length is always an allowed bucket even when it is not
-    itself a power of two)."""
-    b = 1
-    while b < n and b < cap:
-        b <<= 1
-    return min(b, cap)
+# Single definition shared with the continuous-batching engine — the
+# exactness contract between the two serving paths depends on them
+# bucketing identically.  (The configured max prompt length is always
+# an allowed bucket even when it is not itself a power of two.)
+from container_engine_accelerators_tpu.models.batching import (  # noqa: E402
+    bucket_len,
+)
 
 
-def make_handler(run, args):
+def make_handler(run, args, engine_loop=None):
     import jax.numpy as jnp
     import numpy as np
 
@@ -216,18 +230,30 @@ def make_handler(run, args):
                 # runs the server-pinned max_new_tokens; the response
                 # is sliced to the (capped) requested amount.
                 t0 = time.perf_counter()
-                toks = []
-                for i, p in enumerate(prompts):
-                    ids = [int(t) % args.vocab_size
-                           for t in p][: args.max_prompt_len] or [0]
-                    plen = len(ids)
-                    bucket = bucket_len(plen, args.max_prompt_len)
-                    padded = ids + [0] * (bucket - plen)
-                    out = np.asarray(run(
-                        jnp.asarray([padded], jnp.int32), plen,
-                        temperature, seed + i, temperature > 0,
-                    ))
-                    toks.append(out[0][: plen + max_new].tolist())
+                clean = [
+                    [int(t) % args.vocab_size
+                     for t in p][: args.max_prompt_len] or [0]
+                    for p in prompts
+                ]
+                if engine_loop is not None and temperature == 0:
+                    # Continuous batching: all of this request's
+                    # prompts join the shared decode fleet CONCURRENTLY
+                    # (greedy lanes only; sampling keeps the
+                    # per-request path below).
+                    outs = engine_loop.generate_many(clean, max_new)
+                    toks = [ids + gen[:max_new]
+                            for ids, gen in zip(clean, outs)]
+                else:
+                    toks = []
+                    for i, ids in enumerate(clean):
+                        plen = len(ids)
+                        bucket = bucket_len(plen, args.max_prompt_len)
+                        padded = ids + [0] * (bucket - plen)
+                        out = np.asarray(run(
+                            jnp.asarray([padded], jnp.int32), plen,
+                            temperature, seed + i, temperature > 0,
+                        ))
+                        toks.append(out[0][: plen + max_new].tolist())
                 dt = (time.perf_counter() - t0) * 1e3
                 self._send(200, {"tokens": toks,
                                  "latency_ms": round(dt, 2)})
@@ -242,9 +268,30 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(levelname)s %(message)s")
     args = parse_args(argv)
+    if args.slots and args.tp > 1:
+        raise SystemExit("--slots and --tp > 1 are mutually exclusive "
+                         "(the engine's cache is single-device)")
     run = build_generate(args)
+    engine_loop = None
+    if args.slots:
+        from container_engine_accelerators_tpu.models.batching import (
+            DecodeEngine,
+            EngineLoop,
+        )
+
+        engine = DecodeEngine(
+            run.decode_model, run.params, max_slots=args.slots,
+            max_len=bucket_len(args.max_prompt_len, args.max_prompt_len)
+            + args.max_new_tokens,
+        )
+        engine_loop = EngineLoop(engine)
+        # Warm the engine's prefill AND step compiles before taking
+        # traffic (max_new=2 so at least one fleet step runs; a 1-token
+        # request retires inside submit and never steps).
+        engine_loop.generate([0], 2)
+        log.info("continuous batching: %d decode slots", args.slots)
     server = ThreadingHTTPServer(("0.0.0.0", args.port),
-                                 make_handler(run, args))
+                                 make_handler(run, args, engine_loop))
     log.info("serving LM on :%d", server.server_address[1])
     server.serve_forever()
 

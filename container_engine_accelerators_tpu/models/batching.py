@@ -1,0 +1,260 @@
+"""Continuous-batching decode engine (slot-based serving).
+
+Per-request `generate()` leaves the chip idle between requests and
+pays each request's decode serially.  This engine keeps ONE compiled
+single-token step running over a fixed fleet of ``max_slots`` decode
+lanes; requests join a free slot mid-flight (batched MXU prefill, then
+their K/V lives in that slot's cache region) and leave when done — the
+TPU-idiomatic shape of vLLM-style continuous batching: static shapes,
+on-device state, no recompiles as traffic changes.
+
+The model hooks that make this possible (models/transformer.py):
+``cache_index`` is a per-sample vector with vmapped writes, and
+``positions`` may be [B, T] — every slot sits at its own depth in the
+same step.  Inactive slots still compute (static shapes) but their
+state is frozen and their lane is fully overwritten at the next
+insert, so garbage never leaks between requests.
+
+Greedy decode (the exactness-testable mode): the engine's interleaved
+output must be TOKEN-IDENTICAL to per-request ``generate()`` — pinned
+by tests/test_batching.py.
+
+The reference's serving story is a stock single-model TF-Serving pod
+scaled by an HPA on duty cycle (demo/serving/tensorflow-serving.yaml);
+this engine is the TPU-first replacement for the inner serving loop.
+"""
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from container_engine_accelerators_tpu.models.generate import (
+    _rewind_cache_index,
+    init_cache,
+    prefill,
+)
+
+
+def bucket_len(n: int, cap: int) -> int:
+    """Smallest power of two >= n, capped (one compile per bucket)."""
+    b = 1
+    while b < n and b < cap:
+        b *= 2
+    return min(b, cap)
+
+
+class DecodeEngine:
+    """Fixed-fleet continuous-batching decoder (greedy).
+
+    ``max_len`` is each slot's cache length: every request needs
+    ``bucket(prompt) <= max_len`` and ``prompt_len + max_new <= max_len``.
+    """
+
+    def __init__(self, model, params, max_slots: int, max_len: int,
+                 eos_id: Optional[int] = None):
+        if not model.decode:
+            raise ValueError("DecodeEngine needs a model with decode=True")
+        self.model, self.params = model, params
+        self.max_slots, self.max_len = max_slots, max_len
+        self.eos_id = eos_id
+
+        self.cache = init_cache(model, max_slots, max_len)
+        self.pos = jnp.zeros((max_slots,), jnp.int32)
+        self.last_tok = jnp.zeros((max_slots,), jnp.int32)
+        self.active = jnp.zeros((max_slots,), bool)
+
+        self._free = list(range(max_slots))
+        self._req: Dict[int, dict] = {}  # slot -> {id, tokens, remaining}
+        self._results: Dict[int, List[int]] = {}
+        self._next_id = 0
+
+        def _prefill(prompt, prompt_len):
+            cache, last = prefill(model, params, prompt, prompt_len,
+                                  self.max_len)
+            tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return cache, tok0
+
+        # jit caches one trace per prompt BUCKET width; insert and step
+        # trace once (slot index and cursors are traced operands).
+        self._prefill = jax.jit(_prefill)
+        self._insert_slot = jax.jit(self._insert_slot_impl)
+        self._step = jax.jit(self._step_impl)
+
+    # ---- jitted kernels -------------------------------------------------
+
+    def _insert_slot_impl(self, cache, pos, last_tok, active,
+                          slot_cache, tok0, slot, start_pos):
+        def put(full, one):
+            start = (0, slot) + (0,) * (full.ndim - 2)
+            return jax.lax.dynamic_update_slice(
+                full, one.astype(full.dtype), start
+            )
+
+        cache = jax.tree_util.tree_map(put, cache, slot_cache)
+        return (
+            cache,
+            pos.at[slot].set(start_pos),
+            last_tok.at[slot].set(tok0[0]),
+            active.at[slot].set(True),
+        )
+
+    def _step_impl(self, cache, pos, last_tok, active):
+        logits, mutated = self.model.apply(
+            {"params": self.params, "cache": cache},
+            last_tok[:, None],
+            positions=pos[:, None],
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        new_pos = jnp.where(active, pos + 1, pos)
+        new_tok = jnp.where(active, nxt, last_tok)
+        # The model advanced every slot's write cursor; re-pin it to the
+        # engine's per-slot positions so frozen (inactive) lanes stay
+        # frozen.  (Their garbage write this step lands inside their own
+        # lane, which the next insert overwrites wholesale.)
+        cache = _rewind_cache_index(mutated["cache"], new_pos)
+        return cache, new_pos, new_tok, nxt
+
+    # ---- host API -------------------------------------------------------
+
+    def submit(self, prompt_ids: List[int], max_new: int) -> int:
+        """Claim a free slot, prefill it, emit the first token.
+        Returns a request id; raises if the fleet is full."""
+        if not self._free:
+            raise RuntimeError("no free slot — step() until one drains")
+        plen = len(prompt_ids)
+        bucket = bucket_len(plen, self.max_len)
+        if plen > bucket or plen + max_new > self.max_len:
+            raise ValueError(
+                f"request needs {plen}+{max_new} tokens; slot holds "
+                f"{self.max_len}"
+            )
+        slot = self._free.pop()
+        prompt = jnp.asarray(
+            [list(prompt_ids) + [0] * (bucket - plen)], jnp.int32
+        )
+        slot_cache, tok0 = self._prefill(prompt, plen)
+        self.cache, self.pos, self.last_tok, self.active = (
+            self._insert_slot(self.cache, self.pos, self.last_tok,
+                              self.active, slot_cache, tok0, slot, plen)
+        )
+        rid = self._next_id
+        self._next_id += 1
+        first = int(tok0[0])
+        self._req[slot] = {"id": rid, "tokens": [first],
+                           "remaining": max_new - 1}
+        if self._req[slot]["remaining"] <= 0 or first == self.eos_id:
+            self._retire(slot)
+        return rid
+
+    def _retire(self, slot: int):
+        req = self._req.pop(slot)
+        self._results[req["id"]] = req["tokens"]
+        self.active = self.active.at[slot].set(False)
+        self._free.append(slot)
+
+    def step(self) -> int:
+        """One decode step for the whole fleet; returns live-slot count."""
+        if not self._req:
+            return 0
+        self.cache, self.pos, self.last_tok, nxt = self._step(
+            self.cache, self.pos, self.last_tok, self.active
+        )
+        tokens = np.asarray(nxt)
+        for slot in list(self._req):
+            req = self._req[slot]
+            tok = int(tokens[slot])
+            req["tokens"].append(tok)
+            req["remaining"] -= 1
+            if req["remaining"] <= 0 or tok == self.eos_id:
+                self._retire(slot)
+        return len(self._req)
+
+    def run_until_drained(self, max_steps: int = 100_000):
+        for _ in range(max_steps):
+            if self.step() == 0:
+                return
+        raise RuntimeError("engine did not drain")
+
+    def result(self, rid: int) -> Optional[List[int]]:
+        """Generated tokens (first token included) once finished."""
+        return self._results.get(rid)
+
+    def take_result(self, rid: int) -> Optional[List[int]]:
+        """Like :meth:`result` but removes the entry — long-running
+        servers must take, not peek, or finished requests accumulate
+        for the process lifetime."""
+        return self._results.pop(rid, None)
+
+
+class EngineLoop:
+    """Thread-safe request facade + background stepper for DecodeEngine.
+
+    HTTP handler threads call :meth:`generate`; one daemon thread steps
+    the fleet whenever any slot is live.  A single condition variable
+    serializes every engine mutation and doubles as the completion /
+    free-slot signal — the engine itself stays single-threaded.
+    """
+
+    def __init__(self, engine: DecodeEngine):
+        import threading
+
+        self.engine = engine
+        self.cond = threading.Condition()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self.cond:
+                while not self.engine._req:
+                    self.cond.wait()
+                self.engine.step()
+                self.cond.notify_all()
+
+    def generate(self, prompt_ids: List[int], max_new: int,
+                 timeout: float = 300.0) -> List[int]:
+        """Submit and block until done; returns the generated tokens."""
+        return self.generate_many([prompt_ids], max_new, timeout)[0]
+
+    def generate_many(self, prompts: List[List[int]], max_new: int,
+                      timeout: float = 300.0) -> List[List[int]]:
+        """Run several prompts CONCURRENTLY across the fleet.
+
+        Submits each prompt as soon as a slot frees (earlier prompts
+        keep decoding meanwhile) and returns all outputs in input
+        order — a k-prompt request on a k-slot engine costs ~one
+        request's wall clock, not k.
+        """
+        import time
+
+        deadline = time.monotonic() + timeout
+        rids: List[Optional[int]] = [None] * len(prompts)
+        outs: List[Optional[List[int]]] = [None] * len(prompts)
+        pending = set(range(len(prompts)))
+        unsubmitted = list(range(len(prompts)))
+        with self.cond:
+            while pending:
+                progressed = False
+                while unsubmitted and self.engine._free:
+                    i = unsubmitted.pop(0)
+                    rids[i] = self.engine.submit(prompts[i], max_new)
+                    progressed = True
+                if progressed:
+                    self.cond.notify_all()
+                for i in list(pending):
+                    if rids[i] is None:
+                        continue
+                    got = self.engine.take_result(rids[i])
+                    if got is not None:
+                        outs[i] = got
+                        pending.discard(i)
+                        progressed = True
+                if pending and not progressed:
+                    if not self.cond.wait(deadline - time.monotonic()):
+                        raise TimeoutError(
+                            "generation timed out or no free decode slot"
+                        )
+        return outs

@@ -56,6 +56,38 @@ def _rewind_cache_index(cache, position):
     return jax.tree_util.tree_map_with_path(rewind, cache)
 
 
+def prefill(model, params, prompt: jax.Array, prompt_len, max_len: int):
+    """Batched prefill -> (cache cued at ``prompt_len``, last logits).
+
+    One MXU-dense forward over the (padded) ``prompt`` [B, P] writes
+    every layer's K/V into a fresh ``max_len``-token cache; the write
+    cursor is rewound to ``prompt_len`` (traced ok) and only the last
+    real position's hidden row is projected to logits — the model's
+    B*P*vocab LM-head matmul is skipped (``project=False``).  Shared
+    by :func:`generate` and the continuous-batching engine
+    (models/batching.py).
+    """
+    b, plen = prompt.shape
+    cache = init_cache(model, b, max_len)
+    hidden, mutated = model.apply(
+        {"params": params, "cache": cache},
+        prompt,
+        positions=jnp.arange(plen),
+        mutable=["cache"],
+        project=False,
+    )
+    cache = _rewind_cache_index(mutated["cache"], prompt_len)
+    h_last = jax.lax.dynamic_index_in_dim(
+        hidden, jnp.maximum(prompt_len - 1, 0), axis=1, keepdims=False
+    )
+    emb = params["embed"]["embedding"]
+    last = jnp.dot(
+        h_last, emb.T.astype(h_last.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return cache, last
+
+
 def generate(
     model,
     params,
@@ -97,7 +129,6 @@ def generate(
     if prompt_len is None:
         prompt_len = plen
     max_len = plen + max_new_tokens
-    cache = init_cache(model, b, max_len)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def sample_from(nxt_logits, rng):
@@ -107,28 +138,8 @@ def generate(
         tok = jax.random.categorical(sub, nxt_logits / temperature)
         return tok.astype(prompt.dtype), rng
 
-    # Phase 1: batched prefill — one MXU-dense forward over the padded
-    # prompt writes all prompt K/V.  Only the LAST real position's
-    # logits are needed, so skip the model's B*T*vocab LM-head
-    # (project=False), gather that one hidden row, and project it here
-    # with the model's exact tied-weights dtype rules (bf16 operands,
-    # f32 accumulation — transformer.py TransformerLM.__call__).
-    hidden, mutated = model.apply(
-        {"params": params, "cache": cache},
-        prompt,
-        positions=jnp.arange(plen),
-        mutable=["cache"],
-        project=False,
-    )
-    cache = _rewind_cache_index(mutated["cache"], prompt_len)
-    h_last = jax.lax.dynamic_index_in_dim(
-        hidden, jnp.maximum(prompt_len - 1, 0), axis=1, keepdims=False
-    )
-    emb = params["embed"]["embedding"]
-    last = jnp.dot(
-        h_last, emb.T.astype(h_last.dtype),
-        preferred_element_type=jnp.float32,
-    )
+    # Phase 1: batched prefill (shared helper; see prefill()).
+    cache, last = prefill(model, params, prompt, prompt_len, max_len)
     tok0, rng = sample_from(last, rng)
 
     # Phase 2: decode scan over the remaining max_new_tokens - 1 steps.

@@ -41,17 +41,21 @@ def _on_tpu() -> bool:
 
 
 def rotary_embedding(x: jax.Array, positions: jax.Array) -> jax.Array:
-    """Apply RoPE to ``x`` [B, T, H, D] at absolute ``positions`` [T].
+    """Apply RoPE to ``x`` [B, T, H, D] at absolute ``positions``.
 
-    Positions are passed explicitly so sequence-parallel shards rotate
-    with their *global* offsets.
+    ``positions`` is [T] (shared across the batch — training, and
+    whole-batch generation) or [B, T] (per-sample — continuous-batching
+    decode, where every slot sits at its own depth).  Passed explicitly
+    so sequence-parallel shards rotate with their *global* offsets.
     """
     d = x.shape[-1]
     half = d // 2
     freq = 1.0 / (10000.0 ** (jnp.arange(half, dtype=jnp.float32) / half))
-    theta = positions[:, None].astype(jnp.float32) * freq[None, :]  # [T, half]
-    cos = jnp.cos(theta)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(theta)[None, :, None, :].astype(x.dtype)
+    theta = positions[..., None].astype(jnp.float32) * freq  # [..., T, half]
+    if positions.ndim == 1:
+        theta = theta[None]
+    cos = jnp.cos(theta)[:, :, None, :].astype(x.dtype)  # [B|1, T, 1, half]
+    sin = jnp.sin(theta)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
 
@@ -180,6 +184,12 @@ class Attention(nn.Module):
         score/value einsums group the query heads over them — the
         repeat is never materialized, so the HBM read per decoded
         token shrinks by the group factor.
+
+        The write cursor is PER SAMPLE (``cache_index`` [B]) and
+        ``positions`` may be [B, T]: continuous-batching serving steps
+        a fixed fleet of slots each sitting at its own depth.  Batched
+        single-sequence generation passes shared [T] positions and a
+        uniform cursor — the same code path.
         """
         b, t, h, d = q.shape
         kvh = k.shape[2]
@@ -192,20 +202,20 @@ class Attention(nn.Module):
             lambda: jnp.zeros((b, t, kvh, d), v.dtype),
         )
         cache_index = self.variable(
-            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            "cache", "cache_index", lambda: jnp.zeros((b,), jnp.int32)
         )
         if self.is_initializing():
             # init just shapes the cache to the full target length
             return jnp.zeros_like(q)
 
-        idx = cache_index.value
+        idx = cache_index.value  # [b]
         max_len = cached_k.value.shape[1]
-        cached_k.value = jax.lax.dynamic_update_slice(
-            cached_k.value, k, (0, idx, 0, 0)
-        )
-        cached_v.value = jax.lax.dynamic_update_slice(
-            cached_v.value, v, (0, idx, 0, 0)
-        )
+
+        def write(buf, new, i):
+            return jax.lax.dynamic_update_slice(buf, new, (i, 0, 0))
+
+        cached_k.value = jax.vmap(write)(cached_k.value, k, idx)
+        cached_v.value = jax.vmap(write)(cached_v.value, v, idx)
         cache_index.value = idx + t
 
         # Group query heads over the (possibly fewer) cached KV heads:
@@ -217,11 +227,12 @@ class Attention(nn.Module):
             "bqhgd,bkhd->bhgqk", qg * (self.head_dim**-0.5),
             cached_k.value, preferred_element_type=jnp.float32,
         )
-        # Key j is visible to query at global position p when j <= p;
-        # queries in this call sit at `positions` (shape [t]).
+        # Key slot j is visible to a query at global position p when
+        # j <= p; `positions` is [t] (shared) or [b, t] (per slot).
         key_pos = jnp.arange(max_len)
-        mask = key_pos[None, :] <= positions[:, None]  # [t, max_len]
-        s = jnp.where(mask[None, None, None], s, -1e30)
+        pos_bt = positions if positions.ndim == 2 else positions[None]
+        mask = key_pos[None, None, :] <= pos_bt[:, :, None]  # [b|1, t, L]
+        s = jnp.where(mask[:, None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum(
             "bhgqk,bkhd->bqhgd", p, cached_v.value,
