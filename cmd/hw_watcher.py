@@ -84,6 +84,12 @@ DEFAULT_STAGES = [
      "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "0",
              "BENCH_DECODE_WEIGHTS": "int8"},
      "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_serving",
+     "cmd": [sys.executable, "cmd/bench_serving.py", "--slots", "4",
+             "--requests", "12", "--max-new", "64", "--num-layers", "12",
+             "--num-heads", "16", "--head-dim", "64", "--mlp-dim", "4096",
+             "--vocab-size", "32768"],
+     "timeout": 1800},
     {"name": "bench_lm", "cmd": [sys.executable, "bench.py"],
      "env": {"BENCH_WORKLOAD": "lm"}, "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "flash_vs_xla",

@@ -16,8 +16,13 @@ state is frozen and their lane is fully overwritten at the next
 insert, so garbage never leaks between requests.
 
 Greedy decode (the exactness-testable mode): the engine's interleaved
-output must be TOKEN-IDENTICAL to per-request ``generate()`` — pinned
-by tests/test_batching.py.
+output is TOKEN-IDENTICAL to per-request ``generate()`` — pinned by
+tests/test_batching.py at the tested shapes.  One honest caveat: the
+fleet's [slots, 1, D] decode matmuls may tile differently from
+generate()'s [1, 1, D], and a bf16 argmax near-tie can flip on that
+rounding; prefill is batch-1 in both paths and always agrees exactly
+(cmd/bench_serving.py gates on that and reports the full-sequence
+agreement fraction).
 
 The reference's serving story is a stock single-model TF-Serving pod
 scaled by an HPA on duty cycle (demo/serving/tensorflow-serving.yaml);

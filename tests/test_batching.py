@@ -106,6 +106,33 @@ def test_bucket_len():
         [1, 2, 4, 8, 16, 16]
 
 
+@pytest.mark.slow
+def test_bench_serving_cli():
+    """cmd/bench_serving.py end-to-end at toy scale: both paths run,
+    prefill agreement gates, the JSON line is well-formed."""
+    import contextlib
+    import importlib.util
+    import io
+    import json as _json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_serving_cli", os.path.join(repo, "cmd", "bench_serving.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main(["--slots", "2", "--requests", "4", "--max-new", "6",
+                       "--prompt-lens", "3,5"])
+    assert rc == 0
+    line = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["metric"] == "serving_continuous_batching_ttft_speedup"
+    assert line["value"] > 0 and line["throughput_speedup"] > 0
+    assert 0.5 <= line["exact_match_fraction"] <= 1.0
+
+
 def test_engine_loop_concurrent_requests_match_solo(decode_model, params):
     """EngineLoop: more threads than slots, all blocking concurrently —
     every response must equal its solo generate(), and the fleet-full
