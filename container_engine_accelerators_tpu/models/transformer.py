@@ -253,6 +253,7 @@ class Block(nn.Module):
     num_experts: int = 0  # >0: MoE FFN (Switch top-1) instead of dense
     num_kv_heads: Optional[int] = None  # GQA (None = MHA)
     quant: bool = False  # int8 kernels (models/quant.py)
+    moe_capacity_factor: float = 1.25  # train-mode MoE capacity
 
     @nn.compact
     def __call__(self, x, positions):
@@ -277,6 +278,12 @@ class Block(nn.Module):
                 num_experts=self.num_experts,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
+                capacity_factor=self.moe_capacity_factor,
+                # Decode must route drop-free: train-style capacity
+                # depends on the token count, so single-token steps and
+                # the prefill would drop different tokens than a full
+                # forward and the KV-cache contract would break.
+                no_drop=self.decode,
                 name="moe",
             )(y)
             return x + out, aux
@@ -304,6 +311,7 @@ class _ScanBlock(nn.Module):
     num_experts: int = 0
     num_kv_heads: Optional[int] = None
     quant: bool = False
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, positions):
@@ -319,6 +327,7 @@ class _ScanBlock(nn.Module):
             self.num_experts,
             num_kv_heads=self.num_kv_heads,
             quant=self.quant,
+            moe_capacity_factor=self.moe_capacity_factor,
             name="block",
         )(x, positions)
         return x, aux
@@ -344,6 +353,7 @@ class TransformerLM(nn.Module):
     num_experts: int = 0  # >0: MoE-LM (Switch FFN in every block)
     num_kv_heads: Optional[int] = None  # GQA (None = MHA)
     quant: bool = False  # int8 serving kernels (models/quant.py)
+    moe_capacity_factor: float = 1.25  # train-mode MoE capacity
     remat: bool = True  # rematerialize blocks in backward (saves HBM)
 
     @nn.compact
@@ -371,6 +381,7 @@ class TransformerLM(nn.Module):
             self.num_experts,
             self.num_kv_heads,
             self.quant,
+            self.moe_capacity_factor,
         )
         # Scan over a single stacked Block: compile time is O(1) in depth
         # instead of O(num_layers) — with a Python loop the 12-layer

@@ -8,10 +8,13 @@ leading expert axis of the expert weights (``expert_sharding``) and XLA
 inserts the all-to-all that moves token slots to their experts — no
 hand-written collectives, same recipe as the sharding of ``mesh.py``.
 
-Capacity semantics: each expert processes at most
+Capacity semantics: in training, each expert processes at most
 ``ceil(capacity_factor * N / E)`` token slots; overflow tokens fall
 through the residual (their combine weight is zero), the standard
-Switch trade that keeps every shape static for XLA.
+Switch trade that keeps every shape static for XLA.  Inference/decode
+(``no_drop=True``, set by the decode path) routes every token —
+capacity = N — because capacity that depends on the token count would
+make single-token KV-cache steps drop differently than full forwards.
 
 The reference has no model-code analog (its scaling is infrastructure,
 SURVEY.md §2.3); this rounds out the parallelism layer's ep axis next
@@ -41,13 +44,22 @@ class MoEFFN(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     capacity_factor: float = 1.25
+    # Drop-free routing (capacity = N): inference/decode mode.  Train
+    # capacity depends on the token count, so a KV-cache decode step
+    # (N = batch) and a full forward (N = batch*T) would drop different
+    # tokens and diverge; serving routes every token instead — the
+    # decode path sets this (transformer.py Block).
+    no_drop: bool = False
 
     @nn.compact
     def __call__(self, x):
         *lead, d = x.shape
         n = math.prod(lead)
         e = self.num_experts
-        capacity = max(1, math.ceil(self.capacity_factor * n / e))
+        capacity = (
+            n if self.no_drop
+            else max(1, math.ceil(self.capacity_factor * n / e))
+        )
         flat = x.reshape(n, d)
 
         # Router (f32): top-1 expert and its gate probability.

@@ -133,3 +133,41 @@ def test_moe_lm_trains():
         placed, m = step(placed, toks, labels, mask)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_decode_matches_dropfree_train_forward():
+    """KV-cache decode of a MoE LM must equal iterated argmax of the
+    train-mode forward when BOTH route drop-free: decode always routes
+    every token (no_drop — a single-token step and a full forward would
+    otherwise drop different tokens), so the train reference gets
+    capacity_factor = num_experts (capacity >= N, no drops either)."""
+    import numpy as np
+    import optax
+
+    from container_engine_accelerators_tpu.models.generate import generate
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_experts=4)
+    state = create_lm_train_state(
+        transformer_lm(**cfg), jax.random.PRNGKey(3),
+        jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+    )
+    ref = transformer_lm(**cfg, moe_capacity_factor=4.0)
+    prompt = jnp.asarray([[5, 17, 42], [88, 3, 9]], jnp.int32)
+    toks = prompt
+    for _ in range(5):
+        logits = ref.apply(
+            {"params": state.params}, toks,
+            positions=jnp.arange(toks.shape[1]),
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    got = generate(transformer_lm(**cfg, decode=True), state.params,
+                   prompt, 5)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(toks))
