@@ -71,23 +71,40 @@ class MoEFFN(nn.Module):
         gate = jnp.max(probs, axis=-1)  # [N]
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # [N, E]
 
-        # Capacity: position of each token within its expert's queue;
-        # tokens past the capacity drop out of the combine (residual
-        # carries them).  cumsum keeps it a static-shape VPU op.
-        pos = jnp.einsum(
-            "ne,ne->n", onehot, jnp.cumsum(onehot, axis=0) - 1.0
-        ).astype(jnp.int32)
-        keep = pos < capacity
-        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [N, C]
-        dispatch = (
-            onehot[:, :, None] * pos_oh[:, None, :] * keep[:, None, None]
-        )  # [N, E, C]
+        if self.no_drop:
+            # Drop-free: no slot competition, so the slot index IS the
+            # token index — a LINEAR [E, N, D] dispatch (rows for
+            # non-routed experts are zero and their MLP output is
+            # zero).  The capacity form below would build quadratic
+            # [N, E, N] dispatch/combine tensors here for nothing.
+            dispatch = None
+            slots = jnp.einsum(
+                "ne,nd->end", onehot.astype(self.dtype),
+                flat.astype(self.dtype),
+            )  # [E, N, D]
+        else:
+            # Capacity: position of each token within its expert's
+            # queue; tokens past the capacity drop out of the combine
+            # (residual carries them).  cumsum keeps it a static-shape
+            # VPU op.
+            pos = jnp.einsum(
+                "ne,ne->n", onehot, jnp.cumsum(onehot, axis=0) - 1.0
+            ).astype(jnp.int32)
+            keep = pos < capacity
+            pos_oh = jax.nn.one_hot(
+                pos, capacity, dtype=jnp.float32
+            )  # [N, C]
+            dispatch = (
+                onehot[:, :, None] * pos_oh[:, None, :]
+                * keep[:, None, None]
+            )  # [N, E, C]
 
-        # Move token slots to experts: dense einsum; under expert-sharded
-        # weights GSPMD turns this into the all-to-all.
-        slots = jnp.einsum(
-            "nec,nd->ecd", dispatch.astype(self.dtype), flat.astype(self.dtype)
-        )  # [E, C, D]
+            # Move token slots to experts: dense einsum; under expert-
+            # sharded weights GSPMD turns this into the all-to-all.
+            slots = jnp.einsum(
+                "nec,nd->ecd", dispatch.astype(self.dtype),
+                flat.astype(self.dtype),
+            )  # [E, C, D]
 
         wi_gate = self.param(
             "wi_gate", nn.initializers.lecun_normal(batch_axis=(0,)),
@@ -108,10 +125,14 @@ class MoEFFN(nn.Module):
             "ech,ehd->ecd", h, wo.astype(self.dtype)
         )  # [E, C, D]
 
-        combine = dispatch * gate[:, None, None]  # [N, E, C]
-        out = jnp.einsum(
-            "nec,ecd->nd", combine.astype(self.dtype), out_slots
-        )
+        if self.no_drop:
+            combine = (onehot * gate[:, None]).astype(self.dtype)  # [N, E]
+            out = jnp.einsum("ne,end->nd", combine, out_slots)
+        else:
+            combine = dispatch * gate[:, None, None]  # [N, E, C]
+            out = jnp.einsum(
+                "nec,ecd->nd", combine.astype(self.dtype), out_slots
+            )
 
         # Switch load-balance aux loss (f32).
         frac_routed = jnp.mean(onehot, axis=0)  # [E]
