@@ -59,8 +59,14 @@ def test_dense_lm_trains(tokens):
     assert int(jax.device_get(s.step)) == 5
 
 
+@pytest.mark.parametrize("kv_heads", [None, 2])
 @pytest.mark.parametrize("kind", ["ring", "ulysses", "ring-zigzag"])
-def test_seq_parallel_matches_dense(tokens, kind):
+def test_seq_parallel_matches_dense(tokens, kind, kv_heads):
+    """kv_heads=2 additionally pins GQA under every sequence-parallel
+    scheme: the K/V broadcast happens before the ring/all-to-all
+    machinery, and the post-step param comparison covers its backward
+    (query-head grads summing into the shared K/V projections)."""
+    CFG = dict(globals()["CFG"], num_kv_heads=kv_heads)
     mesh = create_mesh(data=4, model=2)
     labels, mask = next_token_targets(tokens)
 
