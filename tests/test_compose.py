@@ -66,6 +66,46 @@ def test_engine_with_moe_matches_solo():
 
 
 @pytest.mark.slow
+def test_quant_with_moe_decode_matches_dequantized_float():
+    """int8 attention kernels + float MoE experts: the quant model's
+    greedy decode must equal the float model evaluated at the
+    DEQUANTIZED weights (experts pass through quantization untouched,
+    so the trees differ only in the attention kernels)."""
+    from tests.test_quant import _dequant_tree
+
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_experts=4)
+    qp = serving_params(_params_for(cfg), "int8")
+    qm = transformer_lm(**cfg, decode=True, quant=True)
+    fm = transformer_lm(**cfg, decode=True)
+    assert _solo(qm, qp) == _solo(fm, _dequant_tree(qp))
+
+
+@pytest.mark.slow
+def test_gqa_under_tensor_parallel_decode_matches_single_device():
+    """GQA decode under 2-way tensor parallelism: KV-head projections
+    shard (or replicate, per the shape rule) and GSPMD's collectives
+    must reproduce the single-device greedy tokens exactly."""
+    from container_engine_accelerators_tpu.parallel import (
+        create_mesh,
+        shard_params,
+    )
+
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+    params = _params_for(cfg)
+    model = transformer_lm(**cfg, decode=True)
+    prompt = jnp.asarray([PROMPT], jnp.int32)
+    solo = np.asarray(generate(model, params, prompt, 5))
+    mesh = create_mesh(data=1, model=2, devices=jax.devices()[:2])
+    sharded = jax.device_put(params, shard_params(params, mesh))
+    tp = np.asarray(jax.jit(lambda p: generate(model, p, prompt, 5))(
+        sharded
+    ))
+    np.testing.assert_array_equal(solo, tp)
+
+
+@pytest.mark.slow
 def test_int8_quant_under_tensor_parallel_matches_single_device():
     from container_engine_accelerators_tpu.parallel import (
         create_mesh,
