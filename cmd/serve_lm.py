@@ -44,6 +44,11 @@ def parse_args(argv=None):
                    help="serving weight precision (models/quant.py): "
                         "bf16 halves, int8 quarters the per-token "
                         "parameter HBM read")
+    p.add_argument("--flash-decode", action="store_true",
+                   help="Pallas cache-attention kernel for decode "
+                        "steps (ops/flash_decode.py): streams + skips "
+                        "the cache instead of masking the full buffer; "
+                        "long-context lever, single chip only")
     p.add_argument("--max-prompt-len", type=int, default=64,
                    help="longest accepted prompt; prompts are padded to "
                         "power-of-two buckets, so ~log2 of this many "
@@ -122,8 +127,15 @@ def build_generate(args):
 
         params = serving_params(params, args.weights)
         log.info("serving weights cast to %s", args.weights)
+    if args.flash_decode and args.tp > 1:
+        # pallas_call has no GSPMD partitioning rule; under a sharded
+        # jit it would gather the full cache per chip, silently
+        # destroying the tp win (ops/flash_decode.py docstring).
+        raise SystemExit("--flash-decode and --tp > 1 are mutually "
+                         "exclusive (the kernel is single-chip)")
     decode_model = transformer_lm(
-        **cfg, decode=True, quant=args.weights == "int8"
+        **cfg, decode=True, quant=args.weights == "int8",
+        use_flash_decode=args.flash_decode,
     )
 
     if args.tp > 1:

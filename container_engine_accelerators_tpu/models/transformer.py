@@ -101,6 +101,12 @@ class Attention(nn.Module):
     # int8 kernels + f32 scales (models/quant.py): 4x less param HBM
     # traffic per decoded token.  Params come from quantize_params().
     quant: bool = False
+    # Pallas flash-decode kernel (ops/flash_decode.py) for the
+    # single-token cache attention: streams the cache in chunks and
+    # SKIPS chunks beyond the visible length instead of masking the
+    # whole fixed buffer.  Long-context serving lever; explicit opt-in,
+    # single chip (no GSPMD rule — the tp path keeps XLA einsums).
+    use_flash_decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -221,6 +227,22 @@ class Attention(nn.Module):
         # Group query heads over the (possibly fewer) cached KV heads:
         # q head g*i+j attends KV head i.  With kvh == h the reshape is
         # the identity grouping and this is plain MHA.
+        if self.use_flash_decode and t == 1:
+            from container_engine_accelerators_tpu.ops.flash_decode import (
+                flash_decode,
+            )
+
+            pos_b = (
+                positions[:, 0] if positions.ndim == 2
+                else jnp.broadcast_to(positions[0], (b,))
+            )
+            o = flash_decode(
+                q[:, 0], cached_k.value, cached_v.value, pos_b + 1,
+                scale=self.head_dim ** -0.5,
+                interpret=jax.devices()[0].platform == "cpu",
+            )
+            return o[:, None].astype(q.dtype)
+
         group = h // kvh
         qg = q.reshape(b, t, kvh, group, d)
         s = jnp.einsum(
@@ -254,6 +276,7 @@ class Block(nn.Module):
     num_kv_heads: Optional[int] = None  # GQA (None = MHA)
     quant: bool = False  # int8 kernels (models/quant.py)
     moe_capacity_factor: float = 1.25  # train-mode MoE capacity
+    use_flash_decode: bool = False  # Pallas cache-attention kernel
 
     @nn.compact
     def __call__(self, x, positions):
@@ -268,6 +291,7 @@ class Block(nn.Module):
             self.decode,
             num_kv_heads=self.num_kv_heads,
             quant=self.quant,
+            use_flash_decode=self.use_flash_decode,
             name="attn",
         )(y, positions)
         y = RMSNorm(dtype=self.dtype, name="ln_mlp")(x)
@@ -312,6 +336,7 @@ class _ScanBlock(nn.Module):
     num_kv_heads: Optional[int] = None
     quant: bool = False
     moe_capacity_factor: float = 1.25
+    use_flash_decode: bool = False
 
     @nn.compact
     def __call__(self, x, positions):
@@ -328,6 +353,7 @@ class _ScanBlock(nn.Module):
             num_kv_heads=self.num_kv_heads,
             quant=self.quant,
             moe_capacity_factor=self.moe_capacity_factor,
+            use_flash_decode=self.use_flash_decode,
             name="block",
         )(x, positions)
         return x, aux
@@ -354,6 +380,7 @@ class TransformerLM(nn.Module):
     num_kv_heads: Optional[int] = None  # GQA (None = MHA)
     quant: bool = False  # int8 serving kernels (models/quant.py)
     moe_capacity_factor: float = 1.25  # train-mode MoE capacity
+    use_flash_decode: bool = False  # Pallas cache-attention kernel
     remat: bool = True  # rematerialize blocks in backward (saves HBM)
 
     @nn.compact
@@ -382,6 +409,7 @@ class TransformerLM(nn.Module):
             self.num_kv_heads,
             self.quant,
             self.moe_capacity_factor,
+            self.use_flash_decode,
         )
         # Scan over a single stacked Block: compile time is O(1) in depth
         # instead of O(num_layers) — with a Python loop the 12-layer

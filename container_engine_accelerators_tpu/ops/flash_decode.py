@@ -1,0 +1,164 @@
+"""Flash decode: single-query attention over a long KV cache (Pallas).
+
+The serving decode step attends ONE query token per sequence over the
+whole cached context.  The XLA path (models/transformer.py
+``_decode_attend``) computes masked scores over the full fixed-length
+buffer — fine at short contexts, but at long ones it streams the dead
+tail of the buffer through the VPU and materializes [B, H, 1, L]
+logits.  This kernel is the long-context replacement:
+
+- streams the cache in ``block_k`` chunks with the classic online
+  softmax (running max / denominator / accumulator in f32 VMEM
+  scratch), writing one [H, D] tile per sequence at the end;
+- **skips** chunks entirely beyond the sequence's visible length
+  (``pl.when`` on the block start) instead of masking them — the
+  savings scale with buffer slack, exactly the regime bucketed
+  serving creates;
+- handles GQA natively: the cache keeps ``KVH`` heads and the query's
+  ``KVH x G`` grouping is computed in-kernel — no repeated K/V pass,
+  matching ``_decode_attend``'s grouped einsums;
+- keeps the cache in its storage layout [B, L, KVH, D] (blocks carry
+  all KV heads, so no transpose copy per step).
+
+Correctness contract (tests/test_flash_decode.py): matches
+``_decode_attend``'s masked-einsum math to f32-accumulation tolerance
+for every (length, GQA group, block) combination, via interpret mode
+on CPU.
+
+Like ops/flash_attention.py, the kernel has no GSPMD partition rule:
+single-chip decode only (the tensor-parallel path keeps XLA einsums).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is unavailable on CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+BLOCK_K = 512
+
+
+def effective_block_k(cache_len: int, block_k: int = BLOCK_K) -> int:
+    """Largest divisor of ``cache_len`` that is <= ``block_k``.
+
+    Any cache length works (a serving cache is bucket + max_new, not
+    necessarily a multiple of 512); the bench's roofline math uses the
+    same value to model the kernel's block-granular reads."""
+    for bk in range(min(block_k, cache_len), 0, -1):
+        if cache_len % bk == 0:
+            return bk
+    return 1  # pragma: no cover — bk=1 always divides
+# Mosaic needs the last two block dims (8k, 128k) or equal to the array
+# dims; a per-sequence scalar therefore rides as an [8, 128] f32 tile.
+_SCALAR_TILE = (8, 128)
+
+
+def _fd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, a_scr,
+               *, block_k, scale):
+    """One grid step: K/V chunk ``kb`` of sequence ``b``, all heads."""
+    kb = pl.program_id(1)
+    nk = pl.num_programs(1)
+    length = len_ref[0, 0, 0].astype(jnp.int32)  # visible keys in [0, L]
+
+    @pl.when(kb == 0)
+    def init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        a_scr[...] = jnp.zeros_like(a_scr)
+
+    @pl.when(kb * block_k < length)
+    def attend():
+        q = q_ref[0]  # [KVH, G, D] — input precision feeds the MXU
+        k = k_ref[0]  # [BK, KVH, D]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k,
+            (((2,), (2,)), ((0,), (1,))),  # contract D; batch KVH
+            preferred_element_type=jnp.float32,
+        ) * scale  # [KVH, G, BK]
+        slot = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2
+        )
+        s = jnp.where(slot < length, s, NEG_INF)
+
+        m_prev = m_scr[...]  # [KVH, G]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :, None])  # [KVH, G, BK]
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=2)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            (((2,), (0,)), ((0,), (1,))),  # contract BK; batch KVH
+            preferred_element_type=jnp.float32,
+        )  # [KVH, G, D]
+        a_scr[...] = a_scr[...] * alpha[:, :, None] + pv
+        m_scr[...] = m_new
+
+    @pl.when(kb == nk - 1)
+    def finalize():
+        o_ref[0] = (
+            a_scr[...] / l_scr[...][:, :, None]
+        ).astype(o_ref.dtype)
+
+
+def flash_decode(q, k_cache, v_cache, lengths, *, scale=None,
+                 block_k=BLOCK_K, interpret=False):
+    """Single-token attention over a KV cache.
+
+    q: [B, H, D]; k_cache/v_cache: [B, L, KVH, D] (H = KVH * G, query
+    head ``kv*G + j`` reads KV head ``kv`` — the grouping of
+    models/transformer.py); lengths: [B] visible keys per sequence
+    (key slot j participates iff j < lengths[b]).  Returns [B, H, D].
+    """
+    if pltpu is None:  # pragma: no cover — pallas TPU always importable here
+        raise NotImplementedError(
+            "flash_decode needs jax.experimental.pallas.tpu"
+        )
+    b, h, d = q.shape
+    _, cache_len, kvh, _ = k_cache.shape
+    if h % kvh:
+        raise ValueError(f"H={h} not divisible by KVH={kvh}")
+    g = h // kvh
+    block_k = effective_block_k(cache_len, block_k)
+    scale = d ** -0.5 if scale is None else scale
+
+    qg = q.reshape(b, kvh, g, d)
+    lens = jnp.broadcast_to(
+        lengths.astype(jnp.float32)[:, None, None],
+        (b,) + _SCALAR_TILE,
+    )
+    nk = cache_len // block_k
+    grid = (b, nk)
+    out = pl.pallas_call(
+        functools.partial(_fd_kernel, block_k=block_k, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,) + _SCALAR_TILE, lambda b_, k_: (b_, 0, 0)),
+            pl.BlockSpec((1, kvh, g, d), lambda b_, k_: (b_, 0, 0, 0)),
+            pl.BlockSpec((1, block_k, kvh, d),
+                         lambda b_, k_: (b_, k_, 0, 0)),
+            pl.BlockSpec((1, block_k, kvh, d),
+                         lambda b_, k_: (b_, k_, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, kvh, g, d), lambda b_, k_: (b_, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, g, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, g), jnp.float32),
+            pltpu.VMEM((kvh, g), jnp.float32),
+            pltpu.VMEM((kvh, g, d), jnp.float32),
+        ],
+        compiler_params=(
+            None if (interpret or pltpu is None)
+            else pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")
+            )
+        ),
+        interpret=interpret,
+    )(lens, qg, k_cache, v_cache)
+    return out.reshape(b, h, d)

@@ -1,0 +1,153 @@
+"""Flash-decode kernel numerics (ops/flash_decode.py, interpret mode).
+
+Reference is the same grouped masked-softmax math as
+models/transformer.py ``_decode_attend`` — the kernel must match it to
+f32-accumulation tolerance for every (length, group, block)
+combination, including per-sample lengths and block-skipping tails.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from container_engine_accelerators_tpu.ops.flash_decode import (
+    flash_decode,
+)
+
+
+def _reference(q, k_cache, v_cache, lengths):
+    """Grouped masked softmax over the full buffer (f32)."""
+    b, h, d = q.shape
+    _, cache_len, kvh, _ = k_cache.shape
+    g = h // kvh
+    qg = q.reshape(b, kvh, g, d).astype(jnp.float32)
+    s = jnp.einsum(
+        "bhgd,bkhd->bhgk", qg * (d ** -0.5),
+        k_cache.astype(jnp.float32),
+    )
+    mask = jnp.arange(cache_len)[None] < lengths[:, None]  # [B, L]
+    s = jnp.where(mask[:, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhgk,bkhd->bhgd", p, v_cache.astype(jnp.float32))
+    return o.reshape(b, h, d)
+
+
+def _mk(b, cache_len, h, kvh, d, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, cache_len, kvh, d), dtype)
+    v = jax.random.normal(ks[2], (b, cache_len, kvh, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("kvh,h", [(4, 4), (2, 4), (1, 4)])
+@pytest.mark.parametrize("block_k", [32, 64, 128])
+def test_matches_reference_across_groups_and_blocks(kvh, h, block_k):
+    b, cache_len, d = 3, 128, 16
+    q, k, v = _mk(b, cache_len, h, kvh, d)
+    lengths = jnp.asarray([1, 57, 128], jnp.int32)  # edge, mid, full
+    got = flash_decode(q, k, v, lengths, block_k=block_k,
+                       interpret=True)
+    want = _reference(q, k, v, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_bf16_inputs_close_to_f32_reference():
+    b, cache_len, h, kvh, d = 2, 256, 4, 2, 32
+    q, k, v = _mk(b, cache_len, h, kvh, d, seed=1, dtype=jnp.bfloat16)
+    lengths = jnp.asarray([100, 256], jnp.int32)
+    got = flash_decode(q, k, v, lengths, block_k=64, interpret=True)
+    want = _reference(q, k, v, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want),
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+def test_skipped_blocks_are_never_loaded():
+    """NaN K/V in chunks entirely beyond the visible length must not
+    reach the output: those blocks are SKIPPED (pl.when), not masked.
+    (Within a partially visible chunk the mask zeroes the probability,
+    which neutralizes finite stale values — the real cache's dead-slot
+    contents — but 0*NaN would still poison, so the NaN tail starts on
+    a block boundary here.)"""
+    b, cache_len, h, kvh, d = 1, 128, 4, 2, 16
+    q, k, v = _mk(b, cache_len, h, kvh, d, seed=2)
+    lengths = jnp.asarray([64], jnp.int32)
+    live = jnp.arange(cache_len)[None, :, None, None] < 64
+    got = flash_decode(q, jnp.where(live, k, jnp.nan),
+                       jnp.where(live, v, jnp.nan), lengths,
+                       block_k=32, interpret=True)
+    want = _reference(q, k, v, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
+    # Stale-but-finite dead slots (the serving reality) are inert even
+    # inside a partially visible chunk.
+    stale_k = jnp.where(jnp.arange(cache_len)[None, :, None, None] < 40,
+                        k, 37.0)
+    got2 = flash_decode(q, stale_k, v, jnp.asarray([40], jnp.int32),
+                        block_k=32, interpret=True)
+    want2 = _reference(q, k, v, jnp.asarray([40], jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(got2), np.asarray(want2), atol=2e-5, rtol=2e-5
+    )
+
+
+@pytest.mark.slow
+def test_model_integration_matches_einsum_decode():
+    """use_flash_decode=True greedy generation must produce the exact
+    tokens of the einsum decode path (same params, GQA config) — the
+    kernel slots into _decode_attend for single-token steps only;
+    prefill stays on the batched einsum path either way."""
+    import optax
+
+    from container_engine_accelerators_tpu.models.generate import (
+        generate,
+    )
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+    state = create_lm_train_state(
+        transformer_lm(**cfg), jax.random.PRNGKey(3),
+        jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+    )
+    prompt = jnp.asarray([[5, 17, 42], [88, 3, 9]], jnp.int32)
+    base = generate(transformer_lm(**cfg, decode=True), state.params,
+                    prompt, 5)
+    flash = generate(
+        transformer_lm(**cfg, decode=True, use_flash_decode=True),
+        state.params, prompt, 5,
+    )
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(flash))
+
+
+def test_guards_and_block_autosize():
+    from container_engine_accelerators_tpu.ops.flash_decode import (
+        effective_block_k,
+    )
+
+    q, k, v = _mk(1, 64, 4, 2, 16)
+    with pytest.raises(ValueError, match="not divisible"):
+        flash_decode(q[:, :3], k, v, jnp.asarray([64]), interpret=True)
+    # Non-multiple cache lengths auto-pick the largest dividing block —
+    # the long-context serving shape (bucket + max_new) must just work.
+    assert effective_block_k(2176) == 272  # 2048 + 128 = 2^7 * 17
+    assert effective_block_k(64, 48) == 32
+    assert effective_block_k(97) == 97  # prime: one whole-cache block
+    q2, k2, v2 = _mk(1, 96, 4, 2, 16)
+    got = flash_decode(q2, k2, v2, jnp.asarray([70]), block_k=64,
+                       interpret=True)  # 96 % 64 != 0 -> block 48
+    want = _reference(q2, k2, v2, jnp.asarray([70]))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5
+    )
