@@ -540,11 +540,15 @@ def _run_decode(on_accel: bool):
     )
 
     suffix = "" if on_accel else "_cpufallback"
-    gqa = f"_gqa{kv}" if kv else ""
-    wtag = f"_w{weights}" if weights != "f32" else ""
+    default_ctx = (64, 192) if on_accel else (4, 4)
+    gqa, wtag, ftag, ltag = _decode_variant_tags(
+        kv, weights, flash_decode, max_len,
+        (prompt_len, new_tokens) != default_ctx,
+    )
     return {
-        "metric": f"decode_{layers}L{gqa}{wtag}_bf16_tokens_per_sec_1chip"
-        + suffix,
+        "metric":
+            f"decode_{layers}L{gqa}{wtag}{ftag}{ltag}"
+            f"_bf16_tokens_per_sec_1chip" + suffix,
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/sec",
         "vs_baseline": round(util, 4) if on_accel else None,
@@ -561,6 +565,21 @@ def _run_decode(on_accel: bool):
         "calls": calls,
         "nonce": nonce,
     }
+
+
+def _decode_variant_tags(kv, weights, flash, max_len, explicit_ctx):
+    """Metric-name tags for a decode variant — the ONE place the tag
+    grammar lives; the writer (_run_decode) and the evidence-log reader
+    (_latest_logged_tpu) both use it, so they cannot drift.  A default
+    run carries no tags; the contrast stages stay distinct in the log.
+    ``explicit_ctx`` is value-based (shape != the mode's default), so
+    pinning the default shape in a stage env adds no tag."""
+    return (
+        f"_gqa{kv}" if kv else "",
+        f"_w{weights}" if weights != "f32" else "",
+        "_flashdec" if flash else "",
+        f"_L{max_len}" if explicit_ctx else "",
+    )
 
 
 TPU_LOG = os.path.join(_REPO_ROOT, "BENCH_TPU_LOG.jsonl")
@@ -605,12 +624,23 @@ def _latest_logged_tpu(workload: str):
     # The decode workload has MHA/GQA and weight-precision variants
     # distinguished only by env; their entries must not stand in for
     # each other (the paired watcher stages exist to CONTRAST them).
-    gqa_tag = wtag = None
+    decode_tags = None
     if workload == "decode":
-        kv = int(os.environ.get("BENCH_DECODE_KV", "0"))
-        gqa_tag = f"_gqa{kv}_" if kv else ""
-        w = os.environ.get("BENCH_DECODE_WEIGHTS", "f32")
-        wtag = f"_w{w}_" if w != "f32" else ""
+        try:
+            kv = int(os.environ.get("BENCH_DECODE_KV", "0"))
+            w = os.environ.get("BENCH_DECODE_WEIGHTS", "f32")
+            flash = os.environ.get("BENCH_DECODE_FLASH", "0") == "1"
+            # Logged entries are on-chip runs, so on-accel defaults
+            # fill whichever shape knob is unset.
+            prompt = int(os.environ.get("BENCH_DECODE_PROMPT", "64"))
+            new = int(os.environ.get("BENCH_DECODE_NEW", "192"))
+        except ValueError:
+            # Malformed env must not crash the orchestrator before the
+            # provisional line prints; no confident variant match.
+            return None
+        decode_tags = _decode_variant_tags(
+            kv, w, flash, prompt + new, (prompt, new) != (64, 192)
+        )
     for line in reversed(lines):
         line = line.strip()
         if not line:
@@ -622,16 +652,14 @@ def _latest_logged_tpu(workload: str):
         metric = entry.get("metric", "")
         if not metric.startswith(prefix) or "cpufallback" in metric:
             continue
-        if gqa_tag is not None and (
-            (gqa_tag and gqa_tag not in metric)
-            or (not gqa_tag and "_gqa" in metric)
-        ):
-            continue
-        if wtag is not None and (
-            (wtag and wtag not in metric)
-            or (not wtag and "_w" in metric)
-        ):
-            continue
+        if decode_tags is not None:
+            markers = ("_gqa", "_w", "_flashdec", "_L")
+            if any(
+                (tag and tag + "_" not in metric)
+                or (not tag and marker in metric)
+                for tag, marker in zip(decode_tags, markers)
+            ):
+                continue
         return entry
     return None
 

@@ -62,27 +62,45 @@ PROBE_CMD = (
 # evidence-loss mode this tool exists to close).
 _BENCH_STAGE_TIMEOUT = 4200
 
+# Every decode stage pins EVERY decode knob: stage env merges over
+# os.environ, and an inherited BENCH_DECODE_* would silently collapse
+# the variant contrasts (f32/GQA/int8, short/long, einsum/flash) into
+# copies of one variant.
+_DECODE_DEFAULTS = {
+    "BENCH_WORKLOAD": "decode",
+    "BENCH_DECODE_KV": "0",
+    "BENCH_DECODE_WEIGHTS": "f32",
+    "BENCH_DECODE_FLASH": "0",
+    "BENCH_DECODE_PROMPT": "64",
+    "BENCH_DECODE_NEW": "192",
+}
+
 DEFAULT_STAGES = [
     {"name": "bench_resnet", "cmd": [sys.executable, "bench.py"],
      "timeout": _BENCH_STAGE_TIMEOUT},
     # Cheap stages right after the path validator: the decode stages
     # compile small graphs and time seconds of work, so even a short
     # tunnel window converts into several distinct measurements before
-    # the compile-heavy LM train stage gets its turn.  Each stage pins
-    # BOTH decode knobs — stage env merges over os.environ, and an
-    # inherited BENCH_DECODE_* would silently turn the f32/GQA/int8
-    # contrast into three copies of one variant.
+    # the compile-heavy LM train stage gets its turn.
     {"name": "bench_decode", "cmd": [sys.executable, "bench.py"],
-     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "0",
-             "BENCH_DECODE_WEIGHTS": "f32"},
+     "env": dict(_DECODE_DEFAULTS),
      "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "bench_decode_gqa", "cmd": [sys.executable, "bench.py"],
-     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "4",
-             "BENCH_DECODE_WEIGHTS": "f32"},
+     "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_KV="4"),
      "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "bench_decode_int8", "cmd": [sys.executable, "bench.py"],
-     "env": {"BENCH_WORKLOAD": "decode", "BENCH_DECODE_KV": "0",
-             "BENCH_DECODE_WEIGHTS": "int8"},
+     "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_WEIGHTS="int8"),
+     "timeout": _BENCH_STAGE_TIMEOUT},
+    # Long-context decode A/B: einsum-over-masked-buffer vs the
+    # flash-decode kernel's streamed+skipped reads, same 2048 cache.
+    {"name": "bench_decode_long", "cmd": [sys.executable, "bench.py"],
+     "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_PROMPT="1984",
+                 BENCH_DECODE_NEW="64"),
+     "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_decode_long_flash",
+     "cmd": [sys.executable, "bench.py"],
+     "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_FLASH="1",
+                 BENCH_DECODE_PROMPT="1984", BENCH_DECODE_NEW="64"),
      "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "bench_serving",
      "cmd": [sys.executable, "cmd/bench_serving.py", "--slots", "4",

@@ -265,6 +265,21 @@ def test_decode_prefix_roundtrip(bench, monkeypatch):
     assert bench._latest_logged_tpu("decode")["value"] == 3.0  # GQA only
     monkeypatch.setenv("BENCH_DECODE_KV", "8")
     assert bench._latest_logged_tpu("decode") is None  # no gqa8 entry
+    # Flash and long-context tags are variants too: the A/B stages'
+    # entries must never stand in for each other or for the defaults.
+    monkeypatch.delenv("BENCH_DECODE_KV", raising=False)
+    bench._log_tpu_result(
+        {"metric": "decode_12L_L2048_bf16_tokens_per_sec_1chip",
+         "value": 4.0})
+    bench._log_tpu_result(
+        {"metric": "decode_12L_flashdec_L2048_bf16_tokens_per_sec_1chip",
+         "value": 5.0})
+    assert bench._latest_logged_tpu("decode")["value"] == 2.0  # defaults
+    monkeypatch.setenv("BENCH_DECODE_PROMPT", "1984")
+    monkeypatch.setenv("BENCH_DECODE_NEW", "64")
+    assert bench._latest_logged_tpu("decode")["value"] == 4.0
+    monkeypatch.setenv("BENCH_DECODE_FLASH", "1")
+    assert bench._latest_logged_tpu("decode")["value"] == 5.0
 
 
 def test_committed_log_is_valid_and_has_tpu_entry():

@@ -188,7 +188,8 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
     )
     for tool in ("bench.py", "bench_attention.py", "roofline_resnet.py",
                  "inject_error.py", "lm", "decode", "BENCH_DECODE_KV",
-                 "BENCH_DECODE_WEIGHTS=int8", "bench_serving.py",
+                 "BENCH_DECODE_WEIGHTS=int8", "BENCH_DECODE_FLASH=1",
+                 "BENCH_DECODE_PROMPT=1984", "bench_serving.py",
                  "inception"):
         assert tool in joined, tool
         assert tool in mk
