@@ -42,6 +42,11 @@ def parse_args(argv=None):
                    help="sequence/context parallelism scheme over the "
                         "mesh data axis (ring-zigzag = causal-balanced "
                         "ring; inputs are reordered automatically)")
+    p.add_argument("--param-sharding", default="megatron",
+                   choices=("megatron", "fsdp"),
+                   help="dense-mode weight layout: megatron replicates "
+                        "along data; fsdp (ZeRO-3) also shards params "
+                        "and optimizer moments over the data axis")
     p.add_argument("--model-par", type=int, default=1,
                    help="tensor-parallel degree of the mesh (dense mode)")
     p.add_argument("--learning-rate", type=float, default=3e-4)
@@ -89,6 +94,12 @@ def main(argv=None):
                 "the sequence shards occupy the whole data axis and "
                 "params are replicated; drop one of the flags"
             )
+        if args.param_sharding != "megatron":
+            raise SystemExit(
+                "--param-sharding fsdp applies to dense mode only: the "
+                "sequence-parallel path runs under shard_map with "
+                "replicated params; drop one of the flags"
+            )
         # The whole data axis carries the sequence shards.
         mesh = create_mesh(model=1)
         if args.seq_len % n_dev:
@@ -120,7 +131,9 @@ def main(argv=None):
         model, jax.random.PRNGKey(0), sample,
         tx=optax.adamw(args.learning_rate, weight_decay=0.1),
     )
-    step_fn, state = make_lm_train_step(mesh, state, seq_parallel)
+    step_fn, state = make_lm_train_step(
+        mesh, state, seq_parallel, param_sharding=args.param_sharding
+    )
 
     checkpointer = None
     start_step = 0

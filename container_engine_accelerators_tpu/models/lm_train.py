@@ -114,7 +114,8 @@ def _loss(apply_fn, params, tokens, labels, mask, positions):
 
 
 def make_lm_train_step(
-    mesh: Mesh, state: TrainState, seq_parallel: Optional[str] = None
+    mesh: Mesh, state: TrainState, seq_parallel: Optional[str] = None,
+    param_sharding: str = "megatron",
 ):
     """Jit the LM step over ``mesh``.
 
@@ -125,26 +126,47 @@ def make_lm_train_step(
     matching ``seq_parallel=`` so its attention uses the axis).
     ring-zigzag additionally expects inputs in zigzag storage order —
     build them with :func:`prepare_seq_parallel_batch`.
+
+    ``param_sharding`` (dense mode only): "megatron" shards weights
+    over MODEL_AXIS and replicates them along the data axis; "fsdp"
+    additionally shards every weight and its optimizer buffers over
+    DATA_AXIS (ZeRO-3 — per-chip param+Adam memory drops by the dp
+    degree, GSPMD all-gathers weights just-in-time and reduce-scatters
+    grads).  The math is identical; only the layout moves.
     """
     rep = NamedSharding(mesh, P())
     apply_fn = state.apply_fn
     tx = state.tx
 
+    if param_sharding not in ("megatron", "fsdp"):
+        raise ValueError(f"unknown param_sharding {param_sharding!r}")
+    if seq_parallel is not None and param_sharding != "megatron":
+        raise ValueError(
+            f"param_sharding={param_sharding!r} applies to dense mode "
+            f"only — the sequence-parallel path runs under shard_map "
+            f"with replicated params (its in_specs are P())"
+        )
+
     if seq_parallel is None:
-        # Megatron-style tensor parallelism over MODEL_AXIS (same rule as
-        # the ResNet path): params and their same-shaped optimizer
-        # buffers shard the largest divisible weight axis.
         from container_engine_accelerators_tpu.parallel.mesh import (
             shard_params,
+            shard_params_fsdp,
         )
+
+        # "megatron": tensor parallelism over MODEL_AXIS (same rule as
+        # the ResNet path) — params and their same-shaped optimizer
+        # buffers shard the largest divisible weight axis, replicated
+        # along data.  "fsdp" additionally shards over the data axis
+        # (validated above).
+        shard = shard_params_fsdp if param_sharding == "fsdp" else shard_params
 
         state_sh = TrainState(
             step=rep,
-            params=shard_params(state.params, mesh),
+            params=shard(state.params, mesh),
             batch_stats=jax.tree_util.tree_map(
                 lambda _: rep, state.batch_stats
             ),
-            opt_state=shard_params(state.opt_state, mesh),
+            opt_state=shard(state.opt_state, mesh),
             tx=tx,
             apply_fn=apply_fn,
         )

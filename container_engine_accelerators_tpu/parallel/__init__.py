@@ -7,6 +7,7 @@ from container_engine_accelerators_tpu.parallel.mesh import (
     create_mesh,
     replicated,
     shard_params,
+    shard_params_fsdp,
 )
 from container_engine_accelerators_tpu.parallel import dcn
 from container_engine_accelerators_tpu.parallel.seq import (
@@ -25,6 +26,7 @@ __all__ = [
     "replicated",
     "ring_attention",
     "shard_params",
+    "shard_params_fsdp",
     "ulysses_attention",
     "dcn",
 ]

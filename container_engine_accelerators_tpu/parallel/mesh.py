@@ -95,17 +95,28 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(DATA_AXIS))
 
 
+def _largest_divisible_axis(shape, size, taken=(), prefer_trailing=True):
+    """Index of the largest axis divisible by ``size`` (and >= 2*size,
+    so a shard never degenerates below 2 rows), skipping ``taken``
+    axes; None if nothing qualifies.  ``prefer_trailing`` breaks ties
+    toward the output-feature axis (the Megatron convention)."""
+    best_axis, best_dim = None, 0
+    for axis in range(len(shape)):
+        dim = shape[axis]
+        better = dim >= best_dim if prefer_trailing else dim > best_dim
+        if (axis not in taken and dim % size == 0 and better
+                and dim >= 2 * size):
+            best_axis, best_dim = axis, dim
+    return best_axis
+
+
 def _param_spec(shape: Tuple[int, ...], model_size: int) -> P:
     if model_size <= 1 or not shape:
         return P()
     # Shard the largest axis divisible by the model-parallel degree; ties
     # break toward the trailing (output-feature) axis, which for convs and
     # dense layers makes this Megatron-style output-channel sharding.
-    best_axis, best_dim = None, 0
-    for axis in range(len(shape)):
-        dim = shape[axis]
-        if dim % model_size == 0 and dim >= best_dim and dim >= 2 * model_size:
-            best_axis, best_dim = axis, dim
+    best_axis = _largest_divisible_axis(shape, model_size)
     if best_axis is None:
         return P()
     spec = [None] * len(shape)
@@ -118,6 +129,43 @@ def shard_params(params, mesh: Mesh):
     model_size = mesh.shape.get(MODEL_AXIS, 1)
     return jax.tree_util.tree_map(
         lambda x: NamedSharding(mesh, _param_spec(np.shape(x), model_size)),
+        params,
+    )
+
+
+def _param_spec_fsdp(shape, data_size: int, model_size: int) -> P:
+    """FSDP/ZeRO layout: the Megatron model-axis rule first, then the
+    largest REMAINING axis divisible by the data-axis size carries
+    DATA_AXIS.  Params (and their same-shaped optimizer buffers) thus
+    occupy 1/(dp*tp) of HBM per chip; GSPMD all-gathers a layer's
+    weights just-in-time for its matmul and reduce-scatters its grads —
+    the scaling-book ZeRO-3 pattern, no hand-written collectives."""
+    base = _param_spec(shape, model_size)
+    spec = list(base) + [None] * (len(shape) - len(base))
+    taken = tuple(i for i, s in enumerate(spec) if s is not None)
+    best_axis = _largest_divisible_axis(
+        shape, data_size, taken=taken, prefer_trailing=True
+    )
+    if best_axis is not None:
+        spec[best_axis] = DATA_AXIS
+    return P(*spec)
+
+
+def shard_params_fsdp(params, mesh: Mesh):
+    """NamedShardings for fully-sharded data parallelism (+ tp).
+
+    Every param shards over the data axis too (ZeRO-3 / FSDP): with
+    replicated-per-chip optimizer state the params' Adam moments are
+    the dominant HBM term at scale, and dp-degree chips each holding a
+    full copy is pure waste.  Small tensors that don't divide stay
+    replicated — they are not the memory term.
+    """
+    data_size = mesh.shape.get(DATA_AXIS, 1)
+    model_size = mesh.shape.get(MODEL_AXIS, 1)
+    return jax.tree_util.tree_map(
+        lambda x: NamedSharding(
+            mesh, _param_spec_fsdp(np.shape(x), data_size, model_size)
+        ),
         params,
     )
 
