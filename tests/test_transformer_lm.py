@@ -120,11 +120,18 @@ def test_seq_parallel_matches_dense_bf16(tokens, kind):
     )
 
 
-def test_fsdp_matches_dp_and_shards_optimizer_state(tokens):
+@pytest.mark.parametrize("extra", [{}, {"num_experts": 4},
+                                   {"num_kv_heads": 2}],
+                         ids=["dense", "moe", "gqa"])
+def test_fsdp_matches_dp_and_shards_optimizer_state(tokens, extra):
     """param_sharding="fsdp": identical math to the replicated dp step
-    (loss and post-step params exact), with params AND optimizer
-    buffers actually sharded over the data axis — the ZeRO memory
-    claim, asserted on the placed shard sizes."""
+    (loss exact; post-step params exact for the dense case, within a
+    small fraction of one update step for MoE/GQA — see below), with
+    params AND optimizer buffers actually sharded over the data axis —
+    the ZeRO memory claim, asserted on the placed shard sizes.
+    Parametrized over MoE (expert weights are the big tensors the data
+    rule shards) and GQA."""
+    cfg = dict(CFG, **extra)
     mesh = create_mesh(data=4, model=2)
     labels, mask = next_token_targets(tokens)
 
@@ -132,7 +139,7 @@ def test_fsdp_matches_dp_and_shards_optimizer_state(tokens):
         # adamw, not the module default sgd: the ZeRO memory claim is
         # about the Adam moment buffers.
         return create_lm_train_state(
-            transformer_lm(**CFG), jax.random.PRNGKey(0), tokens,
+            transformer_lm(**cfg), jax.random.PRNGKey(0), tokens,
             tx=optax.adamw(1e-2),
         )
 
@@ -148,11 +155,18 @@ def test_fsdp_matches_dp_and_shards_optimizer_state(tokens):
         float(f_metrics["loss"]), float(d_metrics["loss"]),
         atol=1e-6, rtol=1e-6,
     )
+    # Post-step params: the dense case is bit-stable at float precision
+    # (the regression guard for fsdp placement bugs).  The MoE/GQA
+    # einsum orders differ enough between layouts that Adam's
+    # m/(sqrt(v)+eps) amplifies a single-ulp gradient-rounding
+    # difference into ~1e-4 of update, so those compare at a fraction
+    # of one lr=1e-2 step (the pre-update loss IS compared tightly).
+    tol = 2e-6 if not extra else 1e-3
     for a, b in zip(
         jax.tree_util.tree_leaves(jax.device_get(d_state.params)),
         jax.tree_util.tree_leaves(jax.device_get(f_state.params)),
     ):
-        np.testing.assert_allclose(a, b, atol=2e-6, rtol=2e-6)
+        np.testing.assert_allclose(a, b, atol=tol, rtol=tol)
 
     # The big tensors really live 1/(dp*tp) per chip, optimizer
     # moments included.
