@@ -56,6 +56,7 @@ presubmit:
 # the rest.
 .PHONY: bench-hw
 bench-hw:
+	-python cmd/bench_micro.py
 	-python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=f32 python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=4 BENCH_DECODE_WEIGHTS=f32 python bench.py
@@ -74,7 +75,7 @@ bench-hw:
 # Kill by exact pid (pkill by pattern self-matches the caller).
 .PHONY: watch-hw watch-hw-stop
 watch-hw:
-	$(PY) cmd/hw_watcher.py --daemonize
+	$(PY) cmd/hw_watcher.py --daemonize --rearm
 	@sleep 1; echo "watcher pid: $$(cat .hw_watcher.pid)"
 
 watch-hw-stop:

@@ -582,7 +582,9 @@ def _decode_variant_tags(kv, weights, flash, max_len, explicit_ctx):
     )
 
 
-TPU_LOG = os.path.join(_REPO_ROOT, "BENCH_TPU_LOG.jsonl")
+# BENCH_TPU_LOG overrides the committed log path (subprocess test seam).
+TPU_LOG = (os.environ.get("BENCH_TPU_LOG")
+           or os.path.join(_REPO_ROOT, "BENCH_TPU_LOG.jsonl"))
 
 
 def _log_tpu_result(result: dict) -> None:
@@ -902,6 +904,11 @@ def orchestrate() -> int:
                 f"{timeout}s",
                 file=sys.stderr,
             )
+            # Round-4 field observation: the tunnel can answer an
+            # enumeration probe and then wedge before the first compile
+            # returns.  A timed-out attempt is hang evidence just like
+            # a timed-out probe — later probes go cheap.
+            hang_seen = True
             continue
         if proc.returncode == 0 and proc.stdout.strip():
             sys.stderr.write(proc.stderr)

@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""Micro-benchmark: seconds-scale on-chip evidence for short tunnel
+windows.
+
+Round-4 field observation (BENCH_HW.md round-4 log): the axon tunnel's
+up-windows can be *minutes* long — device enumeration answered twice in
+a ~6-minute span, then the backend wedged again before the ResNet
+benchmark's first compile ever returned.  Every heavyweight stage needs
+tens of minutes of tunnel health; this tool needs ~four: two one-op
+compiles and seconds of execution.  It runs FIRST in the watcher suite
+so even the shortest contact converts into committed on-chip numbers:
+
+- ``micro_matmul_bf16_tflops``  — 4096x4096x4096 bf16 matmul, MXU rate;
+  ``vs_baseline`` = fraction of the chip's peak (the MFU of the op).
+- ``micro_hbm_copy_gbps``       — 256 MiB streamed read+write,
+  ``vs_baseline`` = fraction of the chip's HBM bandwidth.
+- ``micro_h2d_gbps``            — 64 MiB host->device transfer rate
+  (through the tunnel this measures the *tunnel*, so no peak is
+  claimed; ``vs_baseline`` = 0.0).
+
+Each metric is appended to BENCH_TPU_LOG.jsonl the moment it is
+measured (never batched at exit), so a mid-run wedge keeps everything
+already banked.  Replay/no-sync defense follows bench.py: every timed
+iteration's input differs (a traced scalar mixes the loop index into
+the operand), all dispatches are drained with block_until_ready, and a
+utilization above the physical ceiling raises instead of reporting
+(bench.py:137-152).
+
+The reference records exactly this class of short-form evidence for its
+comms stack — busbw lines from a bounded harness run
+(gpudirect-tcpx/nccl-config.yaml:60-63) — rather than only full
+workload numbers.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_T0 = time.monotonic()
+
+
+def _mark(msg):
+    print(f"bench_micro: [{time.monotonic() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+def _sig4(x):
+    """4 significant figures (fixed-decimal rounding zeroes out
+    tiny-size smoke runs)."""
+    return float(f"{x:.4g}")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--matmul-dim", type=int, default=4096)
+    p.add_argument("--copy-mib", type=int, default=256)
+    p.add_argument("--h2d-mib", type=int, default=64)
+    p.add_argument("--iters", type=int, default=32)
+    p.add_argument(
+        "--force-log", action="store_true",
+        help="append to BENCH_TPU_LOG.jsonl even on CPU (test seam; "
+             "normally CPU runs are smoke-only and never logged)")
+    return p.parse_args(argv)
+
+
+def _timed_loop(fn, iters):
+    """Dispatch ``fn(i)`` for distinct i, drain, return seconds.
+
+    Only the newest output is retained: a single device executes
+    in-order, so draining the last dispatch drains them all, and the
+    dropped references keep live HBM bounded at ~one buffer instead of
+    ``iters`` buffers (256 MiB x 32 would hold half a v5e's HBM)."""
+    import jax
+    out = None
+    t0 = time.monotonic()
+    for i in range(iters):
+        out = fn(i)
+    jax.block_until_ready(out)
+    return time.monotonic() - t0
+
+
+def run_micro(args):
+    """Measure the three micro metrics; yield each result dict as soon
+    as it exists (callers log/print immediately — mid-run wedges keep
+    banked entries)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bench import (_chip_hbm_bw, _chip_peak_flops,
+                       _validate_utilization)
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    init_s = round(time.monotonic() - _T0, 1)
+    _mark(f"backend up: {dev.device_kind or dev.platform} (init {init_s}s)")
+
+    rng = np.random.default_rng(int(time.time()) % 2**31)
+
+    # --- h2d transfer rate (first: no compile at all) ----------------
+    nbytes = args.h2d_mib * (1 << 20)
+    host = rng.random(nbytes // 4, dtype=np.float32)
+    jax.block_until_ready(jax.device_put(host))  # warm the path
+    t0 = time.monotonic()
+    reps = 4
+    for _ in range(reps):
+        jax.block_until_ready(jax.device_put(host))
+    h2d_gbps = reps * nbytes / (time.monotonic() - t0) / 1e9
+    yield {
+        "metric": "micro_h2d_gbps", "value": _sig4(h2d_gbps),
+        "unit": "GB/s", "vs_baseline": 0.0, "mib": args.h2d_mib,
+        "note": "host->device through the tunnel; measures the link, "
+                "no chip peak claimed", "init_s": init_s,
+    }
+    _mark(f"h2d {h2d_gbps:.2f} GB/s")
+
+    # --- HBM streaming copy ------------------------------------------
+    n = args.copy_mib * (1 << 20) // 4
+    a = jax.device_put(rng.random(n, dtype=np.float32))
+    copy = jax.jit(lambda x, i: x + i)
+    t0 = time.monotonic()
+    jax.block_until_ready(copy(a, 1.0))  # compile + warm
+    copy_compile_s = round(time.monotonic() - t0, 1)
+    dt = _timed_loop(lambda i: copy(a, float(i)), args.iters)
+    moved = 2 * a.nbytes * args.iters  # one read + one write per iter
+    hbm_gbps = moved / dt / 1e9
+    bw, bw_src = _chip_hbm_bw(dev)
+    frac = _validate_utilization(hbm_gbps * 1e9 / bw, "HBM fraction",
+                                 "HBM bandwidth", on_accel)
+    yield {
+        "metric": "micro_hbm_copy_gbps", "value": _sig4(hbm_gbps),
+        "unit": "GB/s", "vs_baseline": round(frac, 4),
+        "mib": args.copy_mib, "iters": args.iters,
+        "hbm_bw_source": bw_src, "compile_s": copy_compile_s,
+    }
+    _mark(f"hbm copy {hbm_gbps:.1f} GB/s ({frac:.0%} of peak)")
+
+    # --- bf16 matmul (MXU rate) --------------------------------------
+    d = args.matmul_dim
+    lhs = jax.device_put(rng.random((d, d), dtype=np.float32)
+                         .astype(jnp.bfloat16))
+    rhs = jax.device_put(rng.random((d, d), dtype=np.float32)
+                         .astype(jnp.bfloat16))
+    mm = jax.jit(lambda x, y, i: ((x + i) @ y).sum(dtype=jnp.float32))
+    t0 = time.monotonic()
+    jax.block_until_ready(mm(lhs, rhs, jnp.bfloat16(1)))
+    mm_compile_s = round(time.monotonic() - t0, 1)
+    # i <= 256 is exact in bf16, so every iteration's operand really
+    # differs (the replay defense the docstring promises).
+    dt = _timed_loop(lambda i: mm(lhs, rhs, jnp.bfloat16(i)), args.iters)
+    flops = 2 * d**3 * args.iters
+    tflops = flops / dt / 1e12
+    peak, peak_src = _chip_peak_flops(dev)
+    frac = _validate_utilization(tflops * 1e12 / peak, "matmul MFU",
+                                 "chip peak", on_accel)
+    yield {
+        "metric": "micro_matmul_bf16_tflops", "value": _sig4(tflops),
+        "unit": "TFLOP/s", "vs_baseline": round(frac, 4), "dim": d,
+        "iters": args.iters, "peak_source": peak_src,
+        "compile_s": mm_compile_s,
+    }
+    _mark(f"matmul {tflops:.1f} TFLOP/s ({frac:.0%} of peak)")
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    from bench import _log_tpu_result
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    for result in run_micro(args):
+        if on_accel or args.force_log:
+            _log_tpu_result(result)
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

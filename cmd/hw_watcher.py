@@ -76,6 +76,13 @@ _DECODE_DEFAULTS = {
 }
 
 DEFAULT_STAGES = [
+    # Seconds-scale evidence first: round 4 observed tunnel up-windows
+    # only minutes long (two enumerations answered, then the backend
+    # wedged before ResNet's first compile returned).  bench_micro
+    # needs two one-op compiles, so even the shortest contact banks
+    # committed on-chip numbers before the heavyweight stages start.
+    {"name": "bench_micro",
+     "cmd": [sys.executable, "cmd/bench_micro.py"], "timeout": 900},
     {"name": "bench_resnet", "cmd": [sys.executable, "bench.py"],
      "timeout": _BENCH_STAGE_TIMEOUT},
     # Cheap stages right after the path validator: the decode stages

@@ -76,3 +76,49 @@ def test_chip_peak_ordered_patterns_v5p_vs_v5e():
     assert (peak_v5e, src) == (197e12, "device_kind")
     peak_v5p, src = bench._chip_peak_flops(Dev("TPU v5p"))
     assert (peak_v5p, src) == (459e12, "device_kind")
+
+
+def test_bench_micro_cpu_smoke(tmp_path):
+    """End-to-end on CPU at tiny sizes: three metrics in order, each
+    logged the moment it is measured (--force-log test seam), honest
+    vs_baseline semantics (h2d claims no peak)."""
+    import json
+    import subprocess
+    import sys
+
+    log = tmp_path / "log.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_TPU_LOG=str(log))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "cmd", "bench_micro.py"),
+         "--matmul-dim", "64", "--copy-mib", "1", "--h2d-mib", "1",
+         "--iters", "2", "--force-log"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert [e["metric"] for e in lines] == [
+        "micro_h2d_gbps", "micro_hbm_copy_gbps", "micro_matmul_bf16_tflops"]
+    assert all(e["value"] > 0 for e in lines)
+    assert lines[0]["vs_baseline"] == 0.0  # tunnel link: no peak claimed
+    logged = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [e["metric"] for e in logged] == [e["metric"] for e in lines]
+    assert all("ts" in e for e in logged)
+
+
+def test_bench_micro_cpu_never_logs_without_force(tmp_path):
+    """A CPU run is smoke-only: no BENCH_TPU_LOG entries (the log is
+    the on-chip record; polluting it with host numbers would poison
+    the provisional-line provenance chain)."""
+    import subprocess
+    import sys
+
+    log = tmp_path / "log.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_TPU_LOG=str(log))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "cmd", "bench_micro.py"),
+         "--matmul-dim", "32", "--copy-mib", "1", "--h2d-mib", "1",
+         "--iters", "1"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert not log.exists()
