@@ -202,6 +202,17 @@ def main(argv=None):
             (globalize(toks), globalize(labels), globalize(mask))
         )
 
+    # Maintenance drains send SIGTERM (maintenance watcher taints, then
+    # Kubernetes evicts); convert it into a final synchronous checkpoint
+    # + exit 80 so the rescheduled pod resumes instead of restarting
+    # from step 0 (utils/preempt.py).
+    from container_engine_accelerators_tpu.utils.preempt import (
+        PreemptionGuard,
+        checkpoint_and_exit,
+    )
+
+    guard = PreemptionGuard()
+
     t0 = time.perf_counter()
     tokens_per_batch = args.train_batch_size * args.seq_len
     profiling = False
@@ -227,6 +238,9 @@ def main(argv=None):
             )
         if checkpointer and (step + 1) % args.checkpoint_interval == 0:
             checkpointer.save(state)
+        if guard.should_stop:
+            checkpoint_and_exit(checkpointer, state, step,
+                                args.checkpoint_interval, profiling)
     jax.block_until_ready(state.params)
     total = time.perf_counter() - t0
     steps_run = args.train_steps - start_step

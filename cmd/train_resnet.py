@@ -143,6 +143,16 @@ def main(argv=None):
                                     dtype=np.int32))
           for _ in range(n_batches)]
 
+    # Maintenance drains send SIGTERM; convert it into a final
+    # synchronous checkpoint + exit 80 so the rescheduled pod resumes
+    # (utils/preempt.py; same wiring as cmd/train_lm.py).
+    from container_engine_accelerators_tpu.utils.preempt import (
+        PreemptionGuard,
+        checkpoint_and_exit,
+    )
+
+    guard = PreemptionGuard()
+
     t0 = time.perf_counter()
     metrics = {}
     profiling = False
@@ -168,6 +178,9 @@ def main(argv=None):
             )
         if checkpointer and (step + 1) % args.checkpoint_interval == 0:
             checkpointer.save(state)
+        if guard.should_stop:
+            checkpoint_and_exit(checkpointer, state, step,
+                                args.checkpoint_interval, profiling)
     jax.block_until_ready(state.params)
     total = time.perf_counter() - t0
     steps_run = args.train_steps - start_step

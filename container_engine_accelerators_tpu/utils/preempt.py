@@ -1,0 +1,107 @@
+"""Graceful-preemption support for the training drivers.
+
+The infra side of a TPU host maintenance event already exists: the
+maintenance watcher sees the GCE advance notice, taints the node, and
+drops a code-80 event into the health queue
+(``health/maintenance.py:15-33``) — then Kubernetes drains the pod with
+SIGTERM and a grace period.  TPU slices cannot live-migrate, so the
+only way a training Job survives the drain with its progress is to
+convert that SIGTERM into a final synchronous checkpoint before the
+SIGKILL lands.  The reference leaves this to its demo images' restart
+semantics (demo/gpu-training/generate_job.sh:54-70 restarts from
+``--model_dir``); here the driver itself closes the loop.
+
+Usage (both train drivers)::
+
+    guard = PreemptionGuard()          # installs the SIGTERM handler
+    for step in range(start, steps):
+        state, metrics = step_fn(state, ...)
+        if guard.should_stop:
+            checkpoint_and_exit(checkpointer, state, step,
+                                args.checkpoint_interval)
+
+Exit is NON-zero (80, matching the maintenance event code) on purpose:
+a Kubernetes Job that sees exit 0 counts the pod as a completion and
+never reschedules it, which would turn every maintenance drain into a
+silently truncated training run.  Code 80 makes the Job controller
+restart the pod, and the restart resumes from the just-saved step via
+``TrainCheckpointer.restore_latest``.
+"""
+
+import logging
+import signal
+import threading
+
+log = logging.getLogger(__name__)
+
+# Mirrors health.maintenance.MAINTENANCE_CODE: the same event, seen
+# from inside the workload instead of from the node agent.
+PREEMPTED_EXIT_CODE = 80
+
+
+class PreemptionGuard:
+    """Latch SIGTERM into a flag the training loop polls between steps.
+
+    The handler only sets an event — never checkpoints from signal
+    context: the main thread may be inside a blocking XLA dispatch, and
+    orbax save must run on the thread that owns the arrays.  Polling
+    between steps bounds the reaction time to one train step, well
+    inside any sane terminationGracePeriod.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self._stop = threading.Event()
+        self._signum = None
+        self._previous = {}
+        for s in signals:
+            self._previous[s] = signal.signal(s, self._handle)
+
+    def _handle(self, signum, frame):  # noqa: ARG002 — signal signature
+        self._signum = signum
+        self._stop.set()
+        log.warning("received signal %d: will checkpoint and exit %d "
+                    "after the current step", signum, PREEMPTED_EXIT_CODE)
+
+    @property
+    def should_stop(self) -> bool:
+        return self._stop.is_set()
+
+    @property
+    def signum(self):
+        return self._signum
+
+    def uninstall(self) -> None:
+        """Restore previous handlers (test hygiene)."""
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+
+
+def checkpoint_and_exit(checkpointer, state, step: int,
+                        checkpoint_interval: int,
+                        profiling: bool = False):
+    """The drivers' shared SIGTERM tail: final synchronous checkpoint,
+    then a Job-restartable exit.
+
+    ``step`` is the loop index just completed; the driver's interval
+    save may already have covered it, in which case ``close()``'s
+    wait is all that is needed (a second ``save`` of the same step
+    would collide in orbax).  Always raises ``SystemExit`` with
+    :data:`PREEMPTED_EXIT_CODE`.
+    """
+    import jax
+
+    if profiling:
+        jax.profiler.stop_trace()
+    if checkpointer:
+        jax.block_until_ready(state.params)
+        if (step + 1) % checkpoint_interval != 0:
+            checkpointer.save(state, wait=True)
+        checkpointer.close()
+        log.warning("preempted at step %d: checkpoint saved; exiting "
+                    "%d for Job restart + resume", step + 1,
+                    PREEMPTED_EXIT_CODE)
+    else:
+        log.warning("preempted at step %d with no --checkpoint-dir: "
+                    "progress is lost", step + 1)
+    raise SystemExit(PREEMPTED_EXIT_CODE)
