@@ -463,7 +463,48 @@ def _run_decode(on_accel: bool):
     flash_decode = os.environ.get("BENCH_DECODE_FLASH", "0") == "1"
     model = transformer_lm(**lm_kw, decode=True, quant=weights == "int8",
                            use_flash_decode=flash_decode)
-    run = jax.jit(lambda p: generate(model, params, p, new_tokens))
+
+    # BENCH_DECODE_SPEC=k: speculative decoding (models/speculative.py).
+    # Random-init weights can't show the deployed speedup (that needs a
+    # draft that actually predicts the target), so the two stages bound
+    # the MACHINERY instead: draft=self accepts everything (acceptance
+    # ~1, draft as expensive as the target — measures the verify-chunk
+    # cost on top of a mandatory full-price decode), draft=1L accepts
+    # ~nothing (measures the per-round overhead at acceptance ~0).
+    # vs_baseline stays the PLAIN-decode roofline floor — a valid lower
+    # bound on any spec run's time (self: the draft pass alone is a
+    # full decode; 1L: the verify chunk re-reads the params per emitted
+    # token), so the >100% replay guard still protects the number.
+    spec = int(os.environ.get("BENCH_DECODE_SPEC", "0"))
+    spec_draft = os.environ.get("BENCH_DECODE_SPEC_DRAFT", "self")
+    spec_stats = None
+    if spec:
+        from container_engine_accelerators_tpu.models.speculative import (
+            generate_speculative,
+        )
+
+        if spec_draft == "self":
+            draft_model, draft_params = model, params
+        elif spec_draft == "1L":
+            d_kw = dict(lm_kw, num_layers=1)
+            d_state = create_lm_train_state(
+                transformer_lm(**d_kw), jax.random.PRNGKey(1),
+                jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+            )
+            draft_model = transformer_lm(
+                **d_kw, decode=True, use_flash_decode=flash_decode)
+            draft_params = d_state.params
+        else:
+            raise ValueError(
+                f"BENCH_DECODE_SPEC_DRAFT={spec_draft!r}: want self|1L")
+        run = jax.jit(
+            lambda p: generate_speculative(
+                model, params, draft_model, draft_params, p, new_tokens,
+                k=spec,
+            )
+        )
+    else:
+        run = jax.jit(lambda p: generate(model, params, p, new_tokens))
 
     # Nonce-seeded prompts, one per timed call (identical dispatches
     # replay from the tunnel's execution cache; see _run_resnet).  The
@@ -477,14 +518,21 @@ def _run_decode(on_accel: bool):
         for i in range(calls + 1)
     ]
     jax.block_until_ready(prompts)
+
+    def _sync(o):
+        toks = o[0] if spec else o
+        int(jax.device_get(toks[0, -1]))  # true sync (host fetch)
+
     out = run(prompts[-1])
-    int(jax.device_get(out[0, -1]))  # compile + true sync (host fetch)
+    _sync(out)  # compile + warmup
 
     t0 = time.perf_counter()
     for i in range(calls):
         out = run(prompts[i])
-    int(jax.device_get(out[0, -1]))
+    _sync(out)
     dt = time.perf_counter() - t0
+    if spec:
+        spec_stats = jax.device_get(out[1])
 
     # generate() is two-phase: one batched MXU-dense prefill over the
     # prompt, then new_tokens - 1 single-token decode steps.  The
@@ -541,13 +589,13 @@ def _run_decode(on_accel: bool):
 
     suffix = "" if on_accel else "_cpufallback"
     default_ctx = (64, 192) if on_accel else (4, 4)
-    gqa, wtag, ftag, ltag = _decode_variant_tags(
+    gqa, wtag, ftag, ltag, stag = _decode_variant_tags(
         kv, weights, flash_decode, max_len,
-        (prompt_len, new_tokens) != default_ctx,
+        (prompt_len, new_tokens) != default_ctx, spec, spec_draft,
     )
-    return {
+    result = {
         "metric":
-            f"decode_{layers}L{gqa}{wtag}{ftag}{ltag}"
+            f"decode_{layers}L{gqa}{wtag}{ftag}{ltag}{stag}"
             f"_bf16_tokens_per_sec_1chip" + suffix,
         "value": round(tokens_per_sec, 2),
         "unit": "tokens/sec",
@@ -565,9 +613,18 @@ def _run_decode(on_accel: bool):
         "calls": calls,
         "nonce": nonce,
     }
+    if spec:
+        drafted = int(spec_stats["drafted"].sum())
+        result["spec_k"] = spec
+        result["spec_draft"] = spec_draft
+        result["spec_rounds"] = int(spec_stats["rounds"])
+        result["spec_accept_rate"] = round(
+            int(spec_stats["accepted"].sum()) / max(drafted, 1), 4)
+    return result
 
 
-def _decode_variant_tags(kv, weights, flash, max_len, explicit_ctx):
+def _decode_variant_tags(kv, weights, flash, max_len, explicit_ctx,
+                         spec=0, spec_draft="self"):
     """Metric-name tags for a decode variant — the ONE place the tag
     grammar lives; the writer (_run_decode) and the evidence-log reader
     (_latest_logged_tpu) both use it, so they cannot drift.  A default
@@ -579,6 +636,7 @@ def _decode_variant_tags(kv, weights, flash, max_len, explicit_ctx):
         f"_w{weights}" if weights != "f32" else "",
         "_flashdec" if flash else "",
         f"_L{max_len}" if explicit_ctx else "",
+        f"_speck{spec}{spec_draft}" if spec else "",
     )
 
 
@@ -636,12 +694,14 @@ def _latest_logged_tpu(workload: str):
             # fill whichever shape knob is unset.
             prompt = int(os.environ.get("BENCH_DECODE_PROMPT", "64"))
             new = int(os.environ.get("BENCH_DECODE_NEW", "192"))
+            spec = int(os.environ.get("BENCH_DECODE_SPEC", "0"))
         except ValueError:
             # Malformed env must not crash the orchestrator before the
             # provisional line prints; no confident variant match.
             return None
         decode_tags = _decode_variant_tags(
-            kv, w, flash, prompt + new, (prompt, new) != (64, 192)
+            kv, w, flash, prompt + new, (prompt, new) != (64, 192),
+            spec, os.environ.get("BENCH_DECODE_SPEC_DRAFT", "self"),
         )
     for line in reversed(lines):
         line = line.strip()
@@ -655,7 +715,7 @@ def _latest_logged_tpu(workload: str):
         if not metric.startswith(prefix) or "cpufallback" in metric:
             continue
         if decode_tags is not None:
-            markers = ("_gqa", "_w", "_flashdec", "_L")
+            markers = ("_gqa", "_w", "_flashdec", "_L", "_speck")
             if any(
                 (tag and tag + "_" not in metric)
                 or (not tag and marker in metric)
@@ -705,13 +765,20 @@ def _probe_backend(timeout: int):
     ~20 min of the driver window on eight full-price probes of a
     tunnel already known to be wedged).
     """
+    # "Up" means EXECUTABLE, not merely enumerable: the round-4 window
+    # log (BENCH_HW.md) records a mode where jax.devices() answered
+    # twice and the first real compile then hung for 25 minutes.  A
+    # scalar jit round-trip costs ~1 s on a working backend and turns
+    # that mode into a cheap probe failure instead of a burned
+    # BENCH_ATTEMPT_TIMEOUT.
     try:
         proc = _run_tracked(
             [
                 sys.executable,
                 "-c",
                 "import jax; d = jax.devices(); "
-                "print(d[0].platform, len(d))",
+                "v = float(jax.jit(lambda x: x + 1)(1.0)); "
+                "print(d[0].platform, len(d), v)",
             ],
             timeout, cwd=_REPO_ROOT,
         )

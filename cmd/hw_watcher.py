@@ -45,9 +45,15 @@ import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# The probe requires an EXECUTED scalar jit, not just enumeration: the
+# round-4 tunnel window (BENCH_HW.md) answered jax.devices() and then
+# hung the first real compile for 25 minutes.  Firing the suite on an
+# enumerable-but-not-executable backend burns every stage timeout.
 PROBE_CMD = (
     f"{shlex.quote(sys.executable)} -c "
-    "'import jax; d = jax.devices(); print(d[0].platform, len(d))'"
+    "'import jax; d = jax.devices(); "
+    "v = float(jax.jit(lambda x: x + 1)(1.0)); "
+    "print(d[0].platform, len(d), v)'"
 )
 
 # The `make bench-hw` suite, in VERDICT round-3 priority order: the
@@ -73,6 +79,7 @@ _DECODE_DEFAULTS = {
     "BENCH_DECODE_FLASH": "0",
     "BENCH_DECODE_PROMPT": "64",
     "BENCH_DECODE_NEW": "192",
+    "BENCH_DECODE_SPEC": "0",
 }
 
 DEFAULT_STAGES = [
@@ -97,6 +104,21 @@ DEFAULT_STAGES = [
      "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "bench_decode_int8", "cmd": [sys.executable, "bench.py"],
      "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_WEIGHTS="int8"),
+     "timeout": _BENCH_STAGE_TIMEOUT},
+    # Speculative-decoding machinery bounds (models/speculative.py):
+    # draft=self (acceptance ~1, full-price draft) and draft=1L
+    # (acceptance ~0) bracket the verify-chunk + round overhead;
+    # random-init weights can't show a deployed speedup, so these
+    # measure mechanics, not the headline.
+    {"name": "bench_decode_spec_self",
+     "cmd": [sys.executable, "bench.py"],
+     "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_SPEC="4",
+                 BENCH_DECODE_SPEC_DRAFT="self"),
+     "timeout": _BENCH_STAGE_TIMEOUT},
+    {"name": "bench_decode_spec_1l",
+     "cmd": [sys.executable, "bench.py"],
+     "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_SPEC="4",
+                 BENCH_DECODE_SPEC_DRAFT="1L"),
      "timeout": _BENCH_STAGE_TIMEOUT},
     # Long-context decode A/B: einsum-over-masked-buffer vs the
     # flash-decode kernel's streamed+skipped reads, same 2048 cache.

@@ -1,0 +1,174 @@
+"""Greedy speculative decoding: draft k cheap tokens, verify them in
+ONE target forward.
+
+Plain decode is HBM-bound: every generated token re-reads all target
+params and cache (the roofline bench.py's decode workload measures).
+Speculative decoding converts k of those sequential reads into one
+MXU-dense (k+1)-token verify chunk — the chunk re-reads params ONCE for
+k+1 positions, so accepted drafts cost ~1/k of the bandwidth.  This is
+the serving-side counterpart of prefill's batching (generate.py phase
+1), applied to the decode phase.
+
+The invariant that makes it testable: with greedy acceptance the output
+is EXACTLY the target model's own greedy continuation — the draft can
+only change the speed, never a token.  Concretely, each round:
+
+1. draft autoregressively proposes ``d_1..d_k`` (k+1 single-token
+   steps — the extra step keeps the draft's own cache complete when
+   all k are accepted);
+2. the target runs ONE forward over ``[t_last, d_1..d_k]`` at
+   positions ``p0..p0+k`` (the same chunked-continuation the batched
+   prefill uses, so it hits the MXU);
+3. the longest prefix of drafts matching the target's argmax at each
+   position is accepted, plus the target's own token at the first
+   divergence (or the bonus token when everything matched): ``m+1``
+   tokens per round for ``m`` accepted drafts;
+4. both caches' write cursors rewind to the new head position — stale
+   slots beyond the cursor are dead, exactly like bucket-padding slots
+   (generate.py's ``_rewind_cache_index`` semantics): the visibility
+   mask hides them and in-order writes overwrite them.
+
+Everything is static-shape: the round is a ``lax.while_loop`` whose
+body runs a fixed k+1-step draft scan and one fixed (k+1)-token verify,
+so the whole generation jits once per (prompt bucket, max_new, k).
+
+The reference has no model runtime; within this framework the
+counterpart contracts are generate.py (greedy == iterated train argmax)
+and batching.py (fleet == per-request) — this module extends that
+exactness chain to the draft/verify composition.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from container_engine_accelerators_tpu.models.generate import (
+    _rewind_cache_index,
+    prefill,
+)
+
+
+def generate_speculative(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    k: int = 4,
+    prompt_len=None,
+):
+    """Greedy-decode ``max_new_tokens`` past ``prompt`` [B, P] with
+    k-token speculation -> (tokens [B, P+N], stats).
+
+    Both models must be built with ``decode=True`` and share the
+    vocabulary.  ``prompt_len`` has generate()'s bucket-padding
+    semantics (may be traced).  ``stats`` is a dict of arrays:
+    ``rounds`` (scalar), ``drafted``/``accepted`` ([B], counted only
+    while the sample was still generating) — acceptance rate =
+    accepted/drafted is the lever that decides the realized speedup.
+
+    Output layout matches generate(): positions [prompt_len,
+    prompt_len + max_new_tokens) hold the generated tokens, and they
+    equal the target model's own greedy continuation token-for-token.
+    """
+    if not (model.decode and draft_model.decode):
+        raise ValueError(
+            "generate_speculative() needs decode=True models")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    b, plen = prompt.shape
+    if prompt_len is None:
+        prompt_len = plen
+    prompt_len = jnp.asarray(prompt_len, jnp.int32)
+    # Margin: the final round can overshoot by up to k extra tokens,
+    # and finished samples keep clamp-writing into the tail margin
+    # while stragglers catch up.
+    total = plen + max_new_tokens + k + 1
+
+    t_cache, t_last_logits = prefill(model, params, prompt, prompt_len,
+                                     total)
+    d_cache, _ = prefill(draft_model, draft_params, prompt, prompt_len,
+                         total)
+
+    tok0 = jnp.argmax(t_last_logits, axis=-1).astype(prompt.dtype)
+    out = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens + k + 1), prompt.dtype)],
+        axis=1,
+    )
+    out = jax.lax.dynamic_update_slice(out, tok0[:, None], (0, prompt_len))
+
+    g0 = jnp.ones((b,), jnp.int32)  # tok0 already emitted
+    stats0 = {
+        "rounds": jnp.zeros((), jnp.int32),
+        "drafted": jnp.zeros((b,), jnp.int32),
+        "accepted": jnp.zeros((b,), jnp.int32),
+    }
+
+    def cond(carry):
+        _, _, _, g, _, _ = carry
+        return jnp.min(g) < max_new_tokens
+
+    def body(carry):
+        t_cache, d_cache, out, g, t_last, stats = carry
+        active = g < max_new_tokens
+        p0 = prompt_len + g - 1  # [B] position of t_last
+
+        # Draft phase: k+1 single-token steps (feed t_last, then each
+        # proposal; the last feed only completes the draft cache).
+        def dstep(c, _):
+            d_cache, tok, pos = c
+            logits, mut = draft_model.apply(
+                {"params": draft_params, "cache": d_cache},
+                tok[:, None],
+                positions=pos[:, None],
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(tok.dtype)
+            return (mut["cache"], nxt, pos + 1), nxt
+
+        (d_cache, _, _), drafts = jax.lax.scan(
+            dstep, (d_cache, t_last, p0), None, length=k + 1
+        )
+        drafts = drafts.transpose(1, 0)[:, :k]  # [B, k]: d_1..d_k
+
+        # Verify phase: ONE chunked target forward.
+        chunk = jnp.concatenate([t_last[:, None], drafts], axis=1)
+        pos_chunk = p0[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
+        logits, mut = model.apply(
+            {"params": params, "cache": t_cache},
+            chunk,
+            positions=pos_chunk,
+            mutable=["cache"],
+        )
+        t_cache = mut["cache"]
+        tgt_choice = jnp.argmax(logits, axis=-1).astype(t_last.dtype)
+
+        # m = longest matching prefix; emit d_1..d_m + target's token.
+        matches = (drafts == tgt_choice[:, :k]).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # [B]
+        next_tok = jnp.take_along_axis(
+            tgt_choice, m[:, None], axis=1)[:, 0]
+        row = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), drafts.dtype)], axis=1)
+        row = row.at[jnp.arange(b), m].set(next_tok)
+
+        out = jax.vmap(
+            lambda o, r, off: jax.lax.dynamic_update_slice(o, r, (off,))
+        )(out, row, prompt_len + g)
+
+        g = g + m + 1
+        t_cache = _rewind_cache_index(t_cache, prompt_len + g - 1)
+        d_cache = _rewind_cache_index(d_cache, prompt_len + g - 1)
+        stats = {
+            "rounds": stats["rounds"] + 1,
+            "drafted": stats["drafted"] + jnp.where(active, k, 0),
+            "accepted": stats["accepted"] + jnp.where(active, m, 0),
+        }
+        return t_cache, d_cache, out, g, next_tok, stats
+
+    _, _, out, _, _, stats = jax.lax.while_loop(
+        cond, body, (t_cache, d_cache, out, g0, tok0, stats0)
+    )
+    return out[:, : plen + max_new_tokens], stats

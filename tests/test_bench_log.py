@@ -247,6 +247,28 @@ def test_decode_workload_cpu_smoke(bench, monkeypatch, kv, weights, want):
         assert r["params"] < 60_000  # k/v projections shrank
 
 
+@pytest.mark.slow
+@pytest.mark.parametrize("draft,want_accept", [
+    ("self", 1.0),   # draft == target: every proposal accepted
+    ("1L", None),    # shallow random draft: rate is just reported
+])
+def test_decode_spec_cpu_smoke(bench, monkeypatch, draft, want_accept):
+    """BENCH_DECODE_SPEC: the speculative variant must produce a
+    well-formed, spec-tagged result with acceptance stats."""
+    monkeypatch.setenv("BENCH_DECODE_SPEC", "2")
+    monkeypatch.setenv("BENCH_DECODE_SPEC_DRAFT", draft)
+    r = bench._run_decode(on_accel=False)
+    assert r["metric"] == (
+        f"decode_2L_speck2{draft}_bf16_tokens_per_sec_1chip_cpufallback")
+    assert r["value"] > 0
+    assert r["spec_k"] == 2 and r["spec_draft"] == draft
+    assert r["spec_rounds"] >= 1
+    if want_accept is not None:
+        assert r["spec_accept_rate"] == want_accept
+    else:
+        assert 0.0 <= r["spec_accept_rate"] <= 1.0
+
+
 def test_decode_prefix_roundtrip(bench, monkeypatch):
     """_latest_logged_tpu('decode') must find decode entries, never
     cross-match the lm training prefix, and never let the MHA and GQA
@@ -280,6 +302,19 @@ def test_decode_prefix_roundtrip(bench, monkeypatch):
     assert bench._latest_logged_tpu("decode")["value"] == 4.0
     monkeypatch.setenv("BENCH_DECODE_FLASH", "1")
     assert bench._latest_logged_tpu("decode")["value"] == 5.0
+    # Speculative entries are a variant of their own: never a stand-in
+    # for plain decode, and the self/1L drafts never for each other.
+    monkeypatch.delenv("BENCH_DECODE_PROMPT", raising=False)
+    monkeypatch.delenv("BENCH_DECODE_NEW", raising=False)
+    monkeypatch.delenv("BENCH_DECODE_FLASH", raising=False)
+    bench._log_tpu_result(
+        {"metric": "decode_12L_speck4self_bf16_tokens_per_sec_1chip",
+         "value": 6.0})
+    assert bench._latest_logged_tpu("decode")["value"] == 2.0  # defaults
+    monkeypatch.setenv("BENCH_DECODE_SPEC", "4")
+    assert bench._latest_logged_tpu("decode")["value"] == 6.0
+    monkeypatch.setenv("BENCH_DECODE_SPEC_DRAFT", "1L")
+    assert bench._latest_logged_tpu("decode") is None  # no 1L entry yet
 
 
 def test_committed_log_is_valid_and_has_tpu_entry():

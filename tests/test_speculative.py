@@ -1,0 +1,138 @@
+"""Speculative decoding exactness (models/speculative.py).
+
+The whole value of greedy speculative decoding is that it is a pure
+speed transform: the draft model can only change WHEN tokens are
+produced, never WHICH.  Every test here pins spec output ==
+``generate()``'s greedy output token-for-token under a different draft
+regime — perfect (draft == target), adversarial (independently random
+draft), weaker architecture (fewer layers), plus the bucket-padding
+seam and GQA composition the serving stack relies on.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from container_engine_accelerators_tpu.models.generate import generate
+from container_engine_accelerators_tpu.models.lm_train import (
+    create_lm_train_state,
+)
+from container_engine_accelerators_tpu.models.speculative import (
+    generate_speculative,
+)
+from container_engine_accelerators_tpu.models.transformer import (
+    transformer_lm,
+)
+
+CFG = dict(vocab_size=97, num_layers=2, num_heads=2, head_dim=8,
+           mlp_dim=32)
+DRAFT_CFG = dict(CFG, num_layers=1)
+
+PROMPT = jnp.asarray([[5, 17, 42], [88, 3, 9]], jnp.int32)
+
+
+def _params(cfg, seed):
+    state = create_lm_train_state(
+        transformer_lm(**cfg), jax.random.PRNGKey(seed),
+        jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+    )
+    return state.params
+
+
+@pytest.fixture(scope="module")
+def target_params():
+    return _params(CFG, 3)
+
+
+@pytest.fixture(scope="module")
+def reference(target_params):
+    """The target's own greedy continuation — the contract output."""
+    return generate(transformer_lm(**CFG, decode=True), target_params,
+                    PROMPT, 12)
+
+
+@pytest.mark.parametrize("k", [1, 3, 5])
+def test_self_draft_is_exact_and_accepts_everything(
+        target_params, reference, k):
+    """draft == target: every proposal must be accepted and the output
+    must still be the plain greedy continuation."""
+    model = transformer_lm(**CFG, decode=True)
+    out, stats = generate_speculative(
+        model, target_params, model, target_params, PROMPT, 12, k=k)
+    assert (out == reference).all()
+    assert (stats["accepted"] == stats["drafted"]).all()
+    assert int(stats["drafted"].min()) > 0
+
+
+def test_random_draft_is_exact(target_params, reference):
+    """An independently-initialized draft (agrees with the target only
+    by luck) must still yield the exact target continuation — only the
+    acceptance rate may suffer."""
+    model = transformer_lm(**CFG, decode=True)
+    draft_params = _params(CFG, 999)
+    out, stats = generate_speculative(
+        model, target_params, model, draft_params, PROMPT, 12, k=4)
+    assert (out == reference).all()
+    assert (stats["accepted"] <= stats["drafted"]).all()
+
+
+def test_small_draft_is_exact(target_params, reference):
+    """The realistic deployment shape: a shallower draft model."""
+    model = transformer_lm(**CFG, decode=True)
+    draft = transformer_lm(**DRAFT_CFG, decode=True)
+    out, _ = generate_speculative(
+        model, target_params, draft, _params(DRAFT_CFG, 7), PROMPT, 12,
+        k=4)
+    assert (out == reference).all()
+
+
+def test_bucket_padded_prompt_matches_exact_length(target_params):
+    """generate()'s bucket-padding seam must survive the composition:
+    padded prompt + traced prompt_len == exact-length call."""
+    model = transformer_lm(**CFG, decode=True)
+    draft_params = _params(CFG, 999)
+    exact, _ = generate_speculative(
+        model, target_params, model, draft_params, PROMPT, 8, k=3)
+    padded = jnp.concatenate(
+        [PROMPT, jnp.zeros((2, 5), jnp.int32)], axis=1)
+    got, _ = generate_speculative(
+        model, target_params, model, draft_params, padded, 8, k=3,
+        prompt_len=3)
+    want_len = PROMPT.shape[1] + 8
+    assert (got[:, :want_len] == exact[:, :want_len]).all()
+
+
+def test_gqa_target_is_exact():
+    """Spec decode composes with GQA (grouped decode einsums)."""
+    gqa = dict(CFG, num_heads=4, num_kv_heads=2)
+    params = _params(gqa, 11)
+    model = transformer_lm(**gqa, decode=True)
+    want = generate(model, params, PROMPT, 10)
+    out, _ = generate_speculative(
+        model, params, model, _params(gqa, 12), PROMPT, 10, k=2)
+    assert (out == want).all()
+
+
+def test_jit_compatible(target_params, reference):
+    """One compile covers the whole generation (static max_new, k)."""
+    model = transformer_lm(**CFG, decode=True)
+    draft_params = _params(CFG, 999)
+    fn = jax.jit(
+        lambda p, dp, prompt: generate_speculative(
+            model, p, model, dp, prompt, 12, k=4)
+    )
+    out, stats = fn(target_params, draft_params, PROMPT)
+    assert (out == reference).all()
+    assert int(stats["rounds"]) >= 1
+
+
+def test_rejects_non_decode_model_and_bad_k(target_params):
+    train_mode = transformer_lm(**CFG)
+    decode = transformer_lm(**CFG, decode=True)
+    with pytest.raises(ValueError, match="decode=True"):
+        generate_speculative(train_mode, target_params, decode,
+                             target_params, PROMPT, 4)
+    with pytest.raises(ValueError, match="k must be"):
+        generate_speculative(decode, target_params, decode,
+                             target_params, PROMPT, 4, k=0)
