@@ -69,6 +69,28 @@ def parse_args(argv=None):
                    help="tensor-parallel degree: shard params Megatron-"
                         "style over this many local devices (decode "
                         "output is exactly the single-device tokens)")
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="speculative decoding for greedy requests "
+                        "(models/speculative.py): a draft model "
+                        "proposes K tokens per round, the target "
+                        "verifies them in one chunked forward; output "
+                        "is token-exact vs plain greedy.  0 = off; "
+                        "incompatible with --slots and --tp > 1")
+    p.add_argument("--draft-layers", type=int, default=0,
+                   help="draft depth for --speculative (0 = "
+                        "num_layers/4, min 1)")
+    p.add_argument("--draft-checkpoint-dir", default=None,
+                   help="orbax checkpoint for the draft model (a "
+                        "trained draft is what makes speculation pay; "
+                        "without one the draft is random-init and "
+                        "acceptance is ~1/vocab)")
+    p.add_argument("--prefix-cache", type=int, default=0, metavar="N",
+                   help="cache up to N shared prompt prefixes' KV "
+                        "blocks (models/prefix_cache.py): requests "
+                        "carrying \"prefix_ids\" prefill only their "
+                        "suffix after the first hit.  0 = off; "
+                        "incompatible with --slots, --tp > 1 and "
+                        "--speculative")
     return p.parse_args(argv)
 
 
@@ -157,6 +179,54 @@ def build_generate(args):
         params = jax.device_put(params, shard_params(params, tp_mesh))
         log.info("params sharded %d-way tensor parallel", args.tp)
 
+    # Speculative decoding (greedy requests only — the acceptance rule
+    # is argmax-match): a shallow draft proposes K tokens, the target
+    # verifies them in one chunked forward.  Exactness is free
+    # (models/speculative.py), speed depends on the draft actually
+    # predicting the target — load a trained draft for that.
+    spec_run = None
+    if args.speculative:
+        from container_engine_accelerators_tpu.models.speculative import (
+            generate_speculative,
+        )
+
+        d_cfg = dict(cfg, num_layers=args.draft_layers
+                     or max(1, args.num_layers // 4))
+        d_state = create_lm_train_state(
+            transformer_lm(**d_cfg), jax.random.PRNGKey(1), sample,
+            tx=optax.adamw(3e-4, weight_decay=0.1),
+        )
+        if args.draft_checkpoint_dir:
+            from container_engine_accelerators_tpu.models.checkpoint import (
+                TrainCheckpointer,
+            )
+
+            ck = TrainCheckpointer(
+                os.path.abspath(args.draft_checkpoint_dir))
+            d_state, d_step = ck.restore_latest(d_state)
+            ck.close()
+            log.info("draft: %s params from %s",
+                     f"step-{d_step}" if d_step is not None
+                     else "NO checkpoint found; random",
+                     args.draft_checkpoint_dir)
+        else:
+            log.info("draft: randomly-initialized %d-layer model "
+                     "(exact but acceptance ~1/vocab; train one with "
+                     "cmd/train_lm.py for real speedup)",
+                     d_cfg["num_layers"])
+        draft_model = transformer_lm(
+            **d_cfg, decode=True, use_flash_decode=args.flash_decode)
+        draft_params = d_state.params
+
+        @jax.jit
+        def spec_run(prompt, prompt_len):
+            out, stats = generate_speculative(
+                decode_model, params, draft_model, draft_params,
+                prompt, args.max_new_tokens, k=args.speculative,
+                prompt_len=prompt_len,
+            )
+            return out, stats["accepted"].sum(), stats["drafted"].sum()
+
     # The compile-cache key is (prompt BUCKET, sample?) only — nothing
     # a client controls beyond ~log2(max_prompt_len)*2 entries (ADVICE
     # r03: per-exact-length keys plus an honored per-request max_new
@@ -172,18 +242,66 @@ def build_generate(args):
             prompt_len=prompt_len,
         )
 
-    def run(*a):
-        return _run(*a)
+    import threading
+
+    stats_lock = threading.Lock()
+
+    def run(prompt, prompt_len, temperature, seed, sample):
+        if spec_run is not None and not sample:
+            out, acc, dr = spec_run(prompt, prompt_len)
+            # Rolling acceptance telemetry.  `+=` on an attribute is
+            # load/add/store — not atomic under threaded handlers — so
+            # the counters take the lock.
+            with stats_lock:
+                run.spec_accepted += int(acc)
+                run.spec_drafted += int(dr)
+                log.debug("spec acceptance: %d/%d",
+                          run.spec_accepted, run.spec_drafted)
+            return out
+        return _run(prompt, prompt_len, temperature, seed, sample)
+
+    run.spec_accepted = 0
+    run.spec_drafted = 0
+
+    # Prefix caching: requests that mark their shared system prompt
+    # ("prefix_ids") prefill only the suffix once the prefix KV is
+    # cached.  Compile keys: (prefix bucket, suffix bucket, sample) —
+    # bounded log^2, nothing request-controlled beyond bucket choice.
+    run.prefix_cache = None
+    if args.prefix_cache:
+        from container_engine_accelerators_tpu.models.prefix_cache import (
+            PrefixCache,
+            generate_with_prefix,
+        )
+
+        run.prefix_cache = PrefixCache(
+            decode_model, params, max_prefix_len=args.max_prompt_len,
+            max_entries=args.prefix_cache,
+        )
+
+        @functools.partial(jax.jit, static_argnums=(6,))
+        def _run_prefix(prefix_kv, prefix_len, suffix, suffix_len,
+                        temperature, seed, sample):
+            return generate_with_prefix(
+                decode_model, params, prefix_kv, prefix_len, suffix,
+                args.max_new_tokens,
+                temperature=temperature if sample else 0.0,
+                rng=jax.random.PRNGKey(seed),
+                suffix_len=suffix_len,
+            )
+
+        run.run_prefix = _run_prefix
 
     # The continuous-batching engine (main, --slots) reuses the exact
     # model/params this closure serves.
     run.decode_model = decode_model
     run.params = params
 
-    # Warm the compile cache for a representative shape.
+    # Warm the compile cache for a representative shape (the greedy
+    # path — which is spec_run when speculation is on).
     warm = bucket_len(1, args.max_prompt_len)
-    run(jnp.zeros((1, warm), jnp.int32), 1, 0.0, 0,
-        False).block_until_ready()
+    jax.block_until_ready(
+        run(jnp.zeros((1, warm), jnp.int32), 1, 0.0, 0, False))
     return run
 
 
@@ -247,7 +365,45 @@ def make_handler(run, args, engine_loop=None):
                      for t in p][: args.max_prompt_len] or [0]
                     for p in prompts
                 ]
-                if engine_loop is not None and temperature == 0:
+                # Optional shared system prompt.  With the prefix
+                # cache on, its KV is prefilled once and spliced; on
+                # any other path (cache off, engine, prefix too long)
+                # it degrades to plain concatenation — same tokens,
+                # full-price prefill.
+                prefix_ids = [int(t) % args.vocab_size
+                              for t in (req.get("prefix_ids") or [])]
+                # The admission bound is the SAME on every path: the
+                # combined context (prefix + suffix) is capped at
+                # --max-prompt-len, so a request returns identical
+                # tokens whether or not the cache path engages.
+                use_prefix = (
+                    getattr(run, "prefix_cache", None) is not None
+                    and 0 < len(prefix_ids) < args.max_prompt_len
+                    and engine_loop is None
+                )
+                if prefix_ids and not use_prefix:
+                    clean = [
+                        (prefix_ids + ids)[: args.max_prompt_len]
+                        for ids in clean
+                    ]
+                if use_prefix:
+                    room = args.max_prompt_len - len(prefix_ids)
+                    kv, pfx_len = run.prefix_cache.get_or_build(
+                        tuple(prefix_ids))
+                    toks = []
+                    for i, ids in enumerate(clean):
+                        ids = ids[:room]
+                        plen = len(ids)
+                        bucket = bucket_len(plen, args.max_prompt_len)
+                        padded = ids + [0] * (bucket - plen)
+                        out = np.asarray(run.run_prefix(
+                            kv, pfx_len,
+                            jnp.asarray([padded], jnp.int32), plen,
+                            temperature, seed + i, temperature > 0,
+                        ))
+                        toks.append(prefix_ids + out[0][
+                            : plen + max_new].tolist())
+                elif engine_loop is not None and temperature == 0:
                     # Continuous batching: all of this request's
                     # prompts join the shared decode fleet CONCURRENTLY
                     # (greedy lanes only; sampling keeps the
@@ -283,6 +439,18 @@ def main(argv=None):
     if args.slots and args.tp > 1:
         raise SystemExit("--slots and --tp > 1 are mutually exclusive "
                          "(the engine's cache is single-device)")
+    if args.speculative and args.slots:
+        raise SystemExit("--speculative and --slots are mutually "
+                         "exclusive: greedy requests would route to the "
+                         "engine and the draft would never run")
+    if args.speculative and args.tp > 1:
+        raise SystemExit("--speculative and --tp > 1 are mutually "
+                         "exclusive (the draft runs single-device)")
+    if args.prefix_cache and (args.slots or args.tp > 1
+                              or args.speculative):
+        raise SystemExit("--prefix-cache composes with the plain "
+                         "per-request path only (not --slots, --tp or "
+                         "--speculative) for now")
     run = build_generate(args)
     engine_loop = None
     if args.slots:

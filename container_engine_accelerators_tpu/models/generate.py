@@ -56,6 +56,44 @@ def _rewind_cache_index(cache, position):
     return jax.tree_util.tree_map_with_path(rewind, cache)
 
 
+def prefill_continue(model, params, cache, tokens: jax.Array, start,
+                     true_end):
+    """Continue a prefill: one MXU-dense forward over ``tokens`` [B, S]
+    at positions ``start + arange(S)`` into an EXISTING cache whose
+    write cursor sits at ``start`` -> (cache cued at ``true_end``, last
+    real position's logits).
+
+    ``start`` and ``true_end`` may be traced; ``true_end`` is the total
+    number of real tokens now in the cache (``start`` + the count of
+    real leading ``tokens`` — the tail beyond it is bucket padding with
+    the usual dead-slot semantics).  This is the chunked-continuation
+    primitive shared by :func:`prefill` (start=0), the prefix-cache
+    suffix path (models/prefix_cache.py), and conceptually by the
+    speculative verify chunk (models/speculative.py inlines the same
+    apply pattern to keep its per-round logits).
+    """
+    s = tokens.shape[1]
+    start = jnp.asarray(start, jnp.int32)
+    hidden, mutated = model.apply(
+        {"params": params, "cache": cache},
+        tokens,
+        positions=start + jnp.arange(s, dtype=jnp.int32),
+        mutable=["cache"],
+        project=False,
+    )
+    cache = _rewind_cache_index(mutated["cache"], true_end)
+    h_last = jax.lax.dynamic_index_in_dim(
+        hidden, jnp.maximum(true_end - start - 1, 0), axis=1,
+        keepdims=False,
+    )
+    emb = params["embed"]["embedding"]
+    last = jnp.dot(
+        h_last, emb.T.astype(h_last.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return cache, last
+
+
 def prefill(model, params, prompt: jax.Array, prompt_len, max_len: int):
     """Batched prefill -> (cache cued at ``prompt_len``, last logits).
 
@@ -67,25 +105,8 @@ def prefill(model, params, prompt: jax.Array, prompt_len, max_len: int):
     by :func:`generate` and the continuous-batching engine
     (models/batching.py).
     """
-    b, plen = prompt.shape
-    cache = init_cache(model, b, max_len)
-    hidden, mutated = model.apply(
-        {"params": params, "cache": cache},
-        prompt,
-        positions=jnp.arange(plen),
-        mutable=["cache"],
-        project=False,
-    )
-    cache = _rewind_cache_index(mutated["cache"], prompt_len)
-    h_last = jax.lax.dynamic_index_in_dim(
-        hidden, jnp.maximum(prompt_len - 1, 0), axis=1, keepdims=False
-    )
-    emb = params["embed"]["embedding"]
-    last = jnp.dot(
-        h_last, emb.T.astype(h_last.dtype),
-        preferred_element_type=jnp.float32,
-    )
-    return cache, last
+    cache = init_cache(model, prompt.shape[0], max_len)
+    return prefill_continue(model, params, cache, prompt, 0, prompt_len)
 
 
 def generate(
@@ -124,25 +145,44 @@ def generate(
     """
     if not model.decode:
         raise ValueError("generate() needs a model built with decode=True")
-    greedy = isinstance(temperature, (int, float)) and temperature == 0
     b, plen = prompt.shape
     if prompt_len is None:
         prompt_len = plen
     max_len = plen + max_new_tokens
+
+    # Phase 1: batched prefill (shared helper; see prefill()).
+    cache, last = prefill(model, params, prompt, prompt_len, max_len)
+    gen = decode_loop(model, params, cache, last, prompt_len,
+                      max_new_tokens, temperature, rng, prompt.dtype)
+
+    out = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1
+    )
+    return jax.lax.dynamic_update_slice(out, gen, (0, prompt_len))
+
+
+def decode_loop(model, params, cache, last_logits, prompt_len,
+                max_new_tokens: int, temperature, rng, dtype):
+    """Phase-2 decode: sample from ``last_logits`` then scan
+    ``max_new_tokens - 1`` single-token steps -> generated [B, N].
+
+    The cache must be cued at ``prompt_len`` (what :func:`prefill` or
+    the prefix-cache suffix path leaves behind).  ``temperature``
+    follows generate()'s greedy-vs-sampling rule (Python 0 is
+    structural greedy; any tracer samples).
+    """
+    greedy = isinstance(temperature, (int, float)) and temperature == 0
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def sample_from(nxt_logits, rng):
         if greedy:
-            return jnp.argmax(nxt_logits, axis=-1).astype(prompt.dtype), rng
+            return jnp.argmax(nxt_logits, axis=-1).astype(dtype), rng
         rng, sub = jax.random.split(rng)
         tok = jax.random.categorical(sub, nxt_logits / temperature)
-        return tok.astype(prompt.dtype), rng
+        return tok.astype(dtype), rng
 
-    # Phase 1: batched prefill (shared helper; see prefill()).
-    cache, last = prefill(model, params, prompt, prompt_len, max_len)
-    tok0, rng = sample_from(last, rng)
+    tok0, rng = sample_from(last_logits, rng)
 
-    # Phase 2: decode scan over the remaining max_new_tokens - 1 steps.
     def step(carry, pos):
         cache, tok, rng = carry
         step_logits, mutated = model.apply(
@@ -158,9 +198,4 @@ def generate(
     # first), so the scan covers max_new_tokens - 1 further positions.
     positions = prompt_len + jnp.arange(max_new_tokens - 1, dtype=jnp.int32)
     (_, _, _), rest = jax.lax.scan(step, (cache, tok0, rng), positions)
-    gen = jnp.concatenate([tok0[:, None], rest.transpose(1, 0)], axis=1)
-
-    out = jnp.concatenate(
-        [prompt, jnp.zeros((b, max_new_tokens), prompt.dtype)], axis=1
-    )
-    return jax.lax.dynamic_update_slice(out, gen, (0, prompt_len))
+    return jnp.concatenate([tok0[:, None], rest.transpose(1, 0)], axis=1)

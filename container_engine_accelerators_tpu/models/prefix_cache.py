@@ -1,0 +1,185 @@
+"""Prefix caching: prefill a shared prompt prefix once, reuse its KV
+across requests.
+
+Serving fleets front most requests with the same system prompt; plain
+``generate()`` re-runs the full prefill for every request, re-spending
+MXU FLOPs (and wall-clock TTFT) on tokens whose KV never changes.  This
+module caches the prefix's KV block after one prefill and splices it
+into each request's fresh cache, so the per-request prefill covers only
+the suffix.
+
+TPU-first mechanics — everything rides the invariants the serving
+stack already pins:
+
+- the stored block is the prefix prefill's cache at its power-of-two
+  BUCKET length (one compile per bucket, like prompt bucketing); slots
+  beyond the true ``prefix_len`` hold dead pad KV;
+- splicing is a ``dynamic_update_slice`` of the block into slot 0 of
+  the request's zero cache, cursor set to ``prefix_len`` — from there
+  the suffix continues through :func:`generate.prefill_continue` at
+  positions ``prefix_len + arange(S)`` and decode proceeds normally;
+- dead slots (prefix pad, suffix pad, anything beyond the cursor) are
+  invisible by the slot<=position mask until overwritten in order —
+  the same dead-slot argument as bucket padding (generate.py), just
+  starting from a non-zero cursor.
+
+The compile-cache footprint is (prefix buckets) x (suffix buckets) —
+bounded log^2, nothing request-controlled (the ADVICE r03 lesson).
+
+Exactness contract (tests/test_prefix_cache.py): splice + suffix
+prefill + decode == ``generate()`` over the concatenated prompt,
+token-for-token, greedy and seeded-sampled, MHA and GQA.
+
+The reference has no serving runtime; the in-framework altitude analog
+is the continuous-batching engine (models/batching.py), which shares
+the bucket grammar via ``bucket_len``.
+"""
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from container_engine_accelerators_tpu.models.batching import bucket_len
+from container_engine_accelerators_tpu.models.generate import (
+    decode_loop,
+    init_cache,
+    prefill,
+    prefill_continue,
+)
+
+
+def _splice_prefix(cache, prefix_kv, prefix_len, batch: int):
+    """Write the stored prefix block into slot 0 of a fresh cache and
+    cue the cursor at ``prefix_len``.  The stored block is [1, PFX, ...]
+    and broadcasts over the request batch (a shared prefix is shared by
+    every sequence in the request)."""
+    def splice(path, big, small):
+        key = getattr(path[-1], "key", None)
+        if key in ("cached_key", "cached_value"):
+            # Leaf layout is [..., B, T, heads, dim] — under nn.scan a
+            # leading layer axis precedes the batch axis, so address
+            # batch as ndim-4, never axis 0.
+            bshape = small.shape[:-4] + (batch,) + small.shape[-3:]
+            block = jnp.broadcast_to(small, bshape)
+            return jax.lax.dynamic_update_slice(
+                big, block.astype(big.dtype), (0,) * big.ndim)
+        if key == "cache_index":
+            return jnp.zeros_like(big) + jnp.asarray(prefix_len, big.dtype)
+        return big
+
+    return jax.tree_util.tree_map_with_path(splice, cache, prefix_kv)
+
+
+def generate_with_prefix(
+    model,
+    params,
+    prefix_kv,
+    prefix_len,
+    suffix: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    suffix_len=None,
+):
+    """Generate past (cached prefix + ``suffix`` [B, S]) -> [B, S+N].
+
+    ``prefix_kv`` is a :class:`PrefixCache` entry's KV tree (bucket
+    length read from its leaves); ``prefix_len``/``suffix_len`` may be
+    traced (bucket-padding semantics as in ``generate()``).  Output
+    mirrors generate() with the suffix as the prompt: positions
+    ``[0, suffix_len)`` echo the suffix, ``[suffix_len, suffix_len+N)``
+    are generated — the caller owns re-attaching the prefix ids.
+    """
+    if not model.decode:
+        raise ValueError(
+            "generate_with_prefix() needs a model built with decode=True")
+    b, s = suffix.shape
+    if suffix_len is None:
+        suffix_len = s
+    # Bucket length lives at the T axis (ndim-3) of any KV leaf; the
+    # cache_index leaves are lower-rank and must be skipped.
+    pfx_bucket = next(
+        leaf.shape[-3]
+        for leaf in jax.tree_util.tree_leaves(prefix_kv)
+        if leaf.ndim >= 4
+    )
+    total = pfx_bucket + s + max_new_tokens
+
+    cache = init_cache(model, b, total)
+    cache = _splice_prefix(cache, prefix_kv, prefix_len, b)
+    end = prefix_len + suffix_len
+    cache, last = prefill_continue(
+        model, params, cache, suffix, prefix_len, end)
+    gen = decode_loop(model, params, cache, last, end, max_new_tokens,
+                      temperature, rng, suffix.dtype)
+
+    out = jnp.concatenate(
+        [suffix, jnp.zeros((b, max_new_tokens), suffix.dtype)], axis=1)
+    return jax.lax.dynamic_update_slice(out, gen, (0, suffix_len))
+
+
+class PrefixCache:
+    """Host-side LRU of prefilled prefix KV blocks, keyed by the exact
+    token tuple.  Thread-safe for serving handlers; misses build
+    outside the lock (two racing misses on the same new prefix cost one
+    redundant prefill, never a wrong entry)."""
+
+    def __init__(self, model, params, max_prefix_len: int,
+                 max_entries: int = 8):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_prefix_len = max_prefix_len
+        self.max_entries = max_entries
+        self._store = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        # One compile per prefix bucket (shape-keyed jit).
+        self._build = jax.jit(
+            lambda pfx, plen: prefill(
+                model, params, pfx, plen, pfx.shape[1])[0]
+        )
+
+    def get_or_build(self, ids: Tuple[int, ...]):
+        """-> (prefix_kv tree, prefix_len) for the exact prefix ``ids``.
+
+        ``ids`` longer than ``max_prefix_len`` are rejected (the caller
+        decides how to degrade — serve_lm falls back to the plain
+        path)."""
+        ids = tuple(int(t) for t in ids)
+        if not ids or len(ids) > self.max_prefix_len:
+            raise ValueError(
+                f"prefix length {len(ids)} outside (0, "
+                f"{self.max_prefix_len}]")
+        with self._lock:
+            entry = self._store.get(ids)
+            if entry is not None:
+                self._store.move_to_end(ids)
+                self.hits += 1
+                return entry
+            self.misses += 1
+        bucket = bucket_len(len(ids), self.max_prefix_len)
+        padded = jnp.asarray(
+            [list(ids) + [0] * (bucket - len(ids))], jnp.int32)
+        kv = self._build(padded, len(ids))
+        entry = (kv, len(ids))
+        with self._lock:
+            self._store[ids] = entry
+            self._store.move_to_end(ids)
+            while len(self._store) > self.max_entries:
+                self._store.popitem(last=False)
+                self.evictions += 1
+        return entry
+
+    def __len__(self):
+        with self._lock:
+            return len(self._store)
+
+    def stats(self):
+        with self._lock:
+            return {"entries": len(self._store), "hits": self.hits,
+                    "misses": self.misses, "evictions": self.evictions}

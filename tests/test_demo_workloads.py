@@ -280,3 +280,110 @@ def test_generate_job_sh_produces_valid_jobs(tmp_path):
     assert args.resnet_depth in (34, 50, 101, 152)
 
 
+
+
+@pytest.mark.slow
+def test_serve_lm_speculative_matches_plain_greedy(tmp_path):
+    """--speculative K must be a pure speed transform at the serving
+    surface: greedy tokens identical to the plain path, sampling falls
+    back, and a trained draft checkpoint loads via the shared orbax
+    path."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    tiny = ["--vocab-size", "64", "--num-layers", "2", "--num-heads", "2",
+            "--head-dim", "8", "--mlp-dim", "32", "--max-prompt-len", "8",
+            "--max-new-tokens", "6", "--port", "0"]
+    serve = _load("serve_lm_spec", "cmd", "serve_lm.py")
+    plain = serve.build_generate(serve.parse_args(tiny))
+
+    # Train a 1-layer draft so --draft-checkpoint-dir is exercised with
+    # a genuinely different (and trained) model.
+    train = _load("train_lm_draft", "cmd", "train_lm.py")
+    train.main(["--num-layers", "1", "--num-heads", "2", "--head-dim",
+                "8", "--mlp-dim", "32", "--vocab-size", "64",
+                "--seq-len", "16", "--train-batch-size", "8",
+                "--train-steps", "2", "--steps-per-eval", "1",
+                "--checkpoint-dir", str(tmp_path / "draft_ck"),
+                "--checkpoint-interval", "2"])
+    spec = serve.build_generate(serve.parse_args(
+        tiny + ["--speculative", "3", "--draft-layers", "1",
+                "--draft-checkpoint-dir", str(tmp_path / "draft_ck")]))
+
+    prompt = jnp.asarray([[5, 9, 3, 0]], jnp.int32)  # bucket, plen 3
+    want = np.asarray(plain(prompt, 3, 0.0, 0, False))
+    got = np.asarray(spec(prompt, 3, 0.0, 0, False))
+    n = 3 + 6
+    assert (got[:, :n] == want[:, :n]).all()
+    assert spec.spec_drafted > 0
+    assert 0 <= spec.spec_accepted <= spec.spec_drafted
+
+    # Sampled requests keep the plain path (spec is greedy-only).
+    out = np.asarray(spec(prompt, 3, 1.0, 42, True))
+    assert out.shape == want.shape
+
+
+def test_serve_lm_speculative_flag_exclusions():
+    serve = _load("serve_lm_spec_excl", "cmd", "serve_lm.py")
+    with pytest.raises(SystemExit, match="slots"):
+        serve.main(["--speculative", "2", "--slots", "2"])
+    with pytest.raises(SystemExit, match="tp"):
+        serve.main(["--speculative", "2", "--tp", "2"])
+    with pytest.raises(SystemExit, match="prefix-cache"):
+        serve.main(["--prefix-cache", "2", "--slots", "2"])
+    with pytest.raises(SystemExit, match="prefix-cache"):
+        serve.main(["--prefix-cache", "2", "--speculative", "2"])
+
+
+@pytest.mark.slow
+def test_serve_lm_http_prefix_cache_matches_concatenated(tmp_path):
+    """--prefix-cache N over real HTTP: a request carrying prefix_ids
+    must return exactly the tokens of the same server given the
+    concatenated prompt (full-price path), and the second request must
+    hit the cache."""
+    serve = _load("serve_lm_prefix", "cmd", "serve_lm.py")
+    tiny = ["--vocab-size", "64", "--num-layers", "1", "--num-heads",
+            "2", "--head-dim", "8", "--mlp-dim", "32",
+            "--max-prompt-len", "16", "--max-new-tokens", "4",
+            "--port", "0"]
+    args = serve.parse_args(tiny + ["--prefix-cache", "4"])
+    run = serve.build_generate(args)
+
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0),
+                              serve.make_handler(run, args))
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.load(r)
+
+    prefix = [7, 11, 13]
+    try:
+        with_pfx = post({"prefix_ids": prefix,
+                         "prompt_ids": [[1, 2], [5]]})
+        # Same server, prefix concatenated client-side: routes through
+        # the plain path (no prefix_ids field), full-price prefill.
+        concat = post({"prompt_ids": [prefix + [1, 2], prefix + [5]]})
+        assert with_pfx["tokens"] == concat["tokens"]
+        again = post({"prefix_ids": prefix, "prompt_ids": [[1, 2]]})
+        assert again["tokens"][0] == with_pfx["tokens"][0]
+        st = run.prefix_cache.stats()
+        assert st["entries"] == 1 and st["misses"] == 1
+        assert st["hits"] >= 1
+        # Admission bound identical on both paths: prefix 12 + prompt
+        # 10 overflows --max-prompt-len 16, and the cache path must
+        # truncate exactly like the concatenating fallback.
+        pfx12 = [(20 + i) % 64 for i in range(12)]
+        long_pfx = post({"prefix_ids": pfx12, "prompt_ids": [[1] * 10]})
+        long_cat = post({"prompt_ids": [pfx12 + [1] * 10]})
+        assert long_pfx["tokens"][0] == long_cat["tokens"][0]
+    finally:
+        srv.shutdown()
