@@ -6,6 +6,7 @@ NATIVE_BUILD := native/tpushim/build
 DCNXFERD_BUILD := native/dcnxferd/build
 DCNFASTSOCK_BUILD := native/dcnfastsock/build
 DCNCOLLPERF_BUILD := native/dcncollperf/build
+TOKPACK_BUILD := native/tokpack/build
 
 .PHONY: all native test test-all presubmit proto clean
 
@@ -13,7 +14,13 @@ all: native
 
 native: $(NATIVE_BUILD)/libtpushim.so $(DCNXFERD_BUILD)/dcnxferd \
 	$(DCNFASTSOCK_BUILD)/libdcnfastsock.so \
-	$(DCNCOLLPERF_BUILD)/dcn_collectives_perf
+	$(DCNCOLLPERF_BUILD)/dcn_collectives_perf \
+	$(TOKPACK_BUILD)/tokpack
+
+$(TOKPACK_BUILD)/tokpack: native/tokpack/tokpack.cc
+	mkdir -p $(TOKPACK_BUILD)
+	g++ -std=c++17 -O2 -Wall -Wextra \
+	    -o $(TOKPACK_BUILD)/tokpack native/tokpack/tokpack.cc
 
 $(DCNCOLLPERF_BUILD)/dcn_collectives_perf: native/dcncollperf/dcn_collectives_perf.cc
 	mkdir -p $(DCNCOLLPERF_BUILD)
@@ -139,4 +146,4 @@ proto:
 
 clean:
 	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD) $(DCNFASTSOCK_BUILD) \
-	    $(DCNCOLLPERF_BUILD) $(ASAN_BUILD)
+	    $(DCNCOLLPERF_BUILD) $(ASAN_BUILD) $(TOKPACK_BUILD)

@@ -52,6 +52,10 @@ def parse_args(argv=None):
     p.add_argument("--learning-rate", type=float, default=3e-4)
     p.add_argument("--train-steps", type=int, default=100)
     p.add_argument("--steps-per-eval", type=int, default=20)
+    p.add_argument("--data-dir", default=None,
+                   help="token-shard dataset dir (data/tokens.py "
+                        "format; pack one with native/tokpack).  "
+                        "Default: synthetic token streams")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-interval", type=int, default=100)
     p.add_argument("--profile-dir", default=None,
@@ -183,24 +187,51 @@ def main(argv=None):
         sp_degree = mesh.devices.shape[0]
         zz_perm = np.asarray(zigzag_permutation(args.seq_len, sp_degree))
 
-    np_rng = np.random.default_rng(0)  # same seed everywhere: global batch
-    n_batches = 4
-    batches = []
-    for _ in range(n_batches):
-        toks = np_rng.integers(
-            0, args.vocab_size, (args.train_batch_size, args.seq_len)
-        ).astype(np.int32)
-        # numpy mirror of next_token_targets on the GLOBAL sequence
-        labels = np.roll(toks, -1, axis=1)
-        mask = np.ones(toks.shape, np.float32)
-        mask[:, -1] = 0.0
+    def prepare(toks, labels, mask):
+        """zigzag-reorder (storage order) then globalize — the ONE
+        place both the synthetic and --data-dir paths go through, so
+        their sequence-parallel layout can never diverge."""
         if zz_perm is not None:
             toks, labels, mask = (
                 x[:, zz_perm] for x in (toks, labels, mask)
             )
-        batches.append(
-            (globalize(toks), globalize(labels), globalize(mask))
+        return globalize(toks), globalize(labels), globalize(mask)
+
+    # Real dataset (--data-dir) or synthetic streams.  Both produce the
+    # same GLOBAL numpy batch on every process; the loader's
+    # step->batch mapping is a pure function, so a resumed run replays
+    # exactly the batches it would have seen (data/loader.py).
+    batch_iter = None
+    if args.data_dir:
+        from container_engine_accelerators_tpu.data import (
+            TokenBatchLoader,
+            TokenShardReader,
         )
+
+        reader = TokenShardReader(args.data_dir)
+        loader = TokenBatchLoader(
+            reader, args.train_batch_size, args.seq_len,
+            vocab_size=args.vocab_size,
+        )
+        log.info("dataset: %d tokens (%d steps/epoch) from %s",
+                 reader.total_tokens, loader.steps_per_epoch(),
+                 args.data_dir)
+        batch_iter = loader.iter_batches(
+            start_step, args.train_steps - start_step)
+        batches = None
+    else:
+        np_rng = np.random.default_rng(0)  # same seed everywhere
+        n_batches = 4
+        batches = []
+        for _ in range(n_batches):
+            toks = np_rng.integers(
+                0, args.vocab_size, (args.train_batch_size, args.seq_len)
+            ).astype(np.int32)
+            # numpy mirror of next_token_targets on the GLOBAL sequence
+            labels = np.roll(toks, -1, axis=1)
+            mask = np.ones(toks.shape, np.float32)
+            mask[:, -1] = 0.0
+            batches.append(prepare(toks, labels, mask))
 
     # Maintenance drains send SIGTERM (maintenance watcher taints, then
     # Kubernetes evicts); convert it into a final synchronous checkpoint
@@ -222,7 +253,10 @@ def main(argv=None):
                                             min(10, args.train_steps - 1)):
             jax.profiler.start_trace(args.profile_dir)
             profiling = True
-        toks, labels, mask = batches[step % n_batches]
+        if batch_iter is not None:
+            toks, labels, mask = prepare(*next(batch_iter))
+        else:
+            toks, labels, mask = batches[step % n_batches]
         state, metrics = step_fn(state, toks, labels, mask)
         if profiling and step >= min(20, args.train_steps - 1):
             jax.block_until_ready(state.params)

@@ -1,0 +1,17 @@
+"""TPU-first input pipeline: memory-mapped token shards, deterministic
+step->batch mapping, background prefetch.
+
+The reference's demo trainers stream their datasets through tf.data's
+C++ runtime (demo/gpu-training/generate_job.sh:54-70 mounts ImageNet
+into the TF trainer); this package is the in-tree equivalent for the
+JAX workloads, with the resume/multi-host properties the rest of the
+framework already guarantees for model state.
+"""
+
+from container_engine_accelerators_tpu.data.tokens import (  # noqa: F401
+    TokenShardReader,
+    write_token_shards,
+)
+from container_engine_accelerators_tpu.data.loader import (  # noqa: F401
+    TokenBatchLoader,
+)

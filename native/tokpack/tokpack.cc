@@ -1,0 +1,258 @@
+// tokpack — pack pre-tokenized corpora into the framework's token-shard
+// format (data/tokens.py): NNNNN.tokens files of little-endian uint32
+// plus an index.json.
+//
+// The reference consumed its input pipeline as a vendor C++ runtime
+// (tf.data inside the demo trainer images, demo/gpu-training/
+// generate_job.sh:54-70); this is the in-tree native piece of ours:
+// the hot loop — parsing gigabytes of decimal token ids and streaming
+// them into shards — runs in C++, while the training-side reader stays
+// a ~100-line memory-mapped Python module.
+//
+// Usage:
+//   tokpack --out DIR [--shard-tokens N] FILE...   (or - for stdin)
+//
+// Input: whitespace-separated decimal token ids (any mix of spaces /
+// newlines).  Output shards hold exactly --shard-tokens tokens except
+// the last.  Exit codes: 0 ok, 1 usage, 2 I/O or parse error.
+
+#include <dirent.h>
+#include <sys/stat.h>
+
+#include <cerrno>
+#include <cinttypes>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr size_t kBufBytes = 1 << 20;
+
+struct ShardWriter {
+  std::string dir;
+  uint64_t shard_tokens;
+  std::vector<uint64_t> counts;  // tokens per finished shard
+  FILE* cur = nullptr;
+  uint64_t cur_count = 0;
+  std::vector<uint32_t> buf;
+
+  explicit ShardWriter(std::string d, uint64_t per_shard)
+      : dir(std::move(d)), shard_tokens(per_shard) {
+    buf.reserve(kBufBytes / sizeof(uint32_t));
+  }
+
+  std::string shard_path(size_t i, bool tmp) const {
+    char name[32];
+    snprintf(name, sizeof(name), "%05zu.tokens", i);
+    return dir + "/" + name + (tmp ? ".tmp" : "");
+  }
+
+  bool flush_buf() {
+    if (buf.empty()) return true;
+    size_t n = fwrite(buf.data(), sizeof(uint32_t), buf.size(), cur);
+    if (n != buf.size()) {
+      fprintf(stderr, "tokpack: write failed: %s\n", strerror(errno));
+      return false;
+    }
+    buf.clear();
+    return true;
+  }
+
+  bool add(uint32_t tok) {
+    if (cur == nullptr) {
+      std::string path = shard_path(counts.size(), /*tmp=*/true);
+      cur = fopen(path.c_str(), "wb");
+      if (cur == nullptr) {
+        fprintf(stderr, "tokpack: %s: %s\n", path.c_str(),
+                strerror(errno));
+        return false;
+      }
+      cur_count = 0;
+    }
+    buf.push_back(tok);  // uint32 little-endian on every target we build
+    cur_count++;
+    if (buf.size() * sizeof(uint32_t) >= kBufBytes && !flush_buf())
+      return false;
+    if (cur_count >= shard_tokens) return close_shard();
+    return true;
+  }
+
+  bool close_shard() {
+    if (cur == nullptr) return true;
+    if (!flush_buf()) return false;
+    if (fclose(cur) != 0) {
+      fprintf(stderr, "tokpack: close failed: %s\n", strerror(errno));
+      return false;
+    }
+    cur = nullptr;
+    // Publish atomically: the reader never sees a half-written shard.
+    std::string tmp = shard_path(counts.size(), true);
+    std::string fin = shard_path(counts.size(), false);
+    if (rename(tmp.c_str(), fin.c_str()) != 0) {
+      fprintf(stderr, "tokpack: rename %s: %s\n", tmp.c_str(),
+              strerror(errno));
+      return false;
+    }
+    counts.push_back(cur_count);
+    cur_count = 0;
+    return true;
+  }
+
+  bool write_index() {
+    std::string tmp = dir + "/index.json.tmp";
+    FILE* f = fopen(tmp.c_str(), "w");
+    if (f == nullptr) {
+      fprintf(stderr, "tokpack: %s: %s\n", tmp.c_str(), strerror(errno));
+      return false;
+    }
+    fprintf(f, "{\n \"version\": 1,\n \"shards\": [\n");
+    for (size_t i = 0; i < counts.size(); i++) {
+      char name[32];
+      snprintf(name, sizeof(name), "%05zu.tokens", i);
+      fprintf(f, "  {\"name\": \"%s\", \"tokens\": %" PRIu64 "}%s\n",
+              name, counts[i], i + 1 < counts.size() ? "," : "");
+    }
+    fprintf(f, " ]\n}\n");
+    if (fclose(f) != 0) return false;
+    std::string fin = dir + "/index.json";
+    return rename(tmp.c_str(), fin.c_str()) == 0;
+  }
+};
+
+bool pack_stream(FILE* in, const char* label, ShardWriter* out) {
+  // Hand-rolled decimal scanner: the whole job is this loop, and
+  // fscanf is ~5x slower on multi-GB corpora.
+  std::vector<char> chunk(kBufBytes);
+  uint64_t value = 0;
+  bool in_number = false;
+  for (;;) {
+    size_t n = fread(chunk.data(), 1, chunk.size(), in);
+    if (n == 0) {
+      if (ferror(in)) {
+        fprintf(stderr, "tokpack: %s: read error\n", label);
+        return false;
+      }
+      break;
+    }
+    for (size_t i = 0; i < n; i++) {
+      char c = chunk[i];
+      if (c >= '0' && c <= '9') {
+        value = value * 10 + static_cast<uint64_t>(c - '0');
+        if (value > UINT32_MAX) {
+          fprintf(stderr, "tokpack: %s: token id overflows uint32\n",
+                  label);
+          return false;
+        }
+        in_number = true;
+      } else if (c == ' ' || c == '\n' || c == '\t' || c == '\r') {
+        if (in_number && !out->add(static_cast<uint32_t>(value)))
+          return false;
+        value = 0;
+        in_number = false;
+      } else {
+        fprintf(stderr, "tokpack: %s: unexpected byte 0x%02x (want "
+                "decimal ids + whitespace)\n", label,
+                static_cast<unsigned char>(c));
+        return false;
+      }
+    }
+  }
+  if (in_number && !out->add(static_cast<uint32_t>(value)))
+    return false;
+  return true;
+}
+
+// mkdir -p: create each path component, tolerating ones that exist.
+bool make_dirs(const std::string& path) {
+  std::string cur;
+  for (size_t i = 0; i <= path.size(); i++) {
+    if (i == path.size() || path[i] == '/') {
+      if (!cur.empty() && mkdir(cur.c_str(), 0777) != 0
+          && errno != EEXIST) {
+        fprintf(stderr, "tokpack: mkdir %s: %s\n", cur.c_str(),
+                strerror(errno));
+        return false;
+      }
+    }
+    if (i < path.size()) cur.push_back(path[i]);
+  }
+  return true;
+}
+
+// A re-pack into a dir that already holds shards could interrupt and
+// leave NEW shards under the OLD index.json — sizes can line up, and
+// the reader would silently serve a splice of two corpora.  Refuse
+// loudly instead (the Python writer's name_offset is the append path).
+bool check_dir_empty_of_shards(const std::string& dir) {
+  DIR* d = opendir(dir.c_str());
+  if (d == nullptr) return true;  // fresh dir about to be created
+  bool clean = true;
+  while (dirent* e = readdir(d)) {
+    std::string name = e->d_name;
+    if (name.size() > 7
+        && name.compare(name.size() - 7, 7, ".tokens") == 0) {
+      fprintf(stderr, "tokpack: %s already holds %s — refusing to mix "
+              "corpora (pack into a fresh dir)\n", dir.c_str(),
+              name.c_str());
+      clean = false;
+      break;
+    }
+  }
+  closedir(d);
+  return clean;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string out_dir;
+  uint64_t shard_tokens = 1 << 24;  // 64 MiB shards
+  std::vector<std::string> inputs;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--out" && i + 1 < argc) {
+      out_dir = argv[++i];
+    } else if (a == "--shard-tokens" && i + 1 < argc) {
+      shard_tokens = strtoull(argv[++i], nullptr, 10);
+    } else if (a == "--help") {
+      fprintf(stderr, "usage: tokpack --out DIR [--shard-tokens N] "
+              "FILE... (- for stdin)\n");
+      return 1;
+    } else {
+      inputs.push_back(a);
+    }
+  }
+  if (out_dir.empty() || inputs.empty() || shard_tokens == 0) {
+    fprintf(stderr, "tokpack: need --out DIR, >=1 input, and "
+            "--shard-tokens >= 1 (--help for usage)\n");
+    return 1;
+  }
+  if (!check_dir_empty_of_shards(out_dir)) return 2;
+  if (!make_dirs(out_dir)) return 2;
+
+  ShardWriter writer(out_dir, shard_tokens);
+  for (const std::string& path : inputs) {
+    FILE* in = path == "-" ? stdin : fopen(path.c_str(), "rb");
+    if (in == nullptr) {
+      fprintf(stderr, "tokpack: %s: %s\n", path.c_str(), strerror(errno));
+      return 2;
+    }
+    bool ok = pack_stream(in, path.c_str(), &writer);
+    if (in != stdin) fclose(in);
+    if (!ok) return 2;
+  }
+  if (!writer.close_shard()) return 2;
+  if (writer.counts.empty()) {
+    fprintf(stderr, "tokpack: inputs held 0 tokens\n");
+    return 2;
+  }
+  if (!writer.write_index()) return 2;
+  uint64_t total = 0;
+  for (uint64_t c : writer.counts) total += c;
+  fprintf(stderr, "tokpack: %zu shard(s), %" PRIu64 " tokens -> %s\n",
+          writer.counts.size(), total, out_dir.c_str());
+  return 0;
+}
