@@ -41,6 +41,10 @@ def parse_args(argv=None):
     p.add_argument("--image-size", type=int, default=224)
     p.add_argument("--model-par", type=int, default=1,
                    help="tensor-parallel degree of the mesh")
+    p.add_argument("--data-dir", default=None,
+                   help="array-shard dataset dir (data/arrays.py "
+                        "format: images + labels).  Default: synthetic "
+                        "streams")
     p.add_argument("--model-dir", default=None,
                    help="directory for final params (flax msgpack)")
     p.add_argument("--checkpoint-dir", default=None,
@@ -136,12 +140,43 @@ def main(argv=None):
             return jax.device_put(jnp.asarray(local), data_sh)
         return jax.make_array_from_process_local_data(data_sh, local)
 
-    np_rng = np.random.default_rng(pid)
-    xs = [globalize(np_rng.standard_normal(sample.shape, dtype=np.float32))
-          for _ in range(n_batches)]
-    ys = [globalize(np_rng.integers(0, args.num_classes, (local_batch,),
-                                    dtype=np.int32))
-          for _ in range(n_batches)]
+    # Real dataset (--data-dir) or synthetic streams.  The loader's
+    # step->batch mapping is a pure function of the step (data/), so a
+    # resumed run replays its exact batches; each process slices its
+    # local rows from the identical global batch.
+    batch_iter = None
+    if args.data_dir:
+        from container_engine_accelerators_tpu.data import (
+            ArrayShardReader,
+            ImageBatchLoader,
+        )
+
+        reader = ArrayShardReader(args.data_dir)
+        want = (args.image_size, args.image_size, 3)
+        if reader.sample_shape != want:
+            raise SystemExit(
+                f"--data-dir samples are {reader.sample_shape}, model "
+                f"expects {want} (set --image-size to match)")
+        # shard=(pid, num_procs): each host reads/scales only its own
+        # rows of the global batch (rows are independent, so the pure
+        # mapping survives sharding).
+        loader = ImageBatchLoader(reader, args.train_batch_size,
+                                  num_classes=args.num_classes,
+                                  shard=(pid, num_procs))
+        log.info("dataset: %d samples (%d steps/epoch) from %s",
+                 reader.total_samples, loader.steps_per_epoch(),
+                 args.data_dir)
+        batch_iter = loader.iter_batches(
+            start_step, args.train_steps - start_step)
+        xs = ys = None
+    else:
+        np_rng = np.random.default_rng(pid)
+        xs = [globalize(
+                  np_rng.standard_normal(sample.shape, dtype=np.float32))
+              for _ in range(n_batches)]
+        ys = [globalize(np_rng.integers(0, args.num_classes,
+                                        (local_batch,), dtype=np.int32))
+              for _ in range(n_batches)]
 
     # Maintenance drains send SIGTERM; convert it into a final
     # synchronous checkpoint + exit 80 so the rescheduled pod resumes
@@ -161,8 +196,12 @@ def main(argv=None):
                                             min(10, args.train_steps - 1)):
             jax.profiler.start_trace(args.profile_dir)
             profiling = True
-        state, metrics = step_fn(state, xs[step % n_batches],
-                                 ys[step % n_batches])
+        if batch_iter is not None:
+            lx, ly = next(batch_iter)  # already this host's rows
+            x, y = globalize(lx), globalize(ly)
+        else:
+            x, y = xs[step % n_batches], ys[step % n_batches]
+        state, metrics = step_fn(state, x, y)
         if profiling and step >= min(20, args.train_steps - 1):
             jax.block_until_ready(state.params)
             jax.profiler.stop_trace()

@@ -12,6 +12,11 @@ from container_engine_accelerators_tpu.data.tokens import (  # noqa: F401
     TokenShardReader,
     write_token_shards,
 )
+from container_engine_accelerators_tpu.data.arrays import (  # noqa: F401
+    ArrayShardReader,
+    write_array_shards,
+)
 from container_engine_accelerators_tpu.data.loader import (  # noqa: F401
+    ImageBatchLoader,
     TokenBatchLoader,
 )

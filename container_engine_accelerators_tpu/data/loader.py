@@ -29,9 +29,32 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+from container_engine_accelerators_tpu.data.arrays import ArrayShardReader
 from container_engine_accelerators_tpu.data.tokens import TokenShardReader
 
 Batch = Tuple[np.ndarray, np.ndarray, np.ndarray]
+
+
+def _prefetched(batch_fn, start_step: int, num_steps: int,
+                prefetch: int) -> Iterator:
+    """Yield ``batch_fn(s)`` for s in [start, start+num) in order,
+    produced by a background thread.  Producer errors (e.g. vocab
+    overflow) are re-raised at the consuming step, not swallowed."""
+    q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+
+    def produce():
+        try:
+            for s in range(start_step, start_step + num_steps):
+                q.put(batch_fn(s))
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            q.put(e)
+
+    threading.Thread(target=produce, daemon=True).start()
+    for _ in range(num_steps):
+        item = q.get()
+        if isinstance(item, BaseException):
+            raise item
+        yield item
 
 
 class TokenBatchLoader:
@@ -71,28 +94,9 @@ class TokenBatchLoader:
 
     def iter_batches(self, start_step: int,
                      num_steps: int) -> Iterator[Batch]:
-        """Yield batches for steps [start_step, start_step+num_steps)
-        in order, produced by a background prefetch thread.
-
-        A reader error (e.g. vocab overflow) is re-raised at the
-        consuming step, not swallowed in the thread.
-        """
-        q: "queue.Queue" = queue.Queue(maxsize=max(self.prefetch, 1))
-
-        def produce():
-            try:
-                for s in range(start_step, start_step + num_steps):
-                    q.put(self.batch_at(s))
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                q.put(e)
-
-        worker = threading.Thread(target=produce, daemon=True)
-        worker.start()
-        for _ in range(num_steps):
-            item = q.get()
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        """Prefetched batches for steps [start, start+num) in order."""
+        return _prefetched(self.batch_at, start_step, num_steps,
+                           self.prefetch)
 
     def steps_per_epoch(self) -> int:
         """Steps to consume the dataset once (floor; the modular
@@ -102,3 +106,61 @@ class TokenBatchLoader:
             self.reader.total_tokens
             // (self.batch_size * self.seq_len),
         )
+
+
+class ImageBatchLoader:
+    """Image/label twin of :class:`TokenBatchLoader` — same pure
+    step->batch mapping (global batch ``s`` is samples
+    ``[s*B, (s+1)*B)``, modular) and the same prefetch thread.
+
+    ``shard=(pid, num_procs)`` makes ``batch_at`` return only this
+    process's rows of the global batch — image rows are independent
+    (unlike token labels, which cross sequence shards), so a host
+    never has to materialize or scale the other hosts' slices.  The
+    mapping stays a pure function of (step, shard): resume is exact
+    and the union over shards is exactly the global batch.
+
+    uint8 storage is scaled to [0, 1] float32 on the host ([0, 1) only
+    for images that never saturate); float storage passes through.
+    ``num_classes`` bounds labels the way ``vocab_size`` bounds tokens.
+    """
+
+    def __init__(self, reader: ArrayShardReader, batch_size: int,
+                 num_classes: Optional[int] = None, prefetch: int = 2,
+                 shard: Tuple[int, int] = (0, 1)):
+        pid, num_procs = shard
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if num_procs < 1 or not 0 <= pid < num_procs \
+                or batch_size % num_procs:
+            raise ValueError(
+                f"shard {shard} invalid for batch_size {batch_size}")
+        self.reader = reader
+        self.batch_size = batch_size
+        self.num_classes = num_classes
+        self.prefetch = prefetch
+        self.shard = shard
+
+    def batch_at(self, step: int):
+        pid, num_procs = self.shard
+        local = self.batch_size // num_procs
+        images, labels = self.reader.read(
+            step * self.batch_size + pid * local, local)
+        if self.num_classes is not None:
+            peak = int(labels.max())
+            if peak >= self.num_classes:
+                raise ValueError(
+                    f"dataset label {peak} >= num_classes "
+                    f"{self.num_classes} (step {step})")
+        if images.dtype == np.uint8:
+            images = images.astype(np.float32) / 255.0
+        else:
+            images = images.astype(np.float32, copy=False)
+        return images, labels
+
+    def iter_batches(self, start_step: int, num_steps: int):
+        return _prefetched(self.batch_at, start_step, num_steps,
+                           self.prefetch)
+
+    def steps_per_epoch(self) -> int:
+        return max(1, self.reader.total_samples // self.batch_size)

@@ -124,3 +124,75 @@ def test_int8_quant_under_tensor_parallel_matches_single_device():
         qp_sharded
     ))
     np.testing.assert_array_equal(solo, tp)
+
+
+def _spec(model, params, draft_model, draft_params, n=5, k=3):
+    from container_engine_accelerators_tpu.models.speculative import (
+        generate_speculative,
+    )
+
+    out, _ = generate_speculative(
+        model, params, draft_model, draft_params,
+        jnp.asarray([PROMPT], jnp.int32), n, k=k)
+    return np.asarray(out)[0, len(PROMPT): len(PROMPT) + n].tolist()
+
+
+def _prefix(model, params, n=5):
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+        generate_with_prefix,
+    )
+
+    pc = PrefixCache(model, params, max_prefix_len=4)
+    kv, plen = pc.get_or_build(tuple(PROMPT[:2]))
+    suffix = jnp.asarray([PROMPT[2:]], jnp.int32)
+    out = np.asarray(generate_with_prefix(model, params, kv, plen,
+                                          suffix, n))
+    return out[0, 1: 1 + n].tolist()  # suffix len 1, then generated
+
+
+@pytest.mark.slow
+def test_speculative_with_moe_target_matches_solo():
+    """Draft/verify chunking must survive MoE routing in the target
+    (the drop-free decode router sees k+1-token chunks, not just
+    prefill-or-single-token)."""
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_experts=4)
+    params = _params_for(cfg)
+    mm = transformer_lm(**cfg, decode=True)
+    d_cfg = dict(cfg, num_layers=1)
+    assert _spec(mm, params, transformer_lm(**d_cfg, decode=True),
+                 _params_for(d_cfg)) == _solo(mm, params)
+
+
+@pytest.mark.slow
+def test_speculative_with_int8_target_matches_solo():
+    """The verify chunk runs the int8 kernels at T=k+1 — a matmul
+    shape the quant exactness suite's prefill/decode paths never hit."""
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+    qp = serving_params(_params_for(cfg), "int8")
+    qm = transformer_lm(**cfg, decode=True, quant=True)
+    d_cfg = dict(cfg, num_layers=1)
+    assert _spec(qm, qp, transformer_lm(**d_cfg, decode=True),
+                 _params_for(d_cfg)) == _solo(qm, qp)
+
+
+@pytest.mark.slow
+def test_prefix_cache_with_int8_matches_solo():
+    """Prefix KV is built by the int8 model's own prefill, so splicing
+    + suffix continuation must reproduce its solo decode exactly."""
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+    qp = serving_params(_params_for(cfg), "int8")
+    qm = transformer_lm(**cfg, decode=True, quant=True)
+    assert _prefix(qm, qp) == _solo(qm, qp)
+
+
+@pytest.mark.slow
+def test_prefix_cache_with_moe_matches_solo():
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_experts=4)
+    params = _params_for(cfg)
+    mm = transformer_lm(**cfg, decode=True)
+    assert _prefix(mm, params) == _solo(mm, params)

@@ -180,3 +180,133 @@ def test_train_lm_on_real_dataset_end_to_end(tmp_path):
         "--checkpoint-interval", "3",
     ])
     assert os.path.isdir(tmp_path / "ck")
+
+
+# --- image/label array shards (data/arrays.py) -----------------------
+
+from container_engine_accelerators_tpu.data import (  # noqa: E402
+    ArrayShardReader,
+    ImageBatchLoader,
+    write_array_shards,
+)
+
+
+def _image_dataset(tmp_path, counts, shape=(4, 4, 3), dtype=np.uint8):
+    rng = np.random.default_rng(0)
+    d = str(tmp_path / "imgs")
+    batches = []
+    label = 0
+    for n in counts:
+        imgs = rng.integers(0, 255, (n,) + shape).astype(dtype) \
+            if dtype == np.uint8 else rng.random((n,) + shape, dtype)
+        labels = np.arange(label, label + n, dtype=np.int32) % 10
+        label += n
+        batches.append((imgs, labels))
+    write_array_shards(d, batches)
+    return d
+
+
+def test_array_roundtrip_across_shards(tmp_path):
+    d = _image_dataset(tmp_path, [3, 2, 4])
+    r = ArrayShardReader(d)
+    assert r.total_samples == 9
+    assert r.sample_shape == (4, 4, 3)
+    imgs, labels = r.read(2, 4)  # crosses shard 0->1->2
+    assert imgs.shape == (4, 4, 4, 3)
+    assert labels.tolist() == [2, 3, 4, 5]
+    _, wrap = r.read(7, 4)
+    assert wrap.tolist() == [7, 8, 0, 1]
+
+
+def test_image_loader_pure_scaled_and_bounded(tmp_path):
+    d = _image_dataset(tmp_path, [10])
+    loader = ImageBatchLoader(ArrayShardReader(d), batch_size=4)
+    x1, y1 = loader.batch_at(2)
+    x2, y2 = loader.batch_at(2)
+    assert (x1 == x2).all() and (y1 == y2).all()
+    assert x1.dtype == np.float32 and 0.0 <= x1.min() <= x1.max() <= 1.0
+    assert y1.tolist() == [8, 9, 0, 1]  # modular wrap at sample 10
+    bad = ImageBatchLoader(ArrayShardReader(d), batch_size=4,
+                           num_classes=5)
+    with pytest.raises(ValueError, match="num_classes"):
+        list(bad.iter_batches(0, 3))
+
+
+def test_image_loader_shards_partition_the_global_batch(tmp_path):
+    """Union of the per-process shards == the global batch, in order
+    (the multi-host contract train_resnet's --data-dir relies on)."""
+    d = _image_dataset(tmp_path, [10])
+    r = ArrayShardReader(d)
+    whole = ImageBatchLoader(r, batch_size=4)
+    left = ImageBatchLoader(r, batch_size=4, shard=(0, 2))
+    right = ImageBatchLoader(r, batch_size=4, shard=(1, 2))
+    gx, gy = whole.batch_at(3)
+    lx, ly = left.batch_at(3)
+    rx, ry = right.batch_at(3)
+    assert (np.concatenate([lx, rx]) == gx).all()
+    assert (np.concatenate([ly, ry]) == gy).all()
+    with pytest.raises(ValueError, match="shard"):
+        ImageBatchLoader(r, batch_size=4, shard=(0, 3))
+
+
+def test_array_writer_refuses_populated_dir(tmp_path):
+    d = _image_dataset(tmp_path, [3])
+    with pytest.raises(ValueError, match="refusing to mix"):
+        write_array_shards(d, [(np.zeros((2, 4, 4, 3), np.uint8),
+                                np.zeros(2, np.int32))])
+
+
+def test_array_reader_rejects_mismatch_and_token_index(tmp_path):
+    d = _image_dataset(tmp_path, [3])
+    with open(os.path.join(d, "00000.labels"), "r+b") as f:
+        f.truncate(4)
+    with pytest.raises(ValueError, match="in index"):
+        ArrayShardReader(d)
+    tok = _dataset(tmp_path, [[1, 2, 3]])
+    with pytest.raises(ValueError, match="sample_shape"):
+        ArrayShardReader(tok)
+
+
+@pytest.mark.slow
+def test_train_resnet_on_real_dataset_end_to_end(tmp_path):
+    """cmd/train_resnet.py --data-dir trains on packed image shards."""
+    import importlib.util
+
+    rng = np.random.default_rng(0)
+    d = str(tmp_path / "imgs")
+    write_array_shards(d, [
+        (rng.integers(0, 255, (16, 32, 32, 3)).astype(np.uint8),
+         rng.integers(0, 10, 16).astype(np.int32)),
+    ])
+    spec = importlib.util.spec_from_file_location(
+        "train_resnet_data", os.path.join(REPO, "cmd", "train_resnet.py"))
+    train = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(train)
+    train.main([
+        "--resnet-depth", "18", "--train-batch-size", "8",
+        "--image-size", "32", "--num-classes", "10",
+        "--train-steps", "2", "--steps-per-eval", "1",
+        "--data-dir", d, "--model-dir", str(tmp_path / "out"),
+    ])
+    assert (tmp_path / "out" / "params.msgpack").stat().st_size > 0
+
+
+def test_train_resnet_rejects_shape_mismatch(tmp_path):
+    import importlib.util
+
+    rng = np.random.default_rng(0)
+    d = str(tmp_path / "imgs")
+    write_array_shards(d, [
+        (rng.integers(0, 255, (8, 16, 16, 3)).astype(np.uint8),
+         np.zeros(8, np.int32)),
+    ])
+    spec = importlib.util.spec_from_file_location(
+        "train_resnet_data2", os.path.join(REPO, "cmd", "train_resnet.py"))
+    train = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(train)
+    with pytest.raises(SystemExit, match="image-size"):
+        train.main([
+            "--resnet-depth", "18", "--train-batch-size", "8",
+            "--image-size", "32", "--num-classes", "10",
+            "--train-steps", "4", "--data-dir", d,
+        ])
