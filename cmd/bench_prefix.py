@@ -1,0 +1,146 @@
+#!/usr/bin/env python3
+"""Prefix-cache TTFT benchmark: full prefill vs spliced-prefix suffix
+prefill.
+
+The prefix cache's lever is time-to-first-token: a request fronted by a
+long shared system prompt pays prefill FLOPs ~ prefix+suffix on the
+plain path but only ~ suffix after one cache hit
+(models/prefix_cache.py).  This tool times both paths on the attached
+backend at serving shapes and prints one JSON line each:
+
+  prefix_ttft_full_ms    — generate() over the concatenated prompt
+  prefix_ttft_cached_ms  — generate_with_prefix() with a hot entry;
+                           ``vs_baseline`` = full/cached speedup
+
+Replay defense (bench.py discipline): the prefix is fixed by design —
+that is the cache premise — but every timed call uses a fresh
+nonce-seeded SUFFIX, and results are drained with a host fetch.
+Metrics append to BENCH_TPU_LOG.jsonl on accelerators only.
+
+Reference altitude: the serving demo + HPA
+(/root/reference/demo/serving/tensorflow-serving.yaml:63-79); the
+reference has no serving runtime, so the baseline is this framework's
+own plain path.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--prefix-len", type=int, default=0,
+                   help="0 = backend default (1920 accel / 12 cpu)")
+    p.add_argument("--suffix-len", type=int, default=0,
+                   help="0 = backend default (64 accel / 4 cpu)")
+    p.add_argument("--max-new", type=int, default=1,
+                   help="1 isolates TTFT; raise to amortize decode")
+    p.add_argument("--calls", type=int, default=0,
+                   help="timed calls per path (0 = backend default)")
+    p.add_argument("--force-log", action="store_true",
+                   help="log even on CPU (test seam)")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from bench import _log_tpu_result
+    from container_engine_accelerators_tpu.models.generate import generate
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+    )
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+        generate_with_prefix,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    pfx = args.prefix_len or (1920 if on_accel else 12)
+    suf = args.suffix_len or (64 if on_accel else 4)
+    calls = args.calls or (20 if on_accel else 2)
+    lm_kw = dict(
+        vocab_size=32_768 if on_accel else 128,
+        num_layers=12 if on_accel else 2,
+        num_heads=16 if on_accel else 4,
+        head_dim=64 if on_accel else 8,
+        mlp_dim=4096 if on_accel else 32,
+    )
+    state = create_lm_train_state(
+        transformer_lm(**lm_kw), jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+    )
+    params = state.params
+    model = transformer_lm(**lm_kw, decode=True)
+
+    nonce = int(time.time_ns()) & 0x7FFFFFFF
+    vocab = lm_kw["vocab_size"]
+    prefix_ids = tuple(
+        int(t) for t in jax.device_get(jax.random.randint(
+            jax.random.PRNGKey(7), (pfx,), 0, vocab, jnp.int32)))
+    suffixes = [
+        jax.random.randint(jax.random.PRNGKey(nonce + i), (1, suf), 0,
+                           vocab, jnp.int32)
+        for i in range(calls + 1)
+    ]
+    jax.block_until_ready(suffixes)
+
+    full = jax.jit(
+        lambda p: generate(model, params, p, args.max_new))
+    prefix_arr = jnp.asarray([list(prefix_ids)], jnp.int32)
+
+    def run_full(sfx):
+        return full(jnp.concatenate([prefix_arr, sfx], axis=1))
+
+    cache = PrefixCache(model, params, max_prefix_len=pfx)
+    kv, plen = cache.get_or_build(prefix_ids)  # the one-time build
+    cached = jax.jit(
+        lambda kv, sfx: generate_with_prefix(
+            model, params, kv, plen, sfx, args.max_new))
+
+    results = []
+    for name, fn in (("full", run_full),
+                     ("cached", lambda s: cached(kv, s))):
+        out = fn(suffixes[-1])
+        int(jax.device_get(out[0, -1]))  # compile + drain
+        t0 = time.perf_counter()
+        for i in range(calls):
+            out = fn(suffixes[i])
+            int(jax.device_get(out[0, -1]))  # per-call: TTFT is latency
+        dt = time.perf_counter() - t0
+        results.append((name, dt / calls * 1e3))
+
+    full_ms = dict(results)["full"]
+    cached_ms = dict(results)["cached"]
+    for name, ms in results:
+        entry = {
+            "metric": f"prefix_ttft_{name}_ms",
+            "value": round(ms, 3),
+            "unit": "ms",
+            "vs_baseline": (round(full_ms / cached_ms, 3)
+                            if name == "cached" else 1.0),
+            "prefix_len": pfx, "suffix_len": suf,
+            "max_new": args.max_new, "calls": calls, "nonce": nonce,
+        }
+        if on_accel or args.force_log:
+            _log_tpu_result(entry)
+        print(json.dumps(entry), flush=True)
+    print(f"bench_prefix: full {full_ms:.1f} ms vs cached "
+          f"{cached_ms:.1f} ms -> {full_ms / cached_ms:.2f}x",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -137,6 +137,10 @@ DEFAULT_STAGES = [
              "--num-heads", "16", "--head-dim", "64", "--mlp-dim", "4096",
              "--vocab-size", "32768"],
      "timeout": 1800},
+    # Prefix-cache TTFT lever: full-vs-spliced prefill at serving
+    # shapes (one compile each; cheap next to the train stages).
+    {"name": "bench_prefix",
+     "cmd": [sys.executable, "cmd/bench_prefix.py"], "timeout": 1800},
     {"name": "bench_lm", "cmd": [sys.executable, "bench.py"],
      "env": {"BENCH_WORKLOAD": "lm"}, "timeout": _BENCH_STAGE_TIMEOUT},
     {"name": "flash_vs_xla",

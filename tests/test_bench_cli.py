@@ -122,3 +122,30 @@ def test_bench_micro_cpu_never_logs_without_force(tmp_path):
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert not log.exists()
+
+
+def test_bench_prefix_cpu_smoke(tmp_path):
+    """bench_prefix end-to-end on CPU at toy shapes: both metric lines
+    well-formed, speedup recorded on the cached line, logged via the
+    test seam."""
+    import json
+    import subprocess
+    import sys
+
+    log = tmp_path / "log.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_TPU_LOG=str(log))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "cmd", "bench_prefix.py"),
+         "--prefix-len", "8", "--suffix-len", "4", "--calls", "2",
+         "--force-log"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [json.loads(l) for l in proc.stdout.strip().splitlines()]
+    assert [e["metric"] for e in lines] == [
+        "prefix_ttft_full_ms", "prefix_ttft_cached_ms"]
+    assert all(e["value"] > 0 for e in lines)
+    assert lines[0]["vs_baseline"] == 1.0
+    assert lines[1]["vs_baseline"] > 0
+    logged = [json.loads(l) for l in log.read_text().splitlines()]
+    assert len(logged) == 2

@@ -186,8 +186,8 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
         + " ".join(f"{k}={v}" for k, v in s.get("env", {}).items())
         for s in watcher_mod.DEFAULT_STAGES
     )
-    for tool in ("bench.py", "bench_micro.py", "bench_attention.py",
-                 "roofline_resnet.py",
+    for tool in ("bench.py", "bench_micro.py", "bench_prefix.py",
+                 "bench_attention.py", "roofline_resnet.py",
                  "inject_error.py", "lm", "decode", "BENCH_DECODE_KV",
                  "BENCH_DECODE_WEIGHTS=int8", "BENCH_DECODE_FLASH=1",
                  "BENCH_DECODE_PROMPT=1984", "BENCH_DECODE_SPEC=4",
