@@ -89,8 +89,8 @@ def parse_args(argv=None):
                         "blocks (models/prefix_cache.py): requests "
                         "carrying \"prefix_ids\" prefill only their "
                         "suffix after the first hit.  0 = off; "
-                        "incompatible with --slots, --tp > 1 and "
-                        "--speculative")
+                        "composes with --tp, incompatible with "
+                        "--slots and --speculative")
     return p.parse_args(argv)
 
 
@@ -446,11 +446,11 @@ def main(argv=None):
     if args.speculative and args.tp > 1:
         raise SystemExit("--speculative and --tp > 1 are mutually "
                          "exclusive (the draft runs single-device)")
-    if args.prefix_cache and (args.slots or args.tp > 1
-                              or args.speculative):
-        raise SystemExit("--prefix-cache composes with the plain "
-                         "per-request path only (not --slots, --tp or "
-                         "--speculative) for now")
+    if args.prefix_cache and (args.slots or args.speculative):
+        raise SystemExit("--prefix-cache composes with the per-request "
+                         "path only (not --slots or --speculative) for "
+                         "now; --tp is fine (dryrun regime 8 pins the "
+                         "sharded splice)")
     run = build_generate(args)
     engine_loop = None
     if args.slots:

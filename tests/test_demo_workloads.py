@@ -387,3 +387,29 @@ def test_serve_lm_http_prefix_cache_matches_concatenated(tmp_path):
         assert long_pfx["tokens"][0] == long_cat["tokens"][0]
     finally:
         srv.shutdown()
+
+
+@pytest.mark.slow
+def test_serve_lm_prefix_cache_with_tensor_parallel():
+    """--prefix-cache + --tp 2: the spliced-prefix serving path under
+    Megatron sharding returns exactly the single-device tokens
+    (dryrun regime 8 pins the core; this pins the serve surface)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    serve = _load("serve_lm_pfx_tp", "cmd", "serve_lm.py")
+    tiny = ["--vocab-size", "64", "--num-layers", "1", "--num-heads",
+            "2", "--head-dim", "8", "--mlp-dim", "32",
+            "--max-prompt-len", "8", "--max-new-tokens", "4",
+            "--port", "0", "--prefix-cache", "2"]
+    run1 = serve.build_generate(serve.parse_args(tiny + ["--tp", "1"]))
+    run2 = serve.build_generate(serve.parse_args(tiny + ["--tp", "2"]))
+
+    def gen(run):
+        kv, plen = run.prefix_cache.get_or_build((7, 11))
+        suffix = jnp.asarray([[1, 2]], jnp.int32)
+        return np.asarray(run.run_prefix(kv, plen, suffix, 2, 0.0, 0,
+                                         False))
+
+    a, b = gen(run1), gen(run2)
+    assert (a[:, :6] == b[:, :6]).all()
