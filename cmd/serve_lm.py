@@ -314,6 +314,24 @@ from container_engine_accelerators_tpu.models.batching import (  # noqa: E402
 )
 
 
+def build_engine(run, args):
+    """Continuous-batching engine sized for this server's admission
+    bound.  With the prefix cache on, a slot may hold prefix bucket +
+    suffix bucket (up to 2x the prompt bucket) before decode slots —
+    the lanes are sized for it (fast-tested in
+    tests/test_demo_workloads.py)."""
+    from container_engine_accelerators_tpu.models.batching import (
+        DecodeEngine,
+    )
+
+    prompt_bucket = bucket_len(args.max_prompt_len, args.max_prompt_len)
+    return DecodeEngine(
+        run.decode_model, run.params, max_slots=args.slots,
+        max_len=prompt_bucket + args.max_new_tokens
+        + (prompt_bucket if args.prefix_cache else 0),
+    )
+
+
 def make_handler(run, args, engine_loop=None):
     import jax.numpy as jnp
     import numpy as np
@@ -379,7 +397,6 @@ def make_handler(run, args, engine_loop=None):
                 use_prefix = (
                     getattr(run, "prefix_cache", None) is not None
                     and 0 < len(prefix_ids) < args.max_prompt_len
-                    and engine_loop is None
                 )
                 if prefix_ids and not use_prefix:
                     clean = [
@@ -390,19 +407,29 @@ def make_handler(run, args, engine_loop=None):
                     room = args.max_prompt_len - len(prefix_ids)
                     kv, pfx_len = run.prefix_cache.get_or_build(
                         tuple(prefix_ids))
-                    toks = []
-                    for i, ids in enumerate(clean):
-                        ids = ids[:room]
-                        plen = len(ids)
-                        bucket = bucket_len(plen, args.max_prompt_len)
-                        padded = ids + [0] * (bucket - plen)
-                        out = np.asarray(run.run_prefix(
-                            kv, pfx_len,
-                            jnp.asarray([padded], jnp.int32), plen,
-                            temperature, seed + i, temperature > 0,
-                        ))
-                        toks.append(prefix_ids + out[0][
-                            : plen + max_new].tolist())
+                    rows = [ids[:room] for ids in clean]
+                    if engine_loop is not None and temperature == 0:
+                        # Greedy + slots: the fleet's slots start from
+                        # the spliced block (DecodeEngine.submit
+                        # prefix=).
+                        outs = engine_loop.generate_many(
+                            rows, max_new, prefix=(kv, pfx_len))
+                        toks = [prefix_ids + ids + gen[:max_new]
+                                for ids, gen in zip(rows, outs)]
+                    else:
+                        toks = []
+                        for i, ids in enumerate(rows):
+                            plen = len(ids)
+                            bucket = bucket_len(plen,
+                                                args.max_prompt_len)
+                            padded = ids + [0] * (bucket - plen)
+                            out = np.asarray(run.run_prefix(
+                                kv, pfx_len,
+                                jnp.asarray([padded], jnp.int32), plen,
+                                temperature, seed + i, temperature > 0,
+                            ))
+                            toks.append(prefix_ids + out[0][
+                                : plen + max_new].tolist())
                 elif engine_loop is not None and temperature == 0:
                     # Continuous batching: all of this request's
                     # prompts join the shared decode fleet CONCURRENTLY
@@ -446,25 +473,18 @@ def main(argv=None):
     if args.speculative and args.tp > 1:
         raise SystemExit("--speculative and --tp > 1 are mutually "
                          "exclusive (the draft runs single-device)")
-    if args.prefix_cache and (args.slots or args.speculative):
-        raise SystemExit("--prefix-cache composes with the per-request "
-                         "path only (not --slots or --speculative) for "
-                         "now; --tp is fine (dryrun regime 8 pins the "
-                         "sharded splice)")
+    if args.prefix_cache and args.speculative:
+        raise SystemExit("--prefix-cache and --speculative are mutually "
+                         "exclusive for now (the draft has no spliced "
+                         "entry point); --slots and --tp both compose")
     run = build_generate(args)
     engine_loop = None
     if args.slots:
         from container_engine_accelerators_tpu.models.batching import (
-            DecodeEngine,
             EngineLoop,
         )
 
-        engine = DecodeEngine(
-            run.decode_model, run.params, max_slots=args.slots,
-            max_len=bucket_len(args.max_prompt_len, args.max_prompt_len)
-            + args.max_new_tokens,
-        )
-        engine_loop = EngineLoop(engine)
+        engine_loop = EngineLoop(build_engine(run, args))
         # Warm the engine's prefill AND step compiles before taking
         # traffic (max_new=2 so at least one fleet step runs; a 1-token
         # request retires inside submit and never steps).

@@ -39,6 +39,9 @@ from container_engine_accelerators_tpu.models.generate import (
     _rewind_cache_index,
     init_cache,
     prefill,
+    prefill_continue,
+    prefix_bucket_len,
+    splice_prefix,
 )
 
 
@@ -81,9 +84,22 @@ class DecodeEngine:
             tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
             return cache, tok0
 
+        def _prefill_pfx(prefix_kv, prefix_len, suffix, suffix_len):
+            # Prefix-cache composition: splice the stored block into a
+            # fresh slot-shaped cache, continue-prefill only the suffix
+            # (models/prefix_cache.py semantics inside one slot lane).
+            cache = init_cache(model, 1, self.max_len)
+            cache = splice_prefix(cache, prefix_kv, prefix_len, 1)
+            cache, last = prefill_continue(
+                model, params, cache, suffix, prefix_len,
+                prefix_len + suffix_len)
+            tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return cache, tok0
+
         # jit caches one trace per prompt BUCKET width; insert and step
         # trace once (slot index and cursors are traced operands).
         self._prefill = jax.jit(_prefill)
+        self._prefill_pfx = jax.jit(_prefill_pfx)
         self._insert_slot = jax.jit(self._insert_slot_impl)
         self._step = jax.jit(self._step_impl)
 
@@ -124,23 +140,52 @@ class DecodeEngine:
 
     # ---- host API -------------------------------------------------------
 
-    def submit(self, prompt_ids: List[int], max_new: int) -> int:
+    def submit(self, prompt_ids: List[int], max_new: int,
+               prefix=None) -> int:
         """Claim a free slot, prefill it, emit the first token.
-        Returns a request id; raises if the fleet is full."""
+        Returns a request id; raises if the fleet is full.
+
+        ``prefix`` is an optional ``(prefix_kv, prefix_len)`` entry
+        from :class:`~.prefix_cache.PrefixCache` (built with this
+        engine's model/params): the slot starts from the spliced block
+        and ``prompt_ids`` are treated as the SUFFIX — same exactness
+        contract as the per-request prefix path.
+        """
         if not self._free:
             raise RuntimeError("no free slot — step() until one drains")
         plen = len(prompt_ids)
-        bucket = bucket_len(plen, self.max_len)
-        if plen > bucket or plen + max_new > self.max_len:
+        if prefix is None:
+            bucket = bucket_len(plen, self.max_len)
+            start = 0
+        else:
+            pfx_bucket = prefix_bucket_len(prefix[0])
+            start = int(prefix[1])
+            # The suffix block writes at slots [start, start+bucket);
+            # a clamped dynamic_update_slice would silently break the
+            # slot==position invariant, so over-long requests are
+            # rejected up front.
+            bucket = bucket_len(plen, self.max_len)
+            if pfx_bucket > self.max_len or start + bucket > self.max_len:
+                raise ValueError(
+                    f"spliced request needs prefix bucket {pfx_bucket} "
+                    f"and suffix bucket slots [{start}, {start + bucket})"
+                    f"; slot holds {self.max_len}"
+                )
+        if plen > bucket or start + plen + max_new > self.max_len:
             raise ValueError(
-                f"request needs {plen}+{max_new} tokens; slot holds "
-                f"{self.max_len}"
+                f"request needs {start}+{plen}+{max_new} tokens; slot "
+                f"holds {self.max_len}"
             )
         slot = self._free.pop()
         prompt = jnp.asarray(
             [list(prompt_ids) + [0] * (bucket - plen)], jnp.int32
         )
-        slot_cache, tok0 = self._prefill(prompt, plen)
+        if prefix is None:
+            slot_cache, tok0 = self._prefill(prompt, plen)
+        else:
+            slot_cache, tok0 = self._prefill_pfx(
+                prefix[0], prefix[1], prompt, plen)
+        plen = start + plen  # global depth of the slot's cursor
         self.cache, self.pos, self.last_tok, self.active = (
             self._insert_slot(self.cache, self.pos, self.last_tok,
                               self.active, slot_cache, tok0, slot, plen)
@@ -220,12 +265,14 @@ class EngineLoop:
                 self.cond.notify_all()
 
     def generate(self, prompt_ids: List[int], max_new: int,
-                 timeout: float = 300.0) -> List[int]:
+                 timeout: float = 300.0, prefix=None) -> List[int]:
         """Submit and block until done; returns the generated tokens."""
-        return self.generate_many([prompt_ids], max_new, timeout)[0]
+        return self.generate_many([prompt_ids], max_new, timeout,
+                                  prefix=prefix)[0]
 
     def generate_many(self, prompts: List[List[int]], max_new: int,
-                      timeout: float = 300.0) -> List[List[int]]:
+                      timeout: float = 300.0,
+                      prefix=None) -> List[List[int]]:
         """Run several prompts CONCURRENTLY across the fleet.
 
         Submits each prompt as soon as a slot frees (earlier prompts
@@ -245,7 +292,8 @@ class EngineLoop:
                 progressed = False
                 while unsubmitted and self.engine._free:
                     i = unsubmitted.pop(0)
-                    rids[i] = self.engine.submit(prompts[i], max_new)
+                    rids[i] = self.engine.submit(prompts[i], max_new,
+                                                 prefix=prefix)
                     progressed = True
                 if progressed:
                     self.cond.notify_all()

@@ -56,6 +56,38 @@ def _rewind_cache_index(cache, position):
     return jax.tree_util.tree_map_with_path(rewind, cache)
 
 
+def splice_prefix(cache, prefix_kv, prefix_len, batch: int):
+    """Write a stored prefix KV block into slot 0 of a fresh cache and
+    cue the cursor at ``prefix_len`` (the prefix-cache primitive; see
+    models/prefix_cache.py for the host-side store).  The stored block
+    is [.., 1, PFX, ..] and broadcasts over the batch — a shared prefix
+    is shared by every sequence."""
+    def splice(path, big, small):
+        key = getattr(path[-1], "key", None)
+        if key in ("cached_key", "cached_value"):
+            # Leaf layout is [..., B, T, heads, dim] — under nn.scan a
+            # leading layer axis precedes the batch axis, so address
+            # batch as ndim-4, never axis 0.
+            bshape = small.shape[:-4] + (batch,) + small.shape[-3:]
+            block = jnp.broadcast_to(small, bshape)
+            return jax.lax.dynamic_update_slice(
+                big, block.astype(big.dtype), (0,) * big.ndim)
+        if key == "cache_index":
+            return jnp.zeros_like(big) + jnp.asarray(prefix_len, big.dtype)
+        return big
+
+    return jax.tree_util.tree_map_with_path(splice, cache, prefix_kv)
+
+
+def prefix_bucket_len(prefix_kv) -> int:
+    """Bucket (T-axis) length of a stored prefix KV tree."""
+    return next(
+        leaf.shape[-3]
+        for leaf in jax.tree_util.tree_leaves(prefix_kv)
+        if leaf.ndim >= 4
+    )
+
+
 def prefill_continue(model, params, cache, tokens: jax.Array, start,
                      true_end):
     """Continue a prefill: one MXU-dense forward over ``tokens`` [B, S]

@@ -48,29 +48,14 @@ from container_engine_accelerators_tpu.models.generate import (
     init_cache,
     prefill,
     prefill_continue,
+    prefix_bucket_len,
+    splice_prefix,
 )
 
-
-def _splice_prefix(cache, prefix_kv, prefix_len, batch: int):
-    """Write the stored prefix block into slot 0 of a fresh cache and
-    cue the cursor at ``prefix_len``.  The stored block is [1, PFX, ...]
-    and broadcasts over the request batch (a shared prefix is shared by
-    every sequence in the request)."""
-    def splice(path, big, small):
-        key = getattr(path[-1], "key", None)
-        if key in ("cached_key", "cached_value"):
-            # Leaf layout is [..., B, T, heads, dim] — under nn.scan a
-            # leading layer axis precedes the batch axis, so address
-            # batch as ndim-4, never axis 0.
-            bshape = small.shape[:-4] + (batch,) + small.shape[-3:]
-            block = jnp.broadcast_to(small, bshape)
-            return jax.lax.dynamic_update_slice(
-                big, block.astype(big.dtype), (0,) * big.ndim)
-        if key == "cache_index":
-            return jnp.zeros_like(big) + jnp.asarray(prefix_len, big.dtype)
-        return big
-
-    return jax.tree_util.tree_map_with_path(splice, cache, prefix_kv)
+# The splice/bucket primitives live in generate.py (shared with the
+# continuous-batching engine); re-exported here for callers that think
+# in prefix-cache terms.
+_splice_prefix = splice_prefix
 
 
 def generate_with_prefix(
@@ -99,17 +84,11 @@ def generate_with_prefix(
     b, s = suffix.shape
     if suffix_len is None:
         suffix_len = s
-    # Bucket length lives at the T axis (ndim-3) of any KV leaf; the
-    # cache_index leaves are lower-rank and must be skipped.
-    pfx_bucket = next(
-        leaf.shape[-3]
-        for leaf in jax.tree_util.tree_leaves(prefix_kv)
-        if leaf.ndim >= 4
-    )
+    pfx_bucket = prefix_bucket_len(prefix_kv)
     total = pfx_bucket + s + max_new_tokens
 
     cache = init_cache(model, b, total)
-    cache = _splice_prefix(cache, prefix_kv, prefix_len, b)
+    cache = splice_prefix(cache, prefix_kv, prefix_len, b)
     end = prefix_len + suffix_len
     cache, last = prefill_continue(
         model, params, cache, suffix, prefix_len, end)

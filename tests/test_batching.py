@@ -160,3 +160,43 @@ def test_engine_loop_concurrent_requests_match_solo(decode_model, params):
     assert len(results) == len(prompts)
     for i, p in enumerate(prompts):
         assert results[i] == _solo(decode_model, params, p, 5), i
+
+
+def test_prefix_spliced_slots_match_solo_generate(decode_model, params):
+    """Engine x prefix-cache: a slot started from a spliced prefix
+    block must emit exactly generate(prefix + suffix)'s tokens, while
+    plain and prefix requests interleave in the same fleet."""
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+    )
+
+    pc = PrefixCache(decode_model, params, max_prefix_len=4)
+    prefix = (5, 17, 42)
+    entry = pc.get_or_build(prefix)
+
+    eng = DecodeEngine(decode_model, params, max_slots=3, max_len=32)
+    r1 = eng.submit([7, 9], max_new=6, prefix=entry)
+    eng.step()
+    # A plain request joins mid-flight; then a second prefix request
+    # reusing the same entry at a different depth.
+    r2 = eng.submit([88, 3], max_new=5)
+    eng.step()
+    r3 = eng.submit([1], max_new=4, prefix=entry)
+    eng.run_until_drained()
+    assert eng.result(r1) == _solo(decode_model, params,
+                                   list(prefix) + [7, 9], 6)
+    assert eng.result(r2) == _solo(decode_model, params, [88, 3], 5)
+    assert eng.result(r3) == _solo(decode_model, params,
+                                   list(prefix) + [1], 4)
+
+
+def test_prefix_slot_capacity_guard(decode_model, params):
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+    )
+
+    pc = PrefixCache(decode_model, params, max_prefix_len=16)
+    entry = pc.get_or_build(tuple(range(1, 13)))  # bucket 16
+    eng = DecodeEngine(decode_model, params, max_slots=1, max_len=16)
+    with pytest.raises(ValueError, match="slot"):
+        eng.submit([1, 2, 3, 4, 5], max_new=4, prefix=entry)

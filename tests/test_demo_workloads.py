@@ -329,8 +329,8 @@ def test_serve_lm_speculative_flag_exclusions():
         serve.main(["--speculative", "2", "--slots", "2"])
     with pytest.raises(SystemExit, match="tp"):
         serve.main(["--speculative", "2", "--tp", "2"])
-    with pytest.raises(SystemExit, match="prefix-cache"):
-        serve.main(["--prefix-cache", "2", "--slots", "2"])
+    # --prefix-cache composes with --slots and --tp since the engine
+    # splice landed; only the speculative pairing stays excluded.
     with pytest.raises(SystemExit, match="prefix-cache"):
         serve.main(["--prefix-cache", "2", "--speculative", "2"])
 
@@ -413,3 +413,78 @@ def test_serve_lm_prefix_cache_with_tensor_parallel():
 
     a, b = gen(run1), gen(run2)
     assert (a[:, :6] == b[:, :6]).all()
+
+
+@pytest.mark.slow
+def test_serve_lm_http_prefix_cache_with_slots(tmp_path):
+    """--prefix-cache + --slots over real HTTP: prefix requests ride
+    the continuous-batching fleet (spliced slots) and must match the
+    same server's concatenated plain-engine answer."""
+    serve = _load("serve_lm_pfx_slots", "cmd", "serve_lm.py")
+    args = serve.parse_args(
+        ["--vocab-size", "64", "--num-layers", "1", "--num-heads", "2",
+         "--head-dim", "8", "--mlp-dim", "32", "--max-prompt-len", "16",
+         "--max-new-tokens", "4", "--port", "0", "--slots", "2",
+         "--prefix-cache", "2"])
+    run = serve.build_generate(args)
+
+    from container_engine_accelerators_tpu.models.batching import (
+        DecodeEngine,
+        EngineLoop,
+    )
+    from http.server import ThreadingHTTPServer
+
+    engine = DecodeEngine(
+        run.decode_model, run.params, max_slots=2,
+        max_len=serve.bucket_len(16, 16) + 4 + 16,
+    )
+    loop = EngineLoop(engine)
+    srv = ThreadingHTTPServer(
+        ("127.0.0.1", 0), serve.make_handler(run, args, loop))
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.load(r)
+
+    prefix = [7, 11, 13]
+    try:
+        with_pfx = post({"prefix_ids": prefix,
+                         "prompt_ids": [[1, 2], [5]]})
+        concat = post({"prompt_ids": [prefix + [1, 2], prefix + [5]]})
+        assert with_pfx["tokens"] == concat["tokens"]
+        assert run.prefix_cache.stats()["misses"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_serve_lm_engine_sizing_covers_prefix_admission():
+    """Fast regression for main()'s engine sizing: with --prefix-cache
+    the slot must accept the LARGEST admissible spliced request
+    (max-size prefix + max-bucket suffix + full decode budget)."""
+    import jax.numpy as jnp
+
+    serve = _load("serve_lm_sizing", "cmd", "serve_lm.py")
+    tiny = ["--vocab-size", "64", "--num-layers", "1", "--num-heads",
+            "2", "--head-dim", "8", "--mlp-dim", "32",
+            "--max-prompt-len", "8", "--max-new-tokens", "4",
+            "--port", "0", "--slots", "1"]
+    args = serve.parse_args(tiny + ["--prefix-cache", "2"])
+    run = serve.build_generate(args)
+    engine = serve.build_engine(run, args)
+    assert engine.max_len == 8 + 4 + 8
+    # Worst admissible case: prefix 7 (room 1 -> suffix bucket 1).
+    kv_entry = run.prefix_cache.get_or_build(tuple(range(1, 8)))
+    rid = engine.submit([9], max_new=4, prefix=kv_entry)
+    engine.run_until_drained()
+    assert len(engine.result(rid)) == 4
+    # Without the cache the slot stays at the plain size.
+    args_plain = serve.parse_args(tiny)
+    run_plain = serve.build_generate(args_plain)
+    assert serve.build_engine(run_plain, args_plain).max_len == 8 + 4
