@@ -84,6 +84,11 @@ def parse_args(argv=None):
                         "trained draft is what makes speculation pay; "
                         "without one the draft is random-init and "
                         "acceptance is ~1/vocab)")
+    p.add_argument("--prefill-chunk", type=int, default=0, metavar="T",
+                   help="prefill long prompts in T-token chunks "
+                        "(bounds the [prompt x cache] attention-score "
+                        "memory; numerics identical).  0 = single-shot; "
+                        "applies to the per-request path")
     p.add_argument("--prefix-cache", type=int, default=0, metavar="N",
                    help="cache up to N shared prompt prefixes' KV "
                         "blocks (models/prefix_cache.py): requests "
@@ -240,6 +245,7 @@ def build_generate(args):
             temperature=temperature if sample else 0.0,
             rng=jax.random.PRNGKey(seed),
             prompt_len=prompt_len,
+            prefill_chunk=args.prefill_chunk or None,
         )
 
     import threading
@@ -473,6 +479,14 @@ def main(argv=None):
     if args.speculative and args.tp > 1:
         raise SystemExit("--speculative and --tp > 1 are mutually "
                          "exclusive (the draft runs single-device)")
+    if args.prefill_chunk < 0:
+        raise SystemExit("--prefill-chunk must be >= 0")
+    if args.prefill_chunk and (args.speculative or args.prefix_cache):
+        raise SystemExit("--prefill-chunk wires into the plain "
+                         "per-request path only; the speculative and "
+                         "prefix-cache paths still run single-shot "
+                         "prefill, so combining would silently drop "
+                         "the promised memory bound — drop one flag")
     if args.prefix_cache and args.speculative:
         raise SystemExit("--prefix-cache and --speculative are mutually "
                          "exclusive for now (the draft has no spliced "

@@ -104,26 +104,39 @@ def prefill_continue(model, params, cache, tokens: jax.Array, start,
     speculative verify chunk (models/speculative.py inlines the same
     apply pattern to keep its per-round logits).
     """
-    s = tokens.shape[1]
     start = jnp.asarray(start, jnp.int32)
-    hidden, mutated = model.apply(
-        {"params": params, "cache": cache},
-        tokens,
-        positions=start + jnp.arange(s, dtype=jnp.int32),
-        mutable=["cache"],
-        project=False,
-    )
-    cache = _rewind_cache_index(mutated["cache"], true_end)
+    cache, hidden = _forward_chunk(model, params, cache, tokens, start)
+    cache = _rewind_cache_index(cache, true_end)
     h_last = jax.lax.dynamic_index_in_dim(
         hidden, jnp.maximum(true_end - start - 1, 0), axis=1,
         keepdims=False,
     )
+    return cache, _project_last(params, h_last)
+
+
+def _forward_chunk(model, params, cache, tokens, start):
+    """One decode-mode forward of ``tokens`` [B, S] at positions
+    ``start + arange(S)`` -> (cache with cursor advanced to the chunk
+    end, hidden [B, S, D])."""
+    s = tokens.shape[1]
+    hidden, mutated = model.apply(
+        {"params": params, "cache": cache},
+        tokens,
+        positions=jnp.asarray(start, jnp.int32)
+        + jnp.arange(s, dtype=jnp.int32),
+        mutable=["cache"],
+        project=False,
+    )
+    return mutated["cache"], hidden
+
+
+def _project_last(params, h_row):
+    """LM-head projection of one hidden row [B, D] -> logits [B, V]."""
     emb = params["embed"]["embedding"]
-    last = jnp.dot(
-        h_last, emb.T.astype(h_last.dtype),
+    return jnp.dot(
+        h_row, emb.T.astype(h_row.dtype),
         preferred_element_type=jnp.float32,
     )
-    return cache, last
 
 
 def prefill(model, params, prompt: jax.Array, prompt_len, max_len: int):
@@ -141,6 +154,68 @@ def prefill(model, params, prompt: jax.Array, prompt_len, max_len: int):
     return prefill_continue(model, params, cache, prompt, 0, prompt_len)
 
 
+def prefill_chunked(model, params, prompt: jax.Array, prompt_len,
+                    max_len: int, chunk: int):
+    """Prefill in ``chunk``-token pieces -> (cache at ``prompt_len``,
+    last logits) — numerically identical to :func:`prefill`.
+
+    The single-shot prefill's decode-mode attention materializes a
+    [B, P, L] score tensor; at long context that P*L term owns peak
+    memory.  Chunking caps it at [B, chunk, L] per piece while the
+    matmuls stay MXU-dense, the standard long-prompt TTFT/memory trade
+    (each chunk attends the cache written so far — exactly the chunked
+    continuation the speculative verifier already exercises).
+
+    ``prompt_len`` may be traced (bucket padding): every chunk advances
+    the cursor to its own end, each chunk yields its candidate for the
+    "last real token" hidden row, and the candidates are selected by
+    which chunk actually contains ``prompt_len - 1`` — then the cursor
+    rewinds to ``prompt_len`` with the usual dead-slot semantics.
+    """
+    b, plen = prompt.shape
+    if chunk < 1:
+        raise ValueError("chunk must be >= 1")
+    if chunk >= plen:
+        return prefill(model, params, prompt, prompt_len, max_len)
+    prompt_len = jnp.asarray(prompt_len, jnp.int32)
+    cache = init_cache(model, b, max_len)
+
+    # The full-size chunks run under ONE lax.scan so trace/compile cost
+    # stays constant in prompt length (a Python loop would unroll
+    # ceil(P/chunk) transformer forwards into the graph — worst exactly
+    # in the long-context regime this helper targets); the remainder
+    # chunk, if any, runs once eagerly.
+    def step(carry, i):
+        cache, h_last = carry
+        start = i * chunk
+        toks = jax.lax.dynamic_slice(prompt, (0, start), (b, chunk))
+        cache, hidden = _forward_chunk(model, params, cache, toks, start)
+        # Candidate for the hidden row of token prompt_len-1; ascending
+        # chunks make "overwrite whenever prompt_len-1 >= start" select
+        # exactly the containing chunk.
+        idx = jnp.clip(prompt_len - 1 - start, 0, chunk - 1)
+        cand = jax.lax.dynamic_index_in_dim(
+            hidden, idx, axis=1, keepdims=False)
+        h_last = jnp.where(prompt_len - 1 >= start, cand, h_last)
+        return (cache, h_last), None
+
+    n_full = plen // chunk
+    emb_dim = params["embed"]["embedding"].shape[1]
+    h0 = jnp.zeros((b, emb_dim), model.dtype)  # chunk 0 always overwrites
+    (cache, h_last), _ = jax.lax.scan(
+        step, (cache, h0), jnp.arange(n_full, dtype=jnp.int32))
+    start = n_full * chunk
+    if start < plen:
+        cache, hidden = _forward_chunk(
+            model, params, cache, prompt[:, start:], start)
+        idx = jnp.clip(prompt_len - 1 - start, 0, plen - start - 1)
+        cand = jax.lax.dynamic_index_in_dim(
+            hidden, idx, axis=1, keepdims=False)
+        h_last = jnp.where(prompt_len - 1 >= start, cand, h_last)
+    cache = _rewind_cache_index(cache, prompt_len)
+    return cache, _project_last(params, h_last.astype(model.dtype))
+
+
 def generate(
     model,
     params,
@@ -149,6 +224,7 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     prompt_len=None,
+    prefill_chunk: Optional[int] = None,
 ):
     """Generate ``max_new_tokens`` past ``prompt`` [B, P] -> [B, P+N].
 
@@ -183,7 +259,13 @@ def generate(
     max_len = plen + max_new_tokens
 
     # Phase 1: batched prefill (shared helper; see prefill()).
-    cache, last = prefill(model, params, prompt, prompt_len, max_len)
+    # ``prefill_chunk`` bounds the [B, P, L] attention-score tensor for
+    # long prompts (prefill_chunked) — numerics identical either way.
+    if prefill_chunk:
+        cache, last = prefill_chunked(model, params, prompt, prompt_len,
+                                      max_len, prefill_chunk)
+    else:
+        cache, last = prefill(model, params, prompt, prompt_len, max_len)
     gen = decode_loop(model, params, cache, last, prompt_len,
                       max_new_tokens, temperature, rng, prompt.dtype)
 

@@ -488,3 +488,32 @@ def test_serve_lm_engine_sizing_covers_prefix_admission():
     args_plain = serve.parse_args(tiny)
     run_plain = serve.build_generate(args_plain)
     assert serve.build_engine(run_plain, args_plain).max_len == 8 + 4
+
+
+@pytest.mark.slow
+def test_serve_lm_prefill_chunk_matches_single_shot():
+    import jax
+    import jax.numpy as jnp
+
+    serve = _load("serve_lm_chunk", "cmd", "serve_lm.py")
+    tiny = ["--vocab-size", "64", "--num-layers", "1", "--num-heads",
+            "2", "--head-dim", "8", "--mlp-dim", "32",
+            "--max-prompt-len", "8", "--max-new-tokens", "4",
+            "--port", "0"]
+    a = serve.build_generate(serve.parse_args(tiny))
+    b = serve.build_generate(serve.parse_args(tiny
+                                              + ["--prefill-chunk", "3"]))
+    prompt = jnp.asarray([[3, 1, 4, 1, 5, 9, 0, 0]], jnp.int32)
+    x = jax.device_get(a(prompt, 6, 0.0, 0, False))
+    y = jax.device_get(b(prompt, 6, 0.0, 0, False))
+    assert (x[:, :12] == y[:, :12]).all()
+
+
+def test_serve_lm_prefill_chunk_flag_validation():
+    serve = _load("serve_lm_chunk_excl", "cmd", "serve_lm.py")
+    with pytest.raises(SystemExit, match="prefill-chunk"):
+        serve.main(["--prefill-chunk", "-1"])
+    with pytest.raises(SystemExit, match="prefill-chunk"):
+        serve.main(["--prefill-chunk", "8", "--speculative", "2"])
+    with pytest.raises(SystemExit, match="prefill-chunk"):
+        serve.main(["--prefill-chunk", "8", "--prefix-cache", "2"])

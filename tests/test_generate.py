@@ -159,3 +159,58 @@ class TestGQA:
             transformer_lm(**bad).init(
                 jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
             )
+
+
+class TestChunkedPrefill:
+    """prefill_chunked == prefill, bit-for-bit, across chunk shapes
+    (the long-prompt memory bound must be a pure refactor of the
+    math)."""
+
+    @pytest.mark.parametrize("chunk", [1, 2, 3, 4, 7, 16])
+    def test_matches_single_shot(self, params, chunk):
+        from container_engine_accelerators_tpu.models.generate import (
+            prefill,
+            prefill_chunked,
+        )
+
+        model = transformer_lm(**CFG, decode=True)
+        prompt = jnp.asarray(
+            [[5, 17, 42, 7, 9, 1, 3], [8, 8, 2, 6, 4, 88, 11]],
+            jnp.int32)
+        c1, l1 = prefill(model, params, prompt, 7, 16)
+        c2, l2 = prefill_chunked(model, params, prompt, 7, 16, chunk)
+        assert jnp.allclose(l1, l2, atol=0, rtol=0)
+        for a, b in zip(jax.tree_util.tree_leaves(c1),
+                        jax.tree_util.tree_leaves(c2)):
+            assert (a == b).all()
+
+    def test_generate_with_chunked_prefill_is_exact(self, params):
+        model = transformer_lm(**CFG, decode=True)
+        prompt = jnp.asarray([[5, 17, 42, 7, 9, 1]], jnp.int32)
+        want = generate(model, params, prompt, 6)
+        got = generate(model, params, prompt, 6, prefill_chunk=4)
+        assert (want == got).all()
+
+    def test_bucket_padded_traced_prompt_len(self, params):
+        """prompt_len traced and NOT at a chunk boundary: the last-row
+        selection must pick the containing chunk."""
+        model = transformer_lm(**CFG, decode=True)
+        exact = jnp.asarray([[5, 17, 42, 7, 9]], jnp.int32)
+        padded = jnp.concatenate(
+            [exact, jnp.zeros((1, 3), jnp.int32)], axis=1)
+        want = generate(model, params, exact, 5)
+        fn = jax.jit(lambda p, n: generate(model, params, p, 5,
+                                           prompt_len=n,
+                                           prefill_chunk=3))
+        got = fn(padded, 5)
+        assert (got[:, :10] == want[:, :10]).all()
+
+    def test_rejects_bad_chunk(self, params):
+        from container_engine_accelerators_tpu.models.generate import (
+            prefill_chunked,
+        )
+
+        model = transformer_lm(**CFG, decode=True)
+        with pytest.raises(ValueError, match="chunk"):
+            prefill_chunked(model, params,
+                            jnp.zeros((1, 4), jnp.int32), 4, 8, 0)
