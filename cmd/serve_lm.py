@@ -39,6 +39,11 @@ def parse_args(argv=None):
     p.add_argument("--kv-heads", type=int, default=0,
                    help="GQA KV heads (0 = MHA); shrinks the KV cache "
                         "and the per-token HBM read by heads/kv-heads")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="MoE-LM decode (0 = dense): drop-free top-1 "
+                        "routing so the KV-cache contract holds; "
+                        "composes with slots/prefix/speculative/int8 "
+                        "(tests/test_compose.py)")
     p.add_argument("--weights", choices=("f32", "bf16", "int8"),
                    default="f32",
                    help="serving weight precision (models/quant.py): "
@@ -121,6 +126,7 @@ def build_generate(args):
         head_dim=args.head_dim,
         mlp_dim=args.mlp_dim,
         num_kv_heads=args.kv_heads or None,
+        num_experts=args.num_experts,
     )
     sample = jnp.zeros((1, 8), jnp.int32)
     # Optimizer must match cmd/train_lm.py's (adamw) so the checkpoint's

@@ -32,6 +32,11 @@ def parse_args(argv=None):
     p.add_argument("--mlp-dim", type=int, default=2048)
     p.add_argument("--kv-heads", type=int, default=0,
                    help="GQA KV heads (0 = MHA)")
+    p.add_argument("--num-experts", type=int, default=0,
+                   help="MoE-LM: Switch top-1 FFN with this many "
+                        "experts in every block (0 = dense).  Expert "
+                        "weights shard by the generic megatron/fsdp "
+                        "rules; not validated with --seq-parallel yet")
     p.add_argument("--seq-len", type=int, default=2048,
                    help="GLOBAL sequence length (sharded across the mesh "
                         "under --seq-parallel)")
@@ -91,6 +96,11 @@ def main(argv=None):
 
     seq_parallel = None if args.seq_parallel == "none" else args.seq_parallel
     n_dev = jax.device_count()
+    if args.num_experts and seq_parallel:
+        raise SystemExit(
+            "--num-experts with --seq-parallel is not validated: MoE "
+            "capacity routing under sequence sharding changes the "
+            "global token-drop semantics; drop one of the flags")
     if seq_parallel:
         if args.model_par > 1:
             raise SystemExit(
@@ -128,6 +138,7 @@ def main(argv=None):
         head_dim=args.head_dim,
         mlp_dim=args.mlp_dim,
         num_kv_heads=args.kv_heads or None,
+        num_experts=args.num_experts,
         seq_parallel=seq_parallel,
     )
     sample = jnp.ones((args.train_batch_size, args.seq_len), jnp.int32)
