@@ -80,7 +80,8 @@ def parse_args(argv=None):
                         "proposes K tokens per round, the target "
                         "verifies them in one chunked forward; output "
                         "is token-exact vs plain greedy.  0 = off; "
-                        "incompatible with --slots and --tp > 1")
+                        "composes with --prefix-cache, incompatible "
+                        "with --slots and --tp > 1")
     p.add_argument("--draft-layers", type=int, default=0,
                    help="draft depth for --speculative (0 = "
                         "num_layers/4, min 1)")
@@ -99,8 +100,9 @@ def parse_args(argv=None):
                         "blocks (models/prefix_cache.py): requests "
                         "carrying \"prefix_ids\" prefill only their "
                         "suffix after the first hit.  0 = off; "
-                        "composes with --tp, incompatible with "
-                        "--slots and --speculative")
+                        "composes with --tp, --slots and "
+                        "--speculative (each pairing exactness-"
+                        "pinned)")
     return p.parse_args(argv)
 
 
@@ -274,6 +276,7 @@ def build_generate(args):
 
     run.spec_accepted = 0
     run.spec_drafted = 0
+    run.stats_lock = stats_lock  # spec-prefix handler path shares it
 
     # Prefix caching: requests that mark their shared system prompt
     # ("prefix_ids") prefill only the suffix once the prefix KV is
@@ -303,6 +306,29 @@ def build_generate(args):
             )
 
         run.run_prefix = _run_prefix
+
+        if args.speculative:
+            # spec x prefix: the draft needs its OWN prefilled block
+            # for the shared prompt (models/speculative.py prefix=).
+            run.draft_prefix_cache = PrefixCache(
+                draft_model, draft_params,
+                max_prefix_len=args.max_prompt_len,
+                max_entries=args.prefix_cache,
+            )
+
+            @jax.jit
+            def _spec_prefix(t_kv, d_kv, prefix_len, suffix,
+                             suffix_len):
+                out, stats = generate_speculative(
+                    decode_model, params, draft_model, draft_params,
+                    suffix, args.max_new_tokens, k=args.speculative,
+                    prompt_len=suffix_len,
+                    prefix=(t_kv, d_kv, prefix_len),
+                )
+                return (out, stats["accepted"].sum(),
+                        stats["drafted"].sum())
+
+            run.spec_prefix = _spec_prefix
 
     # The continuous-batching engine (main, --slots) reuses the exact
     # model/params this closure serves.
@@ -347,6 +373,15 @@ def build_engine(run, args):
 def make_handler(run, args, engine_loop=None):
     import jax.numpy as jnp
     import numpy as np
+
+    def pad_row(ids):
+        """One request row -> (bucket-padded [1, B] array, true len).
+        The ONE place the per-row bucket/pad grammar lives — three
+        handler paths (plain, prefix, spec-prefix) share it, so their
+        compile keys and admission behavior cannot drift."""
+        plen = len(ids)
+        bucket = bucket_len(plen, args.max_prompt_len)
+        return jnp.asarray([ids + [0] * (bucket - plen)], jnp.int32), plen
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *a):
@@ -428,16 +463,29 @@ def make_handler(run, args, engine_loop=None):
                             rows, max_new, prefix=(kv, pfx_len))
                         toks = [prefix_ids + ids + gen[:max_new]
                                 for ids, gen in zip(rows, outs)]
+                    elif (getattr(run, "spec_prefix", None) is not None
+                          and temperature == 0):
+                        # Greedy + speculation: both models' spliced
+                        # blocks, suffix-only draft/verify.
+                        d_kv, _ = run.draft_prefix_cache.get_or_build(
+                            tuple(prefix_ids))
+                        toks = []
+                        for ids in rows:
+                            padded, plen = pad_row(ids)
+                            out, acc, dr = run.spec_prefix(
+                                kv, d_kv, pfx_len, padded, plen)
+                            with run.stats_lock:
+                                run.spec_accepted += int(acc)
+                                run.spec_drafted += int(dr)
+                            out = np.asarray(out)
+                            toks.append(prefix_ids + out[0][
+                                : plen + max_new].tolist())
                     else:
                         toks = []
                         for i, ids in enumerate(rows):
-                            plen = len(ids)
-                            bucket = bucket_len(plen,
-                                                args.max_prompt_len)
-                            padded = ids + [0] * (bucket - plen)
+                            padded, plen = pad_row(ids)
                             out = np.asarray(run.run_prefix(
-                                kv, pfx_len,
-                                jnp.asarray([padded], jnp.int32), plen,
+                                kv, pfx_len, padded, plen,
                                 temperature, seed + i, temperature > 0,
                             ))
                             toks.append(prefix_ids + out[0][
@@ -453,11 +501,9 @@ def make_handler(run, args, engine_loop=None):
                 else:
                     toks = []
                     for i, ids in enumerate(clean):
-                        plen = len(ids)
-                        bucket = bucket_len(plen, args.max_prompt_len)
-                        padded = ids + [0] * (bucket - plen)
+                        padded, plen = pad_row(ids)
                         out = np.asarray(run(
-                            jnp.asarray([padded], jnp.int32), plen,
+                            padded, plen,
                             temperature, seed + i, temperature > 0,
                         ))
                         toks.append(out[0][: plen + max_new].tolist())
@@ -493,10 +539,6 @@ def main(argv=None):
                          "prefix-cache paths still run single-shot "
                          "prefill, so combining would silently drop "
                          "the promised memory bound — drop one flag")
-    if args.prefix_cache and args.speculative:
-        raise SystemExit("--prefix-cache and --speculative are mutually "
-                         "exclusive for now (the draft has no spliced "
-                         "entry point); --slots and --tp both compose")
     run = build_generate(args)
     engine_loop = None
     if args.slots:

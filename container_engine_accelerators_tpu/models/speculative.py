@@ -45,7 +45,11 @@ import jax.numpy as jnp
 
 from container_engine_accelerators_tpu.models.generate import (
     _rewind_cache_index,
+    init_cache,
     prefill,
+    prefill_continue,
+    prefix_bucket_len,
+    splice_prefix,
 )
 
 
@@ -58,6 +62,7 @@ def generate_speculative(
     max_new_tokens: int,
     k: int = 4,
     prompt_len=None,
+    prefix=None,
 ):
     """Greedy-decode ``max_new_tokens`` past ``prompt`` [B, P] with
     k-token speculation -> (tokens [B, P+N], stats).
@@ -72,6 +77,13 @@ def generate_speculative(
     Output layout matches generate(): positions [prompt_len,
     prompt_len + max_new_tokens) hold the generated tokens, and they
     equal the target model's own greedy continuation token-for-token.
+
+    ``prefix`` is the prefix-cache composition:
+    ``(target_kv, draft_kv, prefix_len)`` — each model's OWN prefilled
+    block for the shared system prompt (a PrefixCache per model;
+    serve_lm holds both).  ``prompt`` then carries only the suffix and
+    the output layout stays suffix-local, exactly like
+    :func:`~.prefix_cache.generate_with_prefix`.
     """
     if not (model.decode and draft_model.decode):
         raise ValueError(
@@ -82,15 +94,40 @@ def generate_speculative(
     if prompt_len is None:
         prompt_len = plen
     prompt_len = jnp.asarray(prompt_len, jnp.int32)
+
+    if prefix is None:
+        prefix_len = jnp.zeros((), jnp.int32)
+        t_pfx_bucket = d_pfx_bucket = 0
+    else:
+        t_kv, d_kv, prefix_len = prefix
+        prefix_len = jnp.asarray(prefix_len, jnp.int32)
+        t_pfx_bucket = prefix_bucket_len(t_kv)
+        d_pfx_bucket = prefix_bucket_len(d_kv)
+    # ctx_len = global depth of the last real prompt token + 1: cache
+    # positions are ctx-global, while the output buffer stays
+    # suffix-local (prompt_len-indexed).
+    ctx_len = prefix_len + prompt_len
+
     # Margin: the final round can overshoot by up to k extra tokens,
     # and finished samples keep clamp-writing into the tail margin
     # while stragglers catch up.
-    total = plen + max_new_tokens + k + 1
+    margin = plen + max_new_tokens + k + 1
 
-    t_cache, t_last_logits = prefill(model, params, prompt, prompt_len,
-                                     total)
-    d_cache, _ = prefill(draft_model, draft_params, prompt, prompt_len,
-                         total)
+    if prefix is None:
+        t_cache, t_last_logits = prefill(
+            model, params, prompt, prompt_len, margin)
+        d_cache, _ = prefill(
+            draft_model, draft_params, prompt, prompt_len, margin)
+    else:
+        t_cache = init_cache(model, b, t_pfx_bucket + margin)
+        t_cache = splice_prefix(t_cache, t_kv, prefix_len, b)
+        t_cache, t_last_logits = prefill_continue(
+            model, params, t_cache, prompt, prefix_len, ctx_len)
+        d_cache = init_cache(draft_model, b, d_pfx_bucket + margin)
+        d_cache = splice_prefix(d_cache, d_kv, prefix_len, b)
+        d_cache, _ = prefill_continue(
+            draft_model, draft_params, d_cache, prompt, prefix_len,
+            ctx_len)
 
     tok0 = jnp.argmax(t_last_logits, axis=-1).astype(prompt.dtype)
     out = jnp.concatenate(
@@ -113,7 +150,7 @@ def generate_speculative(
     def body(carry):
         t_cache, d_cache, out, g, t_last, stats = carry
         active = g < max_new_tokens
-        p0 = prompt_len + g - 1  # [B] position of t_last
+        p0 = ctx_len + g - 1  # [B] global position of t_last
 
         # Draft phase: k+1 single-token steps (feed t_last, then each
         # proposal; the last feed only completes the draft cache).
@@ -159,8 +196,8 @@ def generate_speculative(
         )(out, row, prompt_len + g)
 
         g = g + m + 1
-        t_cache = _rewind_cache_index(t_cache, prompt_len + g - 1)
-        d_cache = _rewind_cache_index(d_cache, prompt_len + g - 1)
+        t_cache = _rewind_cache_index(t_cache, ctx_len + g - 1)
+        d_cache = _rewind_cache_index(d_cache, ctx_len + g - 1)
         stats = {
             "rounds": stats["rounds"] + 1,
             "drafted": stats["drafted"] + jnp.where(active, k, 0),

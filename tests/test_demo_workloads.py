@@ -329,10 +329,12 @@ def test_serve_lm_speculative_flag_exclusions():
         serve.main(["--speculative", "2", "--slots", "2"])
     with pytest.raises(SystemExit, match="tp"):
         serve.main(["--speculative", "2", "--tp", "2"])
-    # --prefix-cache composes with --slots and --tp since the engine
-    # splice landed; only the speculative pairing stays excluded.
-    with pytest.raises(SystemExit, match="prefix-cache"):
-        serve.main(["--prefix-cache", "2", "--speculative", "2"])
+    # --prefix-cache now composes with --slots, --tp AND --speculative
+    # (each pairing exactness-pinned); no SystemExit case remains for
+    # it.  NOTE for future flag lifts: a stale raises-assertion here
+    # does not fail cleanly — main() proceeds to serve_forever and
+    # HANGS the suite (it burned a 10-minute faulthandler timeout
+    # twice this round).
 
 
 @pytest.mark.slow
@@ -558,3 +560,44 @@ def test_train_lm_moe_seq_parallel_gated():
     with pytest.raises(SystemExit, match="num-experts"):
         train.main(["--num-experts", "4", "--seq-parallel", "ring",
                     "--train-steps", "2"])
+
+
+@pytest.mark.slow
+def test_serve_lm_http_prefix_with_speculative(tmp_path):
+    """--prefix-cache + --speculative over real HTTP: greedy requests
+    ride the dual-spliced draft/verify path and must match the same
+    server's concatenated plain answer (which routes through plain
+    spec — itself pinned exact vs generate)."""
+    serve = _load("serve_lm_pfx_spec", "cmd", "serve_lm.py")
+    args = serve.parse_args(
+        ["--vocab-size", "64", "--num-layers", "2", "--num-heads", "2",
+         "--head-dim", "8", "--mlp-dim", "32", "--max-prompt-len", "16",
+         "--max-new-tokens", "4", "--port", "0", "--speculative", "2",
+         "--draft-layers", "1", "--prefix-cache", "2"])
+    run = serve.build_generate(args)
+
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0),
+                              serve.make_handler(run, args))
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+    def post(payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return json.load(r)
+
+    prefix = [7, 11, 13]
+    try:
+        with_pfx = post({"prefix_ids": prefix, "prompt_ids": [[1, 2]]})
+        concat = post({"prompt_ids": [prefix + [1, 2]]})
+        assert with_pfx["tokens"] == concat["tokens"]
+        assert run.prefix_cache.stats()["misses"] == 1
+        assert run.draft_prefix_cache.stats()["misses"] == 1
+    finally:
+        srv.shutdown()

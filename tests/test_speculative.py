@@ -136,3 +136,54 @@ def test_rejects_non_decode_model_and_bad_k(target_params):
     with pytest.raises(ValueError, match="k must be"):
         generate_speculative(decode, target_params, decode,
                              target_params, PROMPT, 4, k=0)
+
+
+def test_prefix_composition_is_exact(target_params, reference):
+    """spec + prefix cache: each model's own spliced block + suffix
+    speculation must still emit the target's exact greedy continuation
+    (the last serving-feature pairing)."""
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+    )
+
+    model = transformer_lm(**CFG, decode=True)
+    draft_params = _params(CFG, 999)
+    t_pc = PrefixCache(model, target_params, max_prefix_len=2)
+    d_pc = PrefixCache(model, draft_params, max_prefix_len=2)
+    t_kv, plen = t_pc.get_or_build((5, 17))
+    d_kv, _ = d_pc.get_or_build((5, 17))
+    suffix = jnp.asarray([[42, 7], [9, 1]], jnp.int32)
+    out, stats = generate_speculative(
+        model, target_params, model, draft_params, suffix, 12, k=3,
+        prefix=(t_kv, d_kv, plen))
+    # Suffix-local layout: [suffix, generated]; the reference is the
+    # plain greedy continuation of prefix+suffix per row (the prefix
+    # is SHARED — every row sits behind the same system prompt).
+    full = jnp.concatenate(
+        [jnp.broadcast_to(jnp.asarray([[5, 17]], jnp.int32), (2, 2)),
+         suffix], axis=1)
+    want = generate(model, target_params, full, 12)
+    n = suffix.shape[1] + 12
+    assert (out[:, :n] == want[:, 2: 2 + n]).all()
+    assert int(stats["drafted"].min()) > 0
+
+
+def test_prefix_composition_with_shallow_draft(target_params, reference):
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+    )
+
+    model = transformer_lm(**CFG, decode=True)
+    draft = transformer_lm(**DRAFT_CFG, decode=True)
+    draft_params = _params(DRAFT_CFG, 7)
+    t_kv, plen = PrefixCache(model, target_params,
+                             max_prefix_len=2).get_or_build((5, 17))
+    d_kv, _ = PrefixCache(draft, draft_params,
+                          max_prefix_len=2).get_or_build((5, 17))
+    suffix = jnp.asarray([[42]], jnp.int32)
+    out, _ = generate_speculative(
+        model, target_params, draft, draft_params, suffix, 10, k=4,
+        prefix=(t_kv, d_kv, plen))
+    # Row 0 of the module-level reference IS greedy([5, 17, 42, ...]).
+    n = suffix.shape[1] + 10
+    assert (out[:1, :n] == reference[:1, 2: 2 + n]).all()
