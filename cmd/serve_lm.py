@@ -517,10 +517,10 @@ def make_handler(run, args, engine_loop=None):
     return Handler
 
 
-def main(argv=None):
-    logging.basicConfig(level=logging.INFO,
-                        format="%(asctime)s %(levelname)s %(message)s")
-    args = parse_args(argv)
+def validate_args(args):
+    """Flag-composition gates — the ONE copy, called by main() and by
+    the manifest test (tests/test_manifests.py): a rejected pairing in
+    a shipped manifest must fail CI, not CrashLoop on the cluster."""
     if args.slots and args.tp > 1:
         raise SystemExit("--slots and --tp > 1 are mutually exclusive "
                          "(the engine's cache is single-device)")
@@ -539,6 +539,13 @@ def main(argv=None):
                          "prefix-cache paths still run single-shot "
                          "prefill, so combining would silently drop "
                          "the promised memory bound — drop one flag")
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    args = parse_args(argv)
+    validate_args(args)
     run = build_generate(args)
     engine_loop = None
     if args.slots:

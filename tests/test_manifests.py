@@ -286,3 +286,30 @@ def test_installer_entrypoint_is_executable_bash():
         first = f.readline()
     assert first.startswith("#!/bin/bash")
     assert os.access(path, os.X_OK), "entrypoint.sh must be executable"
+
+
+def test_lm_serving_manifest_args_accepted():
+    """The LM serving Deployment's command line must be parseable by
+    the real server AND pass its flag-composition checks (a manifest
+    carrying a rejected pairing would CrashLoop on the cluster)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_lm_manifest", os.path.join(REPO, "cmd", "serve_lm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    c = _find_container(
+        os.path.join(REPO, "demo", "serving", "jax-lm-serving.yaml"),
+        "jax-lm-serving-container")
+    # The EXACT argv the container runs (everything after the script
+    # path) — a stray positional token must fail here like it would on
+    # the cluster, and the shared validate_args applies the same
+    # composition gates main() enforces.
+    assert c["command"][0] == "python3"
+    argv = c["command"][2:]
+    args = mod.parse_args(argv)
+    mod.validate_args(args)
+    # The demo ships the serving levers on.
+    assert args.slots and args.prefix_cache
+    assert args.weights == "int8" and args.kv_heads == 4
