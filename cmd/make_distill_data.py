@@ -1,0 +1,182 @@
+#!/usr/bin/env python3
+"""Generate a distillation corpus from a trained target LM.
+
+Speculative decoding's speedup is acceptance-rate times draft price
+(models/speculative.py): a random draft accepts ~1/vocab and a trained
+draft is what makes the k-token gamble pay.  The TPU-first recipe here
+is compositional — no new training loop, no teacher hooks:
+
+1. this tool samples the TARGET model autoregressively (batched
+   generate(), MXU prefill + decode scan) and streams the sampled
+   sequences into the standard token-shard format (data/tokens.py);
+2. the draft then trains on that corpus with plain
+   ``cmd/train_lm.py --data-dir`` — next-token CE against
+   target-generated text IS distillation onto the target's
+   conditional distribution;
+3. serve with ``--speculative K --draft-checkpoint-dir``.
+
+tests/test_distill.py closes the loop end-to-end: a draft distilled
+this way must beat the random-init draft's acceptance rate on the real
+speculative decoder.
+
+Reference altitude: the reference ships no model tooling at all; the
+in-framework analog is the train-then-serve contract
+(tests/test_demo_workloads.py) extended to the draft.
+
+Usage:
+  python cmd/make_distill_data.py --checkpoint-dir CK --out DIR \
+      --tokens 2000000 [model shape flags as in serve_lm]
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+log = logging.getLogger("make-distill-data")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--num-layers", type=int, default=12)
+    p.add_argument("--num-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--mlp-dim", type=int, default=2048)
+    p.add_argument("--kv-heads", type=int, default=0)
+    p.add_argument("--num-experts", type=int, default=0)
+    p.add_argument("--checkpoint-dir", required=True,
+                   help="target LM's orbax checkpoint (cmd/train_lm.py)")
+    p.add_argument("--out", required=True,
+                   help="token-shard output dir (data/tokens.py format)")
+    p.add_argument("--tokens", type=int, default=1_000_000,
+                   help="total corpus size (prompt + sampled tokens)")
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--prompt-len", type=int, default=8,
+                   help="random seed-prompt length per sequence")
+    p.add_argument("--gen-len", type=int, default=120,
+                   help="sampled tokens per sequence")
+    p.add_argument("--temperature", type=float, default=1.0,
+                   help="sampling temperature (1.0 keeps the target's "
+                        "own distribution — what the draft must learn; "
+                        "0 would collapse coverage to one greedy path "
+                        "per prompt)")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    args = parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from container_engine_accelerators_tpu.data.tokens import (
+        write_token_shards,
+    )
+    from container_engine_accelerators_tpu.models.checkpoint import (
+        TrainCheckpointer,
+    )
+    from container_engine_accelerators_tpu.models.generate import generate
+    from container_engine_accelerators_tpu.models.lm_train import (
+        create_lm_train_state,
+    )
+    from container_engine_accelerators_tpu.models.transformer import (
+        transformer_lm,
+    )
+
+    cfg = dict(
+        vocab_size=args.vocab_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        head_dim=args.head_dim,
+        mlp_dim=args.mlp_dim,
+        num_kv_heads=args.kv_heads or None,
+        num_experts=args.num_experts,
+    )
+    state = create_lm_train_state(
+        transformer_lm(**cfg), jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32),
+        tx=optax.adamw(3e-4, weight_decay=0.1),
+    )
+    # write_token_shards rebuilds the index from directory contents, so
+    # stale shards from a previous run would silently blend into this
+    # corpus — refuse (like native/tokpack and the array writer), and
+    # do it BEFORE the expensive checkpoint restore.
+    if os.path.isdir(args.out) and any(
+            f.endswith(".tokens") for f in os.listdir(args.out)):
+        raise SystemExit(
+            f"{args.out} already holds token shards — refusing to mix "
+            f"corpora (sample into a fresh dir)")
+
+    ck = TrainCheckpointer(os.path.abspath(args.checkpoint_dir))
+    state, step = ck.restore_latest(state)
+    ck.close()
+    if step is None:
+        raise SystemExit(
+            f"{args.checkpoint_dir}: no checkpoint found — distilling "
+            f"from random weights would teach the draft noise")
+    log.info("target: step-%d params from %s", step, args.checkpoint_dir)
+    # Only the params sample; dropping the state frees the restored
+    # Adam moments (2x params of device memory) for a bigger --batch.
+    params = state.params
+    del state
+
+    model = transformer_lm(**cfg, decode=True)
+    run = jax.jit(
+        lambda prompts, seed: generate(
+            model, params, prompts, args.gen_len,
+            temperature=args.temperature,
+            rng=jax.random.PRNGKey(seed),
+        )
+    )
+
+    per_seq = args.prompt_len + args.gen_len
+    per_batch = args.batch * per_seq
+    n_batches = max(1, -(-args.tokens // per_batch))
+    rng = np.random.default_rng(args.seed)
+    # Buffer host-side and flush few LARGE shards: one tiny shard +
+    # index rebuild per batch would be O(n^2) directory scans and
+    # hundreds of KB-sized files.
+    shard_tokens = 1 << 22  # ~16 MiB per shard
+    buffer, buffered, shard_idx, written = [], 0, 0, 0
+
+    def flush():
+        nonlocal buffer, buffered, shard_idx
+        if not buffer:
+            return
+        write_token_shards(
+            args.out, [np.concatenate(buffer)], name_offset=shard_idx)
+        shard_idx += 1
+        buffer, buffered = [], 0
+
+    for i in range(n_batches):
+        prompts = jnp.asarray(
+            rng.integers(0, args.vocab_size,
+                         (args.batch, args.prompt_len)),
+            jnp.int32,
+        )
+        out = np.asarray(run(prompts, args.seed + i))
+        buffer.append(out.reshape(-1).astype(np.uint32))
+        buffered += out.size
+        written += out.size
+        if buffered >= shard_tokens:
+            flush()
+        if (i + 1) % 10 == 0 or i + 1 == n_batches:
+            log.info("batch %d/%d: %d tokens sampled", i + 1,
+                     n_batches, written)
+    flush()
+    log.info("done: %d tokens in %d shards -> %s (train the draft "
+             "with cmd/train_lm.py --data-dir)", written, shard_idx,
+             args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
