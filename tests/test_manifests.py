@@ -313,3 +313,34 @@ def test_lm_serving_manifest_args_accepted():
     # The demo ships the serving levers on.
     assert args.slots and args.prefix_cache
     assert args.weights == "int8" and args.kv_heads == 4
+
+
+def test_lm_data_manifest_args_accepted_and_wired():
+    """The data-pipeline training Job: trainer argv parses, the init
+    container packs into the dir the trainer reads, and both mount the
+    shared volume."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "train_lm_manifest", os.path.join(REPO, "cmd", "train_lm.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    path = os.path.join(REPO, "demo", "tpu-training", "lm-data-tpu.yaml")
+    c = _find_container(path, "lm-data-tpu")
+    argv = c["command"][2:]
+    args = mod.parse_args(argv)
+    assert args.data_dir == "/data/shards"
+    assert args.checkpoint_dir == "/data/ckpt"
+
+    job = next(d for d in _docs(path) if d["kind"] == "Job")
+    pod = job["spec"]["template"]["spec"]
+    init = pod["initContainers"][0]
+    script = "\n".join(init["command"])
+    assert "--out /data/shards" in script  # packer fills what trainer reads
+    assert "tokpack" in script
+    data_mounts = {
+        cc["name"]: {m["name"] for m in cc["volumeMounts"]}
+        for cc in pod["containers"] + pod["initContainers"]
+    }
+    assert all("data" in m for m in data_mounts.values())
