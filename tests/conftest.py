@@ -122,3 +122,23 @@ def fake_dev(tmp_path):
 
     make("accel0", "accel1", "accel2", "accel3")
     return str(dev)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jax_executables_per_module():
+    """Free compiled XLA executables at module boundaries.
+
+    The suite grew past the point where one serial pytest process can
+    hold every test's compiled graph: the 2026-07-31 full run died at
+    90% with 'LLVM compilation error: Cannot allocate memory' ->
+    SIGSEGV while compiling the spec-prefix composition.  Graphs are
+    not shared across modules (each module builds its own shapes), so
+    clearing per module caps memory at one module's worth for the
+    cost of nothing but the yield."""
+    yield
+    # Only when jax was actually imported: a never-imported jax has no
+    # caches, and node-daemon/YAML-only modules (runnable from the
+    # jax-free requirements-node.txt env) must not gain the dependency.
+    jx = sys.modules.get("jax")
+    if jx is not None:
+        jx.clear_caches()

@@ -87,17 +87,22 @@ def _find_container(path, name):
     raise AssertionError(f"container {name} not found in {path}")
 
 
-def test_device_plugin_manifest_args_accepted():
-    """The DS command line must be parseable by the real binary."""
+def _load_cmd_module(filename):
+    """exec a cmd/ driver by path (argparsers live behind main guards)."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
-        "tpu_device_plugin_main",
-        os.path.join(REPO, "cmd", "tpu_device_plugin.py"),
+        filename.replace(".py", "_manifest"),
+        os.path.join(REPO, "cmd", filename),
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    parse_args = mod.parse_args
+    return mod
+
+
+def test_device_plugin_manifest_args_accepted():
+    """The DS command line must be parseable by the real binary."""
+    parse_args = _load_cmd_module("tpu_device_plugin.py").parse_args
 
     c = _find_container(os.path.join(REPO, "cmd", "device-plugin.yaml"),
                         "tpu-device-plugin")
@@ -292,12 +297,7 @@ def test_lm_serving_manifest_args_accepted():
     """The LM serving Deployment's command line must be parseable by
     the real server AND pass its flag-composition checks (a manifest
     carrying a rejected pairing would CrashLoop on the cluster)."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "serve_lm_manifest", os.path.join(REPO, "cmd", "serve_lm.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load_cmd_module("serve_lm.py")
 
     c = _find_container(
         os.path.join(REPO, "demo", "serving", "jax-lm-serving.yaml"),
@@ -319,12 +319,7 @@ def test_lm_data_manifest_args_accepted_and_wired():
     """The data-pipeline training Job: trainer argv parses, the init
     container packs into the dir the trainer reads, and both mount the
     shared volume."""
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "train_lm_manifest", os.path.join(REPO, "cmd", "train_lm.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _load_cmd_module("train_lm.py")
 
     path = os.path.join(REPO, "demo", "tpu-training", "lm-data-tpu.yaml")
     c = _find_container(path, "lm-data-tpu")
