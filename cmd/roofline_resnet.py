@@ -38,7 +38,62 @@ def parse_args(argv=None):
     p.add_argument("--depth", type=int, default=50)
     p.add_argument("--no-time", action="store_true",
                    help="compile + analyze only (no timed steps)")
+    p.add_argument("--analytic", action="store_true",
+                   help="fusion-optimistic HAND byte model instead of "
+                        "the compiled cost analysis: each activation "
+                        "crosses HBM a bounded number of times "
+                        "(~5x fwd+bwd), params ~6x with optimizer.  "
+                        "Backend-independent — XLA:CPU's bytes-accessed "
+                        "reflects CPU fusion and measured TPU MFU "
+                        "already exceeded the 'ceiling' it implies "
+                        "(BENCH_HW.md round-4 negative result)")
     return p.parse_args(argv)
+
+
+def _analytic_bytes(model, state, x):
+    """Fusion-optimistic per-step HBM traffic (bytes).
+
+    Activation accounting (scaling-book style): fwd writes each
+    layer's output once and reads it once downstream (~2A), bwd
+    re-reads the stored activations and streams gradient activations
+    in and out (~3A) -> ~5A at the activation dtype.  Params: fwd
+    read + bwd read + grad write + SGD-momentum read/write + param
+    write ~= 6P at f32.  Real fusion does better on some pairs and
+    worse on others; this is the OPTIMISTIC bound a measured number
+    should be judged against, not a prediction.
+    """
+    import jax
+    import numpy as np
+
+    def fwd(params, batch_stats, x):
+        return model.apply(
+            {"params": params, "batch_stats": batch_stats}, x,
+            mutable=["batch_stats", "intermediates"],
+            capture_intermediates=True,
+        )
+
+    out_shapes = jax.eval_shape(fwd, state.params, state.batch_stats, x)
+    inter = out_shapes[1]["intermediates"]
+    # Count each FUSED unit's output once: the default capture records
+    # every module __call__ (Conv output, then the SAME tensor again as
+    # the BatchNorm output, then again as the block output), which
+    # would overcount activation traffic ~2-3x.  Under fusion the
+    # conv->BN->relu chain materializes one tensor — keyed by the Conv
+    # (plus the tiny Dense head).
+    act_elems = sum(
+        int(np.prod(leaf.shape))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(inter)
+        if any(
+            getattr(k, "key", "").startswith(("Conv", "Dense"))
+            for k in path
+        )
+    )
+    act_bytes = 2  # bf16 activations (model dtype)
+    p_elems = sum(
+        int(np.prod(leaf.shape))
+        for leaf in jax.tree_util.tree_leaves(state.params)
+    )
+    return 5 * act_elems * act_bytes + 6 * p_elems * 4, act_elems, p_elems
 
 
 def _hbm_bw(device):
@@ -90,17 +145,30 @@ def main(argv=None):
             jax.jit(train_step, donate_argnums=(0,)), state, xs[0], ys[0]
         )
         nbytes = 0.0
-        try:
-            cost = step_fn.cost_analysis()
-            if isinstance(cost, list):
-                cost = cost[0]
-            nbytes = float(cost.get("bytes accessed", 0.0))
-        except Exception as e:  # noqa: BLE001 — backend-dependent
-            print(f"roofline: bytes accessed unavailable ({e!r})",
-                  file=sys.stderr)
+        if args.analytic:
+            nbytes, act_elems, p_elems = _analytic_bytes(
+                model, state, xs[0])
+            if not flops:
+                raise SystemExit(
+                    "roofline --analytic: compiled FLOP count "
+                    "unavailable on this backend; the byte model has "
+                    "nothing to divide")
+        else:
+            try:
+                cost = step_fn.cost_analysis()
+                if isinstance(cost, list):
+                    cost = cost[0]
+                nbytes = float(cost.get("bytes accessed", 0.0))
+            except Exception as e:  # noqa: BLE001 — backend-dependent
+                print(f"roofline: bytes accessed unavailable ({e!r})",
+                      file=sys.stderr)
         row = {"batch": batch, "image_size": size,
                "flops_per_step_T": round(flops / 1e12, 3),
                "bytes_per_step_G": round(nbytes / 1e9, 3)}
+        if args.analytic:
+            row["bytes_model"] = "analytic-optimistic"
+            row["activation_melems"] = round(act_elems / 1e6, 2)
+            row["param_melems"] = round(p_elems / 1e6, 1)
         if flops and nbytes:
             t_c = flops / peak
             t_m = nbytes / bw

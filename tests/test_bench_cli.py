@@ -149,3 +149,27 @@ def test_bench_prefix_cpu_smoke(tmp_path):
     assert lines[1]["vs_baseline"] > 0
     logged = [json.loads(l) for l in log.read_text().splitlines()]
     assert len(logged) == 2
+
+
+def test_roofline_analytic_mode(tmp_path):
+    """--analytic: the fusion-optimistic byte model emits a labeled,
+    backend-independent ceiling (the CPU cost-analysis shortcut is a
+    recorded negative result — BENCH_HW.md round 4)."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "cmd", "roofline_resnet.py"),
+         "--batches", "8", "--depth", "18", "--image-size", "32",
+         "--no-time", "--analytic"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["bytes_model"] == "analytic-optimistic"
+    assert row["bytes_per_step_G"] > 0
+    assert row["activation_melems"] > 0 and row["param_melems"] > 0
+    assert 0 < row["mfu_ceiling"] <= 1
+    assert row["bound"] in ("memory", "compute")
