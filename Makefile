@@ -64,7 +64,10 @@ presubmit:
 .PHONY: bench-hw
 bench-hw:
 	-python cmd/bench_micro.py
+	-BENCH_WORKLOAD=resnet BENCH_IMAGE_SIZE=96 BENCH_BATCH=64 BENCH_STEPS=60 python bench.py
+	-BENCH_WORKLOAD=resnet BENCH_IMAGE_SIZE=160 BENCH_BATCH=96 BENCH_STEPS=80 python bench.py
 	-python bench.py
+	-python cmd/roofline_check.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=f32 python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=4 BENCH_DECODE_WEIGHTS=f32 python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=int8 python bench.py

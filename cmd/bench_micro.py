@@ -167,6 +167,9 @@ def run_micro(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    from container_engine_accelerators_tpu.utils.compile_cache import enable
+
+    enable()
     import jax
     from bench import _log_tpu_result
 

@@ -44,6 +44,11 @@ import sys
 import time
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from container_engine_accelerators_tpu.utils.compile_cache import (  # noqa: E402
+    DEFAULT_CACHE_DIR,
+)
 
 # The probe requires an EXECUTED scalar jit, not just enumeration: the
 # round-4 tunnel window (BENCH_HW.md) answered jax.devices() and then
@@ -90,8 +95,31 @@ DEFAULT_STAGES = [
     # committed on-chip numbers before the heavyweight stages start.
     {"name": "bench_micro",
      "cmd": [sys.executable, "cmd/bench_micro.py"], "timeout": 900},
+    # Escalating ResNet ladder (VERDICT r4 item 1): each rung's compile
+    # is smaller and likelier to finish inside a short window, each
+    # banks its own tagged number (bench.py BENCH_IMAGE_SIZE), and
+    # every compile that completes persists in the shared compilation
+    # cache (utils/compile_cache.py) — so the next window replays the
+    # finished rungs in seconds and spends its life on the first rung
+    # the last window never reached.  Rungs keep a short retry budget:
+    # if the window just died, the full-shape stages behind them should
+    # not wait out a long probe dance first.
+    {"name": "bench_resnet_96px", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "resnet", "BENCH_IMAGE_SIZE": "96",
+             "BENCH_BATCH": "64", "BENCH_STEPS": "60",
+             "BENCH_RETRY_BUDGET": "240"},
+     "timeout": 1800},
+    {"name": "bench_resnet_160px", "cmd": [sys.executable, "bench.py"],
+     "env": {"BENCH_WORKLOAD": "resnet", "BENCH_IMAGE_SIZE": "160",
+             "BENCH_BATCH": "96", "BENCH_STEPS": "80",
+             "BENCH_RETRY_BUDGET": "240"},
+     "timeout": 1800},
     {"name": "bench_resnet", "cmd": [sys.executable, "bench.py"],
      "timeout": _BENCH_STAGE_TIMEOUT},
+    # Roofline validation (VERDICT r4 item 8): profile a few real steps
+    # and judge the analytic byte model against the measured trace.
+    {"name": "roofline_check",
+     "cmd": [sys.executable, "cmd/roofline_check.py"], "timeout": 2400},
     # Cheap stages right after the path validator: the decode stages
     # compile small graphs and time seconds of work, so even a short
     # tunnel window converts into several distinct measurements before
@@ -245,6 +273,17 @@ class Watcher:
         for stage in self.stages:
             name = stage["name"]
             env = dict(os.environ)
+            # Every stage shares one persistent compilation cache so a
+            # compile finished in ANY window is free in all later ones
+            # (utils/compile_cache.py; jax reads the env var natively,
+            # stages that call enable() lower the min-compile-time gate
+            # on top).  TPU_COMPILE_CACHE=0 must actually kill it —
+            # exporting the dir anyway would re-enable the cache behind
+            # the operator's back (jax honors the env var regardless of
+            # enable()'s early return).
+            if os.environ.get("TPU_COMPILE_CACHE", "1") != "0":
+                env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                               DEFAULT_CACHE_DIR)
             env.update(stage.get("env", {}))
             t0 = time.monotonic()
             try:

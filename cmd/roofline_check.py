@@ -1,0 +1,211 @@
+#!/usr/bin/env python3
+"""Validate the analytic roofline against ONE real profiler trace.
+
+VERDICT.md round 4, next-round item 8: round 4 recorded honestly that
+CPU-compiled cost analysis is not a roofline proxy (commit 0c20a7e) and
+then substituted a fusion-optimistic HAND byte model
+(``roofline_resnet.py --analytic``, commit a2a91eb) whose
+"0.33 MFU has headroom" conclusion has never been checked against a
+measured trace.  A hand model that has never met a trace is a
+hypothesis; this tool runs the confrontation the first time a tunnel
+window allows:
+
+1. compile the ResNet-50 train step, time real steps (bench.py's
+   nonce/sync discipline), profile a slice of them;
+2. aggregate the trace's device-plane op time (trace_summary.py);
+3. compare measured step time against the analytic byte model's floor
+   ``t_lower = max(flops/peak, bytes/hbm_bw)`` and classify where the
+   gap lives (MXU ops vs everything else).
+
+Verdicts (the ``roofline_verdict`` field):
+
+- ``model-confirmed-headroom`` — measured step >= 1.25x the analytic
+  floor AND non-MXU ops hold >= 25% of device time: the model's
+  headroom claim stands and the trace names the ops to fuse.
+- ``mxu-bound-headroom`` — step >= 1.25x floor but MXU ops dominate:
+  headroom exists *inside* the convs (layout/padding), not in fusion.
+- ``model-refuted-near-ceiling`` — measured step within 1.25x of the
+  floor: the chip is near the model's ceiling; 0.33-class MFU IS the
+  roofline and the headroom claim should be retracted.
+
+On an accelerator the verdict is appended to BENCH_TPU_LOG.jsonl (it
+is evidence), and always written to ``ROOFLINE_CHECK.json`` + printed
+as the last stdout line.  Reference altitude: the reference judges its
+comms numbers against a recorded harness run, not a hand model
+(gpudirect-tcpx/nccl-config.yaml:60-63).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Op families whose device time is MXU work (the convs' lowered names);
+# everything else (fusion = elementwise/BN chains, copy/transpose/
+# reduce, infeed) is the fusion-addressable remainder.
+_MXU_PREFIXES = ("convolution", "dot", "cudnn", "conv")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--batch", type=int, default=None,
+                   help="default: 128 on accel, 8 CPU smoke")
+    p.add_argument("--steps", type=int, default=None,
+                   help="timed steps (default 30 accel / 2 smoke)")
+    p.add_argument("--profile-steps", type=int, default=None,
+                   help="steps inside the trace (default 8 accel / 1)")
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--out", default=None,
+                   help="verdict JSON path (default REPO/ROOFLINE_CHECK.json)")
+    return p.parse_args(argv)
+
+
+def run_check(args):
+    from container_engine_accelerators_tpu.utils.compile_cache import enable
+
+    enable()
+    import jax
+    import jax.numpy as jnp
+
+    from bench import (_chip_hbm_bw, _chip_peak_flops, _compile_step,
+                       _validate_utilization)
+    from container_engine_accelerators_tpu.models import resnet
+    from container_engine_accelerators_tpu.models.train import (
+        cosine_sgd,
+        create_train_state,
+        train_step,
+    )
+    from roofline_resnet import _analytic_bytes
+    from trace_summary import summarize
+
+    dev = jax.devices()[0]
+    on_accel = dev.platform != "cpu"
+    batch = args.batch or (128 if on_accel else 8)
+    steps = args.steps or (30 if on_accel else 2)
+    prof_steps = args.profile_steps or (8 if on_accel else 1)
+    size = args.image_size or (224 if on_accel else 64)
+    peak, peak_src = _chip_peak_flops(dev)
+    bw, _ = _chip_hbm_bw(dev)
+
+    model = resnet(depth=50)
+    nonce = int(time.time_ns()) & 0x7FFFFFFF
+    xs = [jax.random.normal(jax.random.PRNGKey(nonce + i),
+                            (batch, size, size, 3), jnp.float32)
+          for i in range(4)]
+    ys = [jax.random.randint(jax.random.PRNGKey(nonce + 100 + i),
+                             (batch,), 0, 1000) for i in range(4)]
+    state = create_train_state(model, jax.random.PRNGKey(0), xs[0],
+                               tx=cosine_sgd(total_steps=1000))
+    step_fn, flops = _compile_step(
+        jax.jit(train_step, donate_argnums=(0,)), state, xs[0], ys[0])
+    model_bytes, act_elems, p_elems = _analytic_bytes(model, state, xs[0])
+
+    jax.block_until_ready(xs)
+    st, m = step_fn(state, xs[0], ys[0])
+    for i in range(3):
+        st, m = step_fn(st, xs[i % 4], ys[i % 4])
+    float(m["loss"])  # drain dispatch queue (see bench.py on sync)
+
+    prof_dir = tempfile.mkdtemp(prefix="roofline_check_")
+    t0 = time.perf_counter()
+    jax.profiler.start_trace(prof_dir)
+    for i in range(prof_steps):
+        st, m = step_fn(st, xs[i % 4], ys[i % 4])
+    float(m["loss"])
+    jax.profiler.stop_trace()
+    t_prof = time.perf_counter() - t0
+
+    # Timed region OUTSIDE the profiler: tracing overhead must not
+    # inflate the step time the verdict judges.
+    t0 = time.perf_counter()
+    for i in range(steps):
+        st, m = step_fn(st, xs[i % 4], ys[i % 4])
+    final_loss = float(m["loss"])
+    step_s = (time.perf_counter() - t0) / steps
+
+    try:
+        trace = summarize(prof_dir, top=30)
+    finally:
+        import shutil
+
+        shutil.rmtree(prof_dir, ignore_errors=True)
+    mxu_ms = sum(r["ms"] for r in trace["top_ops"]
+                 if r["op"].lower().startswith(_MXU_PREFIXES))
+    other_ms = max(trace["total_device_ms"] - mxu_ms, 0.0)
+    mxu_frac = mxu_ms / max(trace["total_device_ms"], 1e-9)
+
+    t_compute = flops / peak if flops else None
+    t_memory = model_bytes / bw
+    if t_compute is None:
+        # Memory floor alone would drastically understate a
+        # compute-bound step and inflate the headroom ratio — no
+        # confident verdict without both axes.
+        t_floor = ratio = None
+        verdict = "no-floor (compiled FLOP count unavailable)"
+    else:
+        t_floor = max(t_compute, t_memory)
+        # A step FASTER than the hardware floor is the tunnel's
+        # execution-cache replay mode (bench.py's round-1 9.4-MFU
+        # lesson) — raise instead of logging an impossible verdict.
+        _validate_utilization(t_floor / step_s, "roofline floor fraction",
+                              "the hardware floor", on_accel)
+        ratio = step_s / t_floor
+        if ratio < 1.25:
+            verdict = "model-refuted-near-ceiling"
+        elif mxu_frac < 0.75:
+            verdict = "model-confirmed-headroom"
+        else:
+            verdict = "mxu-bound-headroom"
+
+    return {
+        "metric": "roofline_check_resnet50_step_ms",
+        "value": round(step_s * 1e3, 2),
+        "unit": "ms/step",
+        "vs_baseline": round(ratio, 3) if ratio else None,
+        "roofline_verdict": verdict,
+        "batch": batch, "image_size": size, "steps": steps,
+        "profiled_steps": prof_steps,
+        "profiled_wall_s": round(t_prof, 2),
+        "flops_per_step_T": round(flops / 1e12, 3) if flops else None,
+        "model_bytes_G": round(model_bytes / 1e9, 3),
+        "t_floor_ms": round(t_floor * 1e3, 2) if t_floor else None,
+        "t_compute_ms": round(t_compute * 1e3, 2) if t_compute else None,
+        "t_memory_ms": round(t_memory * 1e3, 2),
+        "device_total_ms": trace["total_device_ms"],
+        "mxu_ms": round(mxu_ms, 3),
+        "other_ms": round(other_ms, 3),
+        "mxu_frac": round(mxu_frac, 4),
+        "top_ops": trace["top_ops"][:8],
+        "final_loss": round(final_loss, 4),
+        "peak_source": peak_src,
+        "nonce": nonce,
+        "on_accel": on_accel,
+    }
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    result = run_check(args)
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ROOFLINE_CHECK.json")
+    try:
+        with open(out, "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError as e:
+        print(f"roofline_check: could not write {out}: {e}",
+              file=sys.stderr)
+    if result.pop("on_accel"):
+        from bench import _log_tpu_result
+
+        _log_tpu_result(result)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

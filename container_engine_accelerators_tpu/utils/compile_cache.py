@@ -1,0 +1,68 @@
+"""Persistent XLA compilation cache for the evidence tooling.
+
+Round-5 rationale (VERDICT.md round 4, next-round item 1): the axon
+tunnel's observed up-windows are minutes long, and its dominant failure
+mode is a first heavy compile that never returns (round-4 window log in
+BENCH_HW.md).  A compile that completes ONCE must therefore be free in
+every later window — otherwise each new window re-pays the exact
+compile that killed the previous one.  JAX's persistent compilation
+cache (keyed by HLO + backend) provides that: ``enable()`` points it at
+a repo-local directory shared by every bench/watcher stage, so the
+escalating workload ladder (cmd/hw_watcher.py) resumes where the last
+window died instead of starting over.
+
+The reference caches its expensive build artifact the same way — the
+driver installer keys its installed driver by version and skips the
+rebuild on every later boot (reference
+nvidia-driver-installer/cos/entrypoint.sh's cache check); here the
+expensive artifact is the XLA executable.
+
+``enable()`` is deliberately tolerant: an older jax without these
+config names, or a read-only checkout, must never break a benchmark —
+the cache is an accelerant, not a dependency.
+"""
+
+import os
+import sys
+
+# One shared env name: jax itself reads it, the watcher exports it to
+# every stage, and enable() falls back to it — a stage that never calls
+# enable() still gets the directory (with jax's default >=1s
+# min-compile-time gate, which only skips compiles too cheap to matter).
+CACHE_DIR_ENV = "JAX_COMPILATION_CACHE_DIR"
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_CACHE_DIR = os.path.join(_REPO_ROOT, ".jax_compile_cache")
+
+
+def cache_dir() -> str:
+    """The cache directory of record: env override, else repo-local."""
+    return os.environ.get(CACHE_DIR_ENV) or DEFAULT_CACHE_DIR
+
+
+def enable(path=None, min_compile_seconds=0.5):
+    """Turn on the persistent compilation cache; returns the directory
+    actually configured, or None when this jax cannot (never raises).
+
+    ``min_compile_seconds`` drops to 0.5 s from jax's 1.0 s default so
+    the ladder's smaller rungs (whose compiles are seconds, not
+    minutes) are banked too; sub-half-second compiles stay uncached —
+    they cost less than the disk round-trip.
+    """
+    if os.environ.get("TPU_COMPILE_CACHE", "1") == "0":
+        return None
+    import jax
+
+    path = path or cache_dir()
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_seconds))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # noqa: BLE001 — accelerant, not dependency
+        print(f"compile_cache: not enabled ({e!r})", file=sys.stderr)
+        return None
+    return path

@@ -60,6 +60,28 @@ def test_latest_none_when_no_log(bench):
     assert bench._latest_logged_tpu("lm") is None
 
 
+def test_latest_respects_ladder_rung_tags(bench, monkeypatch):
+    """A reduced-resolution ladder rung (BENCH_IMAGE_SIZE, round-5
+    window-survival work) tags its metric `_96px`; the rung entry must
+    never stand in for the headline full-shape number, nor the
+    reverse when a rung stage asks for its own lineage."""
+    with open(bench.TPU_LOG, "w") as f:
+        f.write(json.dumps({
+            "metric": "resnet50_bf16_train_images_per_sec_1chip",
+            "value": 2709.0}) + "\n")
+        f.write(json.dumps({
+            "metric": "resnet50_bf16_train_images_per_sec_1chip_96px",
+            "value": 9000.0}) + "\n")
+    monkeypatch.delenv("BENCH_IMAGE_SIZE", raising=False)
+    assert bench._latest_logged_tpu("resnet")["value"] == 2709.0
+    monkeypatch.setenv("BENCH_IMAGE_SIZE", "96")
+    assert bench._latest_logged_tpu("resnet")["value"] == 9000.0
+    monkeypatch.setenv("BENCH_IMAGE_SIZE", "160")
+    assert bench._latest_logged_tpu("resnet") is None  # no 160px entry
+    monkeypatch.setenv("BENCH_IMAGE_SIZE", "224")  # explicit native
+    assert bench._latest_logged_tpu("resnet")["value"] == 2709.0
+
+
 @pytest.mark.slow
 def test_fallback_embeds_logged_tpu_entry(tmp_path):
     """Run the real orchestrator with an unreachable 'TPU' (probe

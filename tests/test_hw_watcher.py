@@ -188,6 +188,8 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
     )
     for tool in ("bench.py", "bench_micro.py", "bench_prefix.py",
                  "bench_attention.py", "roofline_resnet.py",
+                 "roofline_check.py", "BENCH_IMAGE_SIZE=96",
+                 "BENCH_IMAGE_SIZE=160",
                  "inject_error.py", "lm", "decode", "BENCH_DECODE_KV",
                  "BENCH_DECODE_WEIGHTS=int8", "BENCH_DECODE_FLASH=1",
                  "BENCH_DECODE_PROMPT=1984", "BENCH_DECODE_SPEC=4",
