@@ -59,6 +59,19 @@ def parse_args(argv=None):
     p.add_argument("--head-dim", type=int, default=16)
     p.add_argument("--mlp-dim", type=int, default=128)
     p.add_argument("--kv-heads", type=int, default=0)
+    p.add_argument("--speculative", type=int, default=0, metavar="K",
+                   help="speculative continuous batching "
+                        "(SpecDecodeEngine): the fleet drafts K tokens "
+                        "per round; sequential reference becomes "
+                        "per-request generate_speculative so the "
+                        "speedup isolates the batching, not the "
+                        "speculation")
+    p.add_argument("--spec-draft", choices=("self", "1L"), default="self",
+                   help="draft for --speculative: self = target drafts "
+                        "itself (acceptance ~1 — bounds the win), 1L = "
+                        "random 1-layer draft (acceptance ~0 — bounds "
+                        "the per-round overhead); bench.py's decode "
+                        "stages use the same bracket")
     return p.parse_args(argv)
 
 
@@ -71,9 +84,13 @@ def main(argv=None) -> int:
 
     from container_engine_accelerators_tpu.models.batching import (
         DecodeEngine,
+        SpecDecodeEngine,
         bucket_len,
     )
     from container_engine_accelerators_tpu.models.generate import generate
+    from container_engine_accelerators_tpu.models.speculative import (
+        generate_speculative,
+    )
     from container_engine_accelerators_tpu.models.lm_train import (
         create_lm_train_state,
     )
@@ -108,10 +125,30 @@ def main(argv=None) -> int:
     max_prompt = max(lens)
     max_len = bucket_len(max_prompt, max_prompt) + args.max_new
 
+    # Speculative mode: both paths speculate (same draft), so the
+    # reported ratio isolates continuous batching.
+    draft_model, draft_params = model, params
+    if args.speculative and args.spec_draft == "1L":
+        d_cfg = dict(cfg, num_layers=1)
+        d_state = create_lm_train_state(
+            transformer_lm(**d_cfg), jax.random.PRNGKey(1),
+            jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+        )
+        draft_model = transformer_lm(**d_cfg, decode=True)
+        draft_params = d_state.params
+
     # --- sequential path (compile outside the clock, per bucket) ----
-    run = jax.jit(
-        lambda p, n: generate(model, params, p, args.max_new, prompt_len=n)
-    )
+    if args.speculative:
+        run = jax.jit(
+            lambda p, n: generate_speculative(
+                model, params, draft_model, draft_params, p,
+                args.max_new, k=args.speculative, prompt_len=n)[0]
+        )
+    else:
+        run = jax.jit(
+            lambda p, n: generate(model, params, p, args.max_new,
+                                  prompt_len=n)
+        )
 
     def seq_one(ids):
         bucket = bucket_len(len(ids), max_prompt)
@@ -133,8 +170,14 @@ def main(argv=None) -> int:
     # --- engine path (single-threaded driver: fill free slots, step).
     # ONE engine instance for warm + timed runs: the jitted closures
     # live on the instance, and the fleet drains fully between runs.
-    eng = DecodeEngine(model, params, max_slots=args.slots,
-                       max_len=max_len)
+    if args.speculative:
+        eng = SpecDecodeEngine(
+            model, params, draft_model, draft_params,
+            max_slots=args.slots, max_len=max_len + args.speculative,
+            k=args.speculative)
+    else:
+        eng = DecodeEngine(model, params, max_slots=args.slots,
+                           max_len=max_len)
 
     def engine_run(reqs):
         rids, queue = {}, list(range(len(reqs)))
@@ -157,6 +200,10 @@ def main(argv=None) -> int:
     # Warm EVERY prefill bucket (matching the sequential warm above)
     # plus the fleet step, so no XLA compile lands inside the clock.
     engine_run([[0] * ln for ln in sorted(set(lens))])
+    if args.speculative:
+        # The warm run's rounds on synthetic all-zero prompts must not
+        # blend into the timed run's acceptance telemetry.
+        eng.spec_rounds = eng.spec_drafted = eng.spec_accepted = 0
     eng_out, eng_ttft, eng_s = engine_run(prompts)
 
     # Correctness gate: each request's FIRST token comes from a
@@ -182,8 +229,10 @@ def main(argv=None) -> int:
           f"{mean_seq_ttft * 1e3:.0f}ms)  engine[{args.slots} slots] "
           f"{eng_s:.2f}s ({tokens / eng_s:.1f} tok/s, mean TTFT "
           f"{mean_eng_ttft * 1e3:.0f}ms)", file=sys.stderr)
-    print(json.dumps({
-        "metric": "serving_continuous_batching_ttft_speedup",
+    stag = (f"_speck{args.speculative}{args.spec_draft}"
+            if args.speculative else "")
+    result = {
+        "metric": "serving_continuous_batching_ttft_speedup" + stag,
         "value": round(mean_seq_ttft / mean_eng_ttft, 3),
         "unit": f"x (mean burst TTFT, sequential/engine, "
                 f"{args.slots} slots)",
@@ -199,7 +248,14 @@ def main(argv=None) -> int:
         "exact_match_fraction": round(exact, 3),
         "platform": jax.devices()[0].platform,
         "nonce": nonce,
-    }))
+    }
+    if args.speculative:
+        result["spec_k"] = args.speculative
+        result["spec_draft"] = args.spec_draft
+        result["spec_accept_rate"] = round(
+            eng.spec_accepted / max(eng.spec_drafted, 1), 4)
+        result["spec_rounds"] = eng.spec_rounds
+    print(json.dumps(result))
     return 0
 
 

@@ -48,6 +48,7 @@ sys.path.insert(0, _REPO_ROOT)
 
 from container_engine_accelerators_tpu.utils.compile_cache import (  # noqa: E402
     DEFAULT_CACHE_DIR,
+    cache_enabled,
 )
 
 # The probe requires an EXECUTED scalar jit, not just enumeration: the
@@ -165,6 +166,15 @@ DEFAULT_STAGES = [
              "--num-heads", "16", "--head-dim", "64", "--mlp-dim", "4096",
              "--vocab-size", "32768"],
      "timeout": 1800},
+    # Speculative continuous batching (SpecDecodeEngine): self-draft
+    # bounds the win at acceptance ~1; both paths speculate so the
+    # ratio isolates the batching.
+    {"name": "bench_serving_spec",
+     "cmd": [sys.executable, "cmd/bench_serving.py", "--slots", "4",
+             "--requests", "12", "--max-new", "64", "--num-layers", "12",
+             "--num-heads", "16", "--head-dim", "64", "--mlp-dim", "4096",
+             "--vocab-size", "32768", "--speculative", "4"],
+     "timeout": 1800},
     # Prefix-cache TTFT lever: full-vs-spliced prefill at serving
     # shapes (one compile each; cheap next to the train stages).
     {"name": "bench_prefix",
@@ -277,11 +287,11 @@ class Watcher:
             # compile finished in ANY window is free in all later ones
             # (utils/compile_cache.py; jax reads the env var natively,
             # stages that call enable() lower the min-compile-time gate
-            # on top).  TPU_COMPILE_CACHE=0 must actually kill it —
+            # on top).  The kill-switch check is shared with enable():
             # exporting the dir anyway would re-enable the cache behind
             # the operator's back (jax honors the env var regardless of
             # enable()'s early return).
-            if os.environ.get("TPU_COMPILE_CACHE", "1") != "0":
+            if cache_enabled():
                 env.setdefault("JAX_COMPILATION_CACHE_DIR",
                                DEFAULT_CACHE_DIR)
             env.update(stage.get("env", {}))

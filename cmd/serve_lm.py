@@ -80,8 +80,10 @@ def parse_args(argv=None):
                         "proposes K tokens per round, the target "
                         "verifies them in one chunked forward; output "
                         "is token-exact vs plain greedy.  0 = off; "
-                        "composes with --prefix-cache, incompatible "
-                        "with --slots and --tp > 1")
+                        "composes with --prefix-cache and --slots "
+                        "(the fleet drafts/verifies per round — "
+                        "models/batching.py SpecDecodeEngine), "
+                        "incompatible with --tp > 1")
     p.add_argument("--draft-layers", type=int, default=0,
                    help="draft depth for --speculative (0 = "
                         "num_layers/4, min 1)")
@@ -331,9 +333,12 @@ def build_generate(args):
             run.spec_prefix = _spec_prefix
 
     # The continuous-batching engine (main, --slots) reuses the exact
-    # model/params this closure serves.
+    # model/params this closure serves; with --speculative it also
+    # builds its draft fleet from the same pair the per-request path
+    # uses (build_engine).
     run.decode_model = decode_model
     run.params = params
+    run.draft = (draft_model, draft_params) if args.speculative else None
 
     # Warm the compile cache for a representative shape (the greedy
     # path — which is spec_run when speculation is on).
@@ -355,18 +360,28 @@ from container_engine_accelerators_tpu.models.batching import (  # noqa: E402
 def build_engine(run, args):
     """Continuous-batching engine sized for this server's admission
     bound.  With the prefix cache on, a slot may hold prefix bucket +
-    suffix bucket (up to 2x the prompt bucket) before decode slots —
-    the lanes are sized for it (fast-tested in
-    tests/test_demo_workloads.py)."""
+    suffix bucket (up to 2x the prompt bucket) before decode slots;
+    with --speculative the lane reserves k more tail slots (a final
+    verify round can overshoot) — the lanes are sized for both
+    (fast-tested in tests/test_demo_workloads.py)."""
     from container_engine_accelerators_tpu.models.batching import (
         DecodeEngine,
+        SpecDecodeEngine,
     )
 
     prompt_bucket = bucket_len(args.max_prompt_len, args.max_prompt_len)
+    max_len = (prompt_bucket + args.max_new_tokens
+               + (prompt_bucket if args.prefix_cache else 0)
+               + args.speculative)
+    if args.speculative:
+        draft_model, draft_params = run.draft
+        return SpecDecodeEngine(
+            run.decode_model, run.params, draft_model, draft_params,
+            max_slots=args.slots, max_len=max_len, k=args.speculative,
+        )
     return DecodeEngine(
         run.decode_model, run.params, max_slots=args.slots,
-        max_len=prompt_bucket + args.max_new_tokens
-        + (prompt_bucket if args.prefix_cache else 0),
+        max_len=max_len,
     )
 
 
@@ -458,9 +473,17 @@ def make_handler(run, args, engine_loop=None):
                     if engine_loop is not None and temperature == 0:
                         # Greedy + slots: the fleet's slots start from
                         # the spliced block (DecodeEngine.submit
-                        # prefix=).
+                        # prefix=); the speculative engine also needs
+                        # the draft model's own spliced block.
+                        if getattr(run, "draft_prefix_cache",
+                                   None) is not None:
+                            d_kv, _ = run.draft_prefix_cache \
+                                .get_or_build(tuple(prefix_ids))
+                            pfx = (kv, d_kv, pfx_len)
+                        else:
+                            pfx = (kv, pfx_len)
                         outs = engine_loop.generate_many(
-                            rows, max_new, prefix=(kv, pfx_len))
+                            rows, max_new, prefix=pfx)
                         toks = [prefix_ids + ids + gen[:max_new]
                                 for ids, gen in zip(rows, outs)]
                     elif (getattr(run, "spec_prefix", None) is not None
@@ -524,10 +547,6 @@ def validate_args(args):
     if args.slots and args.tp > 1:
         raise SystemExit("--slots and --tp > 1 are mutually exclusive "
                          "(the engine's cache is single-device)")
-    if args.speculative and args.slots:
-        raise SystemExit("--speculative and --slots are mutually "
-                         "exclusive: greedy requests would route to the "
-                         "engine and the draft would never run")
     if args.speculative and args.tp > 1:
         raise SystemExit("--speculative and --tp > 1 are mutually "
                          "exclusive (the draft runs single-device)")

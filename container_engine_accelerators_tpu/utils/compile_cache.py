@@ -41,6 +41,15 @@ def cache_dir() -> str:
     return os.environ.get(CACHE_DIR_ENV) or DEFAULT_CACHE_DIR
 
 
+def cache_enabled(environ=None) -> bool:
+    """The kill-switch convention, in ONE place: ``enable()`` and the
+    watcher's stage-env export (cmd/hw_watcher.py) must agree, or
+    setting TPU_COMPILE_CACHE=0 would still export the dir and jax
+    would re-enable the cache behind the operator's back."""
+    environ = os.environ if environ is None else environ
+    return environ.get("TPU_COMPILE_CACHE", "1") != "0"
+
+
 def enable(path=None, min_compile_seconds=0.5):
     """Turn on the persistent compilation cache; returns the directory
     actually configured, or None when this jax cannot (never raises).
@@ -50,7 +59,7 @@ def enable(path=None, min_compile_seconds=0.5):
     minutes) are banked too; sub-half-second compiles stay uncached —
     they cost less than the disk round-trip.
     """
-    if os.environ.get("TPU_COMPILE_CACHE", "1") == "0":
+    if not cache_enabled():
         return None
     import jax
 

@@ -200,3 +200,122 @@ def test_prefix_slot_capacity_guard(decode_model, params):
     eng = DecodeEngine(decode_model, params, max_slots=1, max_len=16)
     with pytest.raises(ValueError, match="slot"):
         eng.submit([1, 2, 3, 4, 5], max_new=4, prefix=entry)
+
+
+# ---- speculative continuous batching (SpecDecodeEngine, round 5) ----
+#
+# The load-bearing property extends models/speculative.py's exactness
+# chain: interleaved draft/verify ROUNDS over the fleet must be
+# token-identical to per-request generate_speculative at any
+# acceptance rate — self-draft (acceptance ~1) and a random shallow
+# draft (acceptance ~0) bracket it.
+
+from container_engine_accelerators_tpu.models.batching import (  # noqa: E402
+    SpecDecodeEngine,
+)
+from container_engine_accelerators_tpu.models.speculative import (  # noqa: E402
+    generate_speculative,
+)
+
+D_CFG = dict(CFG, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    state = create_lm_train_state(
+        transformer_lm(**D_CFG), jax.random.PRNGKey(9),
+        jnp.zeros((1, 8), jnp.int32), tx=optax.sgd(0.1),
+    )
+    return transformer_lm(**D_CFG, decode=True), state.params
+
+
+def _solo_spec(decode_model, params, dm, dp, prompt_ids, n, k,
+               prefix=None):
+    prompt = jnp.asarray([prompt_ids], jnp.int32)
+    out, _ = generate_speculative(decode_model, params, dm, dp, prompt,
+                                  n, k=k, prefix=prefix)
+    return np.asarray(out)[0, len(prompt_ids): len(prompt_ids) + n].tolist()
+
+
+@pytest.mark.parametrize("which", ["self", "1L"])
+def test_spec_engine_matches_solo_speculative(decode_model, params,
+                                              draft, which):
+    dm, dp = (decode_model, params) if which == "self" else draft
+    eng = SpecDecodeEngine(decode_model, params, dm, dp, max_slots=3,
+                           max_len=40, k=3)
+    r1 = eng.submit([5, 17, 42], max_new=7)
+    eng.step()
+    # r2/r3 join mid-flight at different depths and buckets; r4 reuses
+    # a drained slot.
+    r2 = eng.submit([88, 3], max_new=5)
+    eng.step()
+    r3 = eng.submit([7, 9, 11, 2, 6], max_new=6)
+    eng.run_until_drained()
+    r4 = eng.submit([1, 2, 3], max_new=4)
+    eng.run_until_drained()
+    for rid, ids, n in [(r1, [5, 17, 42], 7), (r2, [88, 3], 5),
+                        (r3, [7, 9, 11, 2, 6], 6), (r4, [1, 2, 3], 4)]:
+        assert eng.result(rid) == _solo_spec(
+            decode_model, params, dm, dp, ids, n, 3), (which, rid)
+    assert eng.spec_rounds > 0 and eng.spec_drafted > 0
+    rate = eng.spec_accepted / eng.spec_drafted
+    # Self-draft accepts everything; a random 1-layer draft almost
+    # nothing — the bracket that makes the machinery's cost measurable.
+    assert rate == 1.0 if which == "self" else rate < 0.5
+
+
+def test_spec_engine_prefix_spliced_and_mixed(decode_model, params,
+                                              draft):
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+    )
+
+    dm, dp = draft
+    pfx_ids = (11, 22, 33, 44, 55)
+    t_kv, t_len = PrefixCache(decode_model, params,
+                              max_prefix_len=16).get_or_build(pfx_ids)
+    d_kv, _ = PrefixCache(dm, dp, max_prefix_len=16).get_or_build(pfx_ids)
+    eng = SpecDecodeEngine(decode_model, params, dm, dp, max_slots=2,
+                           max_len=48, k=3)
+    ra = eng.submit([5, 17], max_new=6, prefix=(t_kv, d_kv, t_len))
+    # A plain (unspliced) request shares the same fleet.
+    rb = eng.submit([3, 1, 4, 1, 5], max_new=5)
+    eng.run_until_drained()
+    assert eng.result(ra) == _solo_spec(
+        decode_model, params, dm, dp, [5, 17], 6, 3,
+        prefix=(t_kv, d_kv, t_len))
+    assert eng.result(rb) == _solo_spec(
+        decode_model, params, dm, dp, [3, 1, 4, 1, 5], 5, 3)
+
+
+def test_spec_engine_margin_admission(decode_model, params, draft):
+    """A request that would let a final verify round write past the
+    lane must be rejected up front (margin = k tail slots)."""
+    dm, dp = draft
+    eng = SpecDecodeEngine(decode_model, params, dm, dp, max_slots=1,
+                           max_len=16, k=4)
+    with pytest.raises(ValueError, match="slot holds"):
+        eng.submit([1, 2, 3], max_new=10)  # 3 + 10 + 4 = 17 > 16
+    eng.submit([1, 2, 3], max_new=9)  # 3 + 9 + 4 = 16: exactly fits
+    eng.run_until_drained()
+
+
+def test_spec_engine_eos_retires_early(decode_model, params):
+    """EOS inside an accepted run of drafts truncates and retires the
+    slot mid-round (self-draft so whole rounds are accepted)."""
+    eng = SpecDecodeEngine(decode_model, params, decode_model, params,
+                           max_slots=1, max_len=40, k=3)
+    full = SpecDecodeEngine(decode_model, params, decode_model, params,
+                            max_slots=1, max_len=40, k=3)
+    want = _solo_spec(decode_model, params, decode_model, params,
+                      [5, 17, 42], 8, 3)
+    eos = want[3]  # stop partway through the sequence
+    eng.eos_id = eos
+    rid = eng.submit([5, 17, 42], max_new=8)
+    eng.run_until_drained()
+    got = eng.result(rid)
+    assert got == want[: want.index(eos) + 1]
+    # The untouched engine still produces the full sequence.
+    rid2 = full.submit([5, 17, 42], max_new=8)
+    full.run_until_drained()
+    assert full.result(rid2) == want

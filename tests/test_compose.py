@@ -221,3 +221,62 @@ def test_moe_under_tensor_parallel_decode_matches_single_device():
         sharded
     ))
     np.testing.assert_array_equal(solo, tp)
+
+
+@pytest.mark.slow
+def test_spec_engine_with_int8_target_matches_solo():
+    """Speculative continuous batching x int8: the fleet's draft/verify
+    rounds on a quantized target must equal per-request speculative
+    generation on the same pair (round-5 matrix cell)."""
+    from container_engine_accelerators_tpu.models.batching import (
+        SpecDecodeEngine,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        generate_speculative,
+    )
+
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+    qp = serving_params(_params_for(cfg), "int8")
+    qm = transformer_lm(**cfg, decode=True, quant=True)
+    d_cfg = dict(cfg, num_layers=1)
+    dp = _params_for(d_cfg)
+    dm = transformer_lm(**d_cfg, decode=True)
+
+    eng = SpecDecodeEngine(qm, qp, dm, dp, max_slots=2, max_len=32, k=3)
+    rid = eng.submit(PROMPT, 5)
+    eng.run_until_drained()
+    out, _ = generate_speculative(
+        qm, qp, dm, dp, jnp.asarray([PROMPT], jnp.int32), 5, k=3)
+    want = np.asarray(out)[0, len(PROMPT): len(PROMPT) + 5].tolist()
+    assert eng.result(rid) == want
+
+
+@pytest.mark.slow
+def test_spec_engine_with_moe_target_matches_solo():
+    """Speculative continuous batching x MoE decode (round-5 matrix
+    cell): routing inside the verify chunk must not disturb the
+    acceptance rule."""
+    from container_engine_accelerators_tpu.models.batching import (
+        SpecDecodeEngine,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        generate_speculative,
+    )
+
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2, num_experts=4)
+    params = _params_for(cfg)
+    model = transformer_lm(**cfg, decode=True)
+    d_cfg = dict(cfg, num_layers=1, num_experts=0)
+    dp = _params_for(d_cfg)
+    dm = transformer_lm(**d_cfg, decode=True)
+
+    eng = SpecDecodeEngine(model, params, dm, dp, max_slots=2,
+                           max_len=32, k=3)
+    rid = eng.submit(PROMPT, 5)
+    eng.run_until_drained()
+    out, _ = generate_speculative(
+        model, params, dm, dp, jnp.asarray([PROMPT], jnp.int32), 5, k=3)
+    want = np.asarray(out)[0, len(PROMPT): len(PROMPT) + 5].tolist()
+    assert eng.result(rid) == want

@@ -194,6 +194,6 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
                  "BENCH_DECODE_WEIGHTS=int8", "BENCH_DECODE_FLASH=1",
                  "BENCH_DECODE_PROMPT=1984", "BENCH_DECODE_SPEC=4",
                  "BENCH_DECODE_SPEC_DRAFT=1L", "bench_serving.py",
-                 "inception"):
+                 "--speculative", "inception"):
         assert tool in joined, tool
         assert tool in mk
