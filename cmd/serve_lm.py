@@ -75,15 +75,17 @@ def parse_args(argv=None):
                         "style over this many local devices (decode "
                         "output is exactly the single-device tokens)")
     p.add_argument("--speculative", type=int, default=0, metavar="K",
-                   help="speculative decoding for greedy requests "
-                        "(models/speculative.py): a draft model "
-                        "proposes K tokens per round, the target "
-                        "verifies them in one chunked forward; output "
-                        "is token-exact vs plain greedy.  0 = off; "
-                        "composes with --prefix-cache and --slots "
-                        "(the fleet drafts/verifies per round — "
-                        "models/batching.py SpecDecodeEngine), "
-                        "incompatible with --tp > 1")
+                   help="speculative decoding (models/speculative.py): "
+                        "a draft proposes K tokens per round, the "
+                        "target verifies them in one chunked forward. "
+                        "Greedy requests are token-exact vs plain "
+                        "greedy; sampled requests use distribution-"
+                        "exact rejection sampling (output distribution "
+                        "identical to plain temperature sampling). "
+                        "0 = off; composes with --prefix-cache and "
+                        "--slots (the greedy fleet drafts/verifies per "
+                        "round — SpecDecodeEngine), incompatible with "
+                        "--tp > 1")
     p.add_argument("--draft-layers", type=int, default=0,
                    help="draft depth for --speculative (0 = "
                         "num_layers/4, min 1)")
@@ -194,15 +196,18 @@ def build_generate(args):
         params = jax.device_put(params, shard_params(params, tp_mesh))
         log.info("params sharded %d-way tensor parallel", args.tp)
 
-    # Speculative decoding (greedy requests only — the acceptance rule
-    # is argmax-match): a shallow draft proposes K tokens, the target
-    # verifies them in one chunked forward.  Exactness is free
-    # (models/speculative.py), speed depends on the draft actually
-    # predicting the target — load a trained draft for that.
-    spec_run = None
+    # Speculative decoding: greedy requests draft/verify with the
+    # argmax-match acceptance rule (token-exact vs plain greedy);
+    # sampled requests use distribution-exact rejection sampling
+    # (accept with prob min(1, p/q), resample the residual — output
+    # distribution identical to plain temperature sampling for ANY
+    # draft).  Speed depends on the draft actually predicting the
+    # target — load a trained draft for that.
+    spec_run = spec_run_sampled = None
     if args.speculative:
         from container_engine_accelerators_tpu.models.speculative import (
             generate_speculative,
+            generate_speculative_sampled,
         )
 
         d_cfg = dict(cfg, num_layers=args.draft_layers
@@ -242,6 +247,16 @@ def build_generate(args):
             )
             return out, stats["accepted"].sum(), stats["drafted"].sum()
 
+        @jax.jit
+        def spec_run_sampled(prompt, prompt_len, temperature, seed):
+            out, stats = generate_speculative_sampled(
+                decode_model, params, draft_model, draft_params,
+                prompt, args.max_new_tokens, k=args.speculative,
+                temperature=temperature, rng=jax.random.PRNGKey(seed),
+                prompt_len=prompt_len,
+            )
+            return out, stats["accepted"].sum(), stats["drafted"].sum()
+
     # The compile-cache key is (prompt BUCKET, sample?) only — nothing
     # a client controls beyond ~log2(max_prompt_len)*2 entries (ADVICE
     # r03: per-exact-length keys plus an honored per-request max_new
@@ -263,8 +278,12 @@ def build_generate(args):
     stats_lock = threading.Lock()
 
     def run(prompt, prompt_len, temperature, seed, sample):
-        if spec_run is not None and not sample:
-            out, acc, dr = spec_run(prompt, prompt_len)
+        if spec_run is not None:
+            if sample:
+                out, acc, dr = spec_run_sampled(
+                    prompt, prompt_len, temperature, seed)
+            else:
+                out, acc, dr = spec_run(prompt, prompt_len)
             # Rolling acceptance telemetry.  `+=` on an attribute is
             # load/add/store — not atomic under threaded handlers — so
             # the counters take the lock.
@@ -331,6 +350,22 @@ def build_generate(args):
                         stats["drafted"].sum())
 
             run.spec_prefix = _spec_prefix
+
+            @jax.jit
+            def _spec_prefix_sampled(t_kv, d_kv, prefix_len, suffix,
+                                     suffix_len, temperature, seed):
+                out, stats = generate_speculative_sampled(
+                    decode_model, params, draft_model, draft_params,
+                    suffix, args.max_new_tokens, k=args.speculative,
+                    temperature=temperature,
+                    rng=jax.random.PRNGKey(seed),
+                    prompt_len=suffix_len,
+                    prefix=(t_kv, d_kv, prefix_len),
+                )
+                return (out, stats["accepted"].sum(),
+                        stats["drafted"].sum())
+
+            run.spec_prefix_sampled = _spec_prefix_sampled
 
     # The continuous-batching engine (main, --slots) reuses the exact
     # model/params this closure serves; with --speculative it also
@@ -486,17 +521,23 @@ def make_handler(run, args, engine_loop=None):
                             rows, max_new, prefix=pfx)
                         toks = [prefix_ids + ids + gen[:max_new]
                                 for ids, gen in zip(rows, outs)]
-                    elif (getattr(run, "spec_prefix", None) is not None
-                          and temperature == 0):
-                        # Greedy + speculation: both models' spliced
-                        # blocks, suffix-only draft/verify.
+                    elif getattr(run, "spec_prefix", None) is not None:
+                        # Speculation over both models' spliced blocks,
+                        # suffix-only draft/verify: greedy uses the
+                        # argmax-acceptance round, sampling the
+                        # distribution-exact rejection round.
                         d_kv, _ = run.draft_prefix_cache.get_or_build(
                             tuple(prefix_ids))
                         toks = []
-                        for ids in rows:
+                        for i, ids in enumerate(rows):
                             padded, plen = pad_row(ids)
-                            out, acc, dr = run.spec_prefix(
-                                kv, d_kv, pfx_len, padded, plen)
+                            if temperature > 0:
+                                out, acc, dr = run.spec_prefix_sampled(
+                                    kv, d_kv, pfx_len, padded, plen,
+                                    temperature, seed + i)
+                            else:
+                                out, acc, dr = run.spec_prefix(
+                                    kv, d_kv, pfx_len, padded, plen)
                             with run.stats_lock:
                                 run.spec_accepted += int(acc)
                                 run.spec_drafted += int(dr)

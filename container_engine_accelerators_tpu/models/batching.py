@@ -53,6 +53,19 @@ def bucket_len(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def _lane_put(full, one, slot):
+    """Overwrite lane ``slot`` of a fleet cache tree with a one-lane
+    tree — the ONE copy of the lane-write layout rule: the slot axis
+    is every cache leaf's second axis (a leading ``nn.scan`` layer
+    axis precedes it).  Shared by the target insert
+    (``_insert_slot_impl``) and the speculative draft-lane insert."""
+    def put(f, o):
+        start = (0, slot) + (0,) * (f.ndim - 2)
+        return jax.lax.dynamic_update_slice(f, o.astype(f.dtype), start)
+
+    return jax.tree_util.tree_map(put, full, one)
+
+
 class DecodeEngine:
     """Fixed-fleet continuous-batching decoder (greedy).
 
@@ -112,13 +125,7 @@ class DecodeEngine:
 
     def _insert_slot_impl(self, cache, pos, last_tok, active,
                           slot_cache, tok0, slot, start_pos):
-        def put(full, one):
-            start = (0, slot) + (0,) * (full.ndim - 2)
-            return jax.lax.dynamic_update_slice(
-                full, one.astype(full.dtype), start
-            )
-
-        cache = jax.tree_util.tree_map(put, cache, slot_cache)
+        cache = _lane_put(cache, slot_cache, slot)
         return (
             cache,
             pos.at[slot].set(start_pos),
@@ -309,17 +316,9 @@ class SpecDecodeEngine(DecodeEngine):
                 prefix_len + suffix_len)
             return cache
 
-        def _insert_lane(full, one, slot):
-            def put(f, o):
-                start = (0, slot) + (0,) * (f.ndim - 2)
-                return jax.lax.dynamic_update_slice(
-                    f, o.astype(f.dtype), start)
-
-            return jax.tree_util.tree_map(put, full, one)
-
         self._prefill_draft = jax.jit(_prefill_draft)
         self._prefill_pfx_draft = jax.jit(_prefill_pfx_draft)
-        self._insert_lane = jax.jit(_insert_lane)
+        self._insert_lane = jax.jit(_lane_put)
         self._spec_step = jax.jit(self._spec_step_impl)
 
     # ---- jitted round ---------------------------------------------------

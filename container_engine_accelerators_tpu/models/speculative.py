@@ -53,6 +53,68 @@ from container_engine_accelerators_tpu.models.generate import (
 )
 
 
+def _spec_setup(model, params, draft_model, draft_params, prompt,
+                max_new_tokens, k, prompt_len, prefix):
+    """Shared prefill/splice setup for both speculative variants —
+    the cache/margin/ctx contract lives HERE so the greedy and sampled
+    rounds cannot drift: both caches cued past prompt (+ spliced
+    prefix), ``margin = plen + max_new + k + 1`` because the final
+    round can overshoot by up to k and finished samples keep
+    clamp-writing into the tail while stragglers catch up.
+
+    Returns ``(t_cache, d_cache, t_last_logits, ctx_len, prompt_len,
+    out0, g0, stats0)`` where ``out0`` is the output buffer WITHOUT
+    the first token written (the variants decode tok0 differently:
+    argmax vs a sample) and ``ctx_len`` is the global depth of the
+    last real prompt token + 1 — cache positions are ctx-global while
+    the output buffer stays suffix-local (prompt_len-indexed).
+    """
+    b, plen = prompt.shape
+    if prompt_len is None:
+        prompt_len = plen
+    prompt_len = jnp.asarray(prompt_len, jnp.int32)
+
+    if prefix is None:
+        prefix_len = jnp.zeros((), jnp.int32)
+        t_pfx_bucket = d_pfx_bucket = 0
+    else:
+        t_kv, d_kv, prefix_len = prefix
+        prefix_len = jnp.asarray(prefix_len, jnp.int32)
+        t_pfx_bucket = prefix_bucket_len(t_kv)
+        d_pfx_bucket = prefix_bucket_len(d_kv)
+    ctx_len = prefix_len + prompt_len
+    margin = plen + max_new_tokens + k + 1
+
+    if prefix is None:
+        t_cache, t_last_logits = prefill(
+            model, params, prompt, prompt_len, margin)
+        d_cache, _ = prefill(
+            draft_model, draft_params, prompt, prompt_len, margin)
+    else:
+        t_cache = init_cache(model, b, t_pfx_bucket + margin)
+        t_cache = splice_prefix(t_cache, t_kv, prefix_len, b)
+        t_cache, t_last_logits = prefill_continue(
+            model, params, t_cache, prompt, prefix_len, ctx_len)
+        d_cache = init_cache(draft_model, b, d_pfx_bucket + margin)
+        d_cache = splice_prefix(d_cache, d_kv, prefix_len, b)
+        d_cache, _ = prefill_continue(
+            draft_model, draft_params, d_cache, prompt, prefix_len,
+            ctx_len)
+
+    out0 = jnp.concatenate(
+        [prompt, jnp.zeros((b, max_new_tokens + k + 1), prompt.dtype)],
+        axis=1,
+    )
+    g0 = jnp.ones((b,), jnp.int32)  # tok0 emitted by the caller
+    stats0 = {
+        "rounds": jnp.zeros((), jnp.int32),
+        "drafted": jnp.zeros((b,), jnp.int32),
+        "accepted": jnp.zeros((b,), jnp.int32),
+    }
+    return (t_cache, d_cache, t_last_logits, ctx_len, prompt_len, out0,
+            g0, stats0)
+
+
 def generate_speculative(
     model,
     params,
@@ -91,57 +153,12 @@ def generate_speculative(
     if k < 1:
         raise ValueError("k must be >= 1")
     b, plen = prompt.shape
-    if prompt_len is None:
-        prompt_len = plen
-    prompt_len = jnp.asarray(prompt_len, jnp.int32)
-
-    if prefix is None:
-        prefix_len = jnp.zeros((), jnp.int32)
-        t_pfx_bucket = d_pfx_bucket = 0
-    else:
-        t_kv, d_kv, prefix_len = prefix
-        prefix_len = jnp.asarray(prefix_len, jnp.int32)
-        t_pfx_bucket = prefix_bucket_len(t_kv)
-        d_pfx_bucket = prefix_bucket_len(d_kv)
-    # ctx_len = global depth of the last real prompt token + 1: cache
-    # positions are ctx-global, while the output buffer stays
-    # suffix-local (prompt_len-indexed).
-    ctx_len = prefix_len + prompt_len
-
-    # Margin: the final round can overshoot by up to k extra tokens,
-    # and finished samples keep clamp-writing into the tail margin
-    # while stragglers catch up.
-    margin = plen + max_new_tokens + k + 1
-
-    if prefix is None:
-        t_cache, t_last_logits = prefill(
-            model, params, prompt, prompt_len, margin)
-        d_cache, _ = prefill(
-            draft_model, draft_params, prompt, prompt_len, margin)
-    else:
-        t_cache = init_cache(model, b, t_pfx_bucket + margin)
-        t_cache = splice_prefix(t_cache, t_kv, prefix_len, b)
-        t_cache, t_last_logits = prefill_continue(
-            model, params, t_cache, prompt, prefix_len, ctx_len)
-        d_cache = init_cache(draft_model, b, d_pfx_bucket + margin)
-        d_cache = splice_prefix(d_cache, d_kv, prefix_len, b)
-        d_cache, _ = prefill_continue(
-            draft_model, draft_params, d_cache, prompt, prefix_len,
-            ctx_len)
+    (t_cache, d_cache, t_last_logits, ctx_len, prompt_len, out, g0,
+     stats0) = _spec_setup(model, params, draft_model, draft_params,
+                           prompt, max_new_tokens, k, prompt_len, prefix)
 
     tok0 = jnp.argmax(t_last_logits, axis=-1).astype(prompt.dtype)
-    out = jnp.concatenate(
-        [prompt, jnp.zeros((b, max_new_tokens + k + 1), prompt.dtype)],
-        axis=1,
-    )
     out = jax.lax.dynamic_update_slice(out, tok0[:, None], (0, prompt_len))
-
-    g0 = jnp.ones((b,), jnp.int32)  # tok0 already emitted
-    stats0 = {
-        "rounds": jnp.zeros((), jnp.int32),
-        "drafted": jnp.zeros((b,), jnp.int32),
-        "accepted": jnp.zeros((b,), jnp.int32),
-    }
 
     def cond(carry):
         _, _, _, g, _, _ = carry
@@ -207,5 +224,149 @@ def generate_speculative(
 
     _, _, out, _, _, stats = jax.lax.while_loop(
         cond, body, (t_cache, d_cache, out, g0, tok0, stats0)
+    )
+    return out[:, : plen + max_new_tokens], stats
+
+
+def generate_speculative_sampled(
+    model,
+    params,
+    draft_model,
+    draft_params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    k: int = 4,
+    temperature: float = 1.0,
+    rng: Optional[jax.Array] = None,
+    prompt_len=None,
+    prefix=None,
+):
+    """Distribution-exact SAMPLED speculative decoding (VERDICT r4
+    item 3): the classic rejection scheme — draft samples ``x_i ~ q``,
+    the one chunked target forward yields ``p`` at every position,
+    ``x_i`` is accepted with probability ``min(1, p_i(x_i)/q_i(x_i))``,
+    and the first rejection resamples from the residual
+    ``normalize(max(p - q, 0))``; a fully-accepted round samples the
+    bonus position from ``p`` directly.  The output token distribution
+    is EXACTLY the target's temperature sampling, for ANY draft — the
+    draft only moves the speed (tests/test_speculative.py pins the
+    marginals against plain sampling with a deliberately mismatched
+    draft).
+
+    Same cache/cursor/layout contract as :func:`generate_speculative`
+    (bucket padding via ``prompt_len``, optional
+    ``prefix=(target_kv, draft_kv, prefix_len)`` splice, stats dict);
+    ``temperature`` may be a traced scalar but must be > 0 — the
+    greedy limit is :func:`generate_speculative`, which serve_lm
+    routes to separately.  The first token is sampled from the prefill
+    logits, as in ``generate()``'s sampled path.
+
+    Implementation notes: acceptance tests ``u * q(x) < p(x)`` (the
+    division-free form of ``u < p/q``); the bonus case reuses the
+    residual formula with ``q`` padded to zero at index k, where
+    ``max(p - 0, 0) = p``; an identically-zero residual (p == q
+    exactly) falls back to sampling ``p``.
+    """
+    if not (model.decode and draft_model.decode):
+        raise ValueError(
+            "generate_speculative_sampled() needs decode=True models")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    b, plen = prompt.shape
+    temperature = jnp.asarray(temperature, jnp.float32)
+    (t_cache, d_cache, t_last_logits, ctx_len, prompt_len, out, g0,
+     stats0) = _spec_setup(model, params, draft_model, draft_params,
+                           prompt, max_new_tokens, k, prompt_len, prefix)
+
+    rng, k0 = jax.random.split(rng)
+    tok0 = jax.random.categorical(
+        k0, t_last_logits / temperature).astype(prompt.dtype)
+    out = jax.lax.dynamic_update_slice(out, tok0[:, None], (0, prompt_len))
+
+    def cond(carry):
+        _, _, _, g, _, _, _ = carry
+        return jnp.min(g) < max_new_tokens
+
+    def body(carry):
+        t_cache, d_cache, out, g, t_last, stats, rkey = carry
+        active = g < max_new_tokens
+        p0 = ctx_len + g - 1
+        rkey, kd, ka, kr = jax.random.split(rkey, 4)
+
+        def dstep(c, i):
+            cache, tok, pos = c
+            logits, mut = draft_model.apply(
+                {"params": draft_params, "cache": cache},
+                tok[:, None],
+                positions=pos[:, None],
+                mutable=["cache"],
+            )
+            logits = logits[:, 0, :] / temperature
+            nxt = jax.random.categorical(
+                jax.random.fold_in(kd, i), logits).astype(tok.dtype)
+            return (mut["cache"], nxt, pos + 1), (
+                nxt, jax.nn.softmax(logits, axis=-1))
+
+        (d_cache, _, _), (draft_toks, draft_qs) = jax.lax.scan(
+            dstep, (d_cache, t_last, p0), jnp.arange(k + 1)
+        )
+        drafts = draft_toks.transpose(1, 0)[:, :k]       # [B, k]
+        qs = draft_qs.transpose(1, 0, 2)[:, :k, :]       # [B, k, V]
+
+        chunk = jnp.concatenate([t_last[:, None], drafts], axis=1)
+        pos_chunk = p0[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
+        logits, mut = model.apply(
+            {"params": params, "cache": t_cache},
+            chunk,
+            positions=pos_chunk,
+            mutable=["cache"],
+        )
+        t_cache = mut["cache"]
+        ps = jax.nn.softmax(logits / temperature, axis=-1)  # [B, k+1, V]
+
+        p_at = jnp.take_along_axis(
+            ps[:, :k, :], drafts[..., None], axis=-1)[..., 0]  # [B, k]
+        q_at = jnp.take_along_axis(
+            qs, drafts[..., None], axis=-1)[..., 0]            # [B, k]
+        u = jax.random.uniform(ka, (b, k))
+        accepted = (u * q_at < p_at).astype(jnp.int32)
+        m = jnp.sum(jnp.cumprod(accepted, axis=1), axis=1)     # [B]
+
+        # Residual at the first rejected position; q padded to zero at
+        # index k makes the all-accepted bonus case the same formula
+        # (max(p - 0, 0) = p).
+        qs_pad = jnp.concatenate(
+            [qs, jnp.zeros_like(ps[:, :1, :])], axis=1)        # [B, k+1, V]
+        p_m = jnp.take_along_axis(
+            ps, m[:, None, None], axis=1)[:, 0, :]             # [B, V]
+        q_m = jnp.take_along_axis(
+            qs_pad, m[:, None, None], axis=1)[:, 0, :]
+        res = jnp.maximum(p_m - q_m, 0.0)
+        res_sum = jnp.sum(res, axis=-1, keepdims=True)
+        safe = jnp.where(res_sum > 0, res, p_m)
+        next_tok = jax.random.categorical(
+            kr, jnp.log(safe + 1e-30)).astype(t_last.dtype)
+
+        row = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), drafts.dtype)], axis=1)
+        row = row.at[jnp.arange(b), m].set(next_tok)
+        out = jax.vmap(
+            lambda o, r, off: jax.lax.dynamic_update_slice(o, r, (off,))
+        )(out, row, prompt_len + g)
+
+        g = g + m + 1
+        t_cache = _rewind_cache_index(t_cache, ctx_len + g - 1)
+        d_cache = _rewind_cache_index(d_cache, ctx_len + g - 1)
+        stats = {
+            "rounds": stats["rounds"] + 1,
+            "drafted": stats["drafted"] + jnp.where(active, k, 0),
+            "accepted": stats["accepted"] + jnp.where(active, m, 0),
+        }
+        return t_cache, d_cache, out, g, next_tok, stats, rkey
+
+    _, _, out, _, _, stats, _ = jax.lax.while_loop(
+        cond, body, (t_cache, d_cache, out, g0, tok0, stats0, rng)
     )
     return out[:, : plen + max_new_tokens], stats

@@ -259,9 +259,12 @@ def test_spec_engine_matches_solo_speculative(decode_model, params,
             decode_model, params, dm, dp, ids, n, 3), (which, rid)
     assert eng.spec_rounds > 0 and eng.spec_drafted > 0
     rate = eng.spec_accepted / eng.spec_drafted
-    # Self-draft accepts everything; a random 1-layer draft almost
-    # nothing — the bracket that makes the machinery's cost measurable.
-    assert rate == 1.0 if which == "self" else rate < 0.5
+    # Self-draft accepts ~everything (not asserted exact: the [S,1]
+    # draft step and [S,k+1] verify chunk tile differently, and a bf16
+    # argmax near-tie can flip on-chip — batching.py's own caveat); a
+    # random 1-layer draft accepts almost nothing.  The bracket makes
+    # the machinery's cost measurable.
+    assert rate > 0.9 if which == "self" else rate < 0.5
 
 
 def test_spec_engine_prefix_spliced_and_mixed(decode_model, params,

@@ -318,9 +318,15 @@ def test_serve_lm_speculative_matches_plain_greedy(tmp_path):
     assert spec.spec_drafted > 0
     assert 0 <= spec.spec_accepted <= spec.spec_drafted
 
-    # Sampled requests keep the plain path (spec is greedy-only).
+    # Sampled requests route to distribution-exact rejection sampling
+    # (round 5 — no more silent greedy-only fallback): the spec
+    # counters must grow, and a fixed seed must be reproducible.
+    drafted_before = spec.spec_drafted
     out = np.asarray(spec(prompt, 3, 1.0, 42, True))
     assert out.shape == want.shape
+    assert spec.spec_drafted > drafted_before
+    again = np.asarray(spec(prompt, 3, 1.0, 42, True))
+    assert (out == again).all()
 
 
 def test_serve_lm_speculative_flag_exclusions():

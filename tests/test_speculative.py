@@ -187,3 +187,126 @@ def test_prefix_composition_with_shallow_draft(target_params, reference):
     # Row 0 of the module-level reference IS greedy([5, 17, 42, ...]).
     n = suffix.shape[1] + 10
     assert (out[:1, :n] == reference[:1, 2: 2 + n]).all()
+
+
+# ---- distribution-exact SAMPLED speculation (round 5) ---------------
+#
+# The sampled counterpart's contract is distributional, not
+# token-level: for ANY draft, rejection sampling makes the output
+# distribution exactly the target's temperature sampling.  The tests
+# use a deliberately mismatched draft whose own marginals sit far from
+# the target's (the power check), batch N independent rows in one
+# call, and compare per-position marginals by total variation.
+
+import numpy as np  # noqa: E402
+
+from container_engine_accelerators_tpu.models.speculative import (  # noqa: E402
+    generate_speculative_sampled,
+)
+
+S_CFG = dict(vocab_size=13, num_layers=2, num_heads=2, head_dim=4,
+             mlp_dim=16)
+S_DRAFT_CFG = dict(S_CFG, num_layers=1)
+
+
+def _marginal(out, prompt_len, pos, vocab):
+    toks = np.asarray(out)[:, prompt_len + pos]
+    return np.bincount(toks, minlength=vocab) / len(toks)
+
+
+def test_sampled_spec_matches_target_distribution():
+    tp = _params(S_CFG, 3)
+    dp = _params(S_DRAFT_CFG, 9)
+    model = transformer_lm(**S_CFG, decode=True)
+    draft = transformer_lm(**S_DRAFT_CFG, decode=True)
+    n, new = 1024, 3
+    prompt = jnp.tile(jnp.asarray([[5, 9, 3]], jnp.int32), (n, 1))
+
+    out_spec, stats = generate_speculative_sampled(
+        model, tp, draft, dp, prompt, new, k=2, temperature=1.0,
+        rng=jax.random.PRNGKey(42))
+    out_plain = generate(model, tp, prompt, new, temperature=1.0,
+                         rng=jax.random.PRNGKey(7))
+    out_draft = generate(draft, dp, prompt, new, temperature=1.0,
+                         rng=jax.random.PRNGKey(8))
+
+    for pos in range(new):
+        ms = _marginal(out_spec, 3, pos, 13)
+        mp = _marginal(out_plain, 3, pos, 13)
+        md = _marginal(out_draft, 3, pos, 13)
+        tv_spec = 0.5 * np.abs(ms - mp).sum()
+        tv_draft = 0.5 * np.abs(md - mp).sum()
+        # Noise floor at N=1024, V=13 is ~0.05; the mismatched draft
+        # sits ~0.4 away — a scheme biased toward the draft (e.g.
+        # always-accept) fails the first bound by a factor.
+        assert tv_spec < 0.15, (pos, tv_spec)
+        assert tv_draft > 0.25, (pos, tv_draft)  # the test has power
+    # The mismatched draft must reject a nontrivial fraction.
+    rate = int(stats["accepted"].sum()) / int(stats["drafted"].sum())
+    assert 0.0 < rate < 0.95
+
+
+def test_sampled_spec_deterministic_per_seed():
+    tp = _params(S_CFG, 3)
+    dp = _params(S_DRAFT_CFG, 9)
+    model = transformer_lm(**S_CFG, decode=True)
+    draft = transformer_lm(**S_DRAFT_CFG, decode=True)
+    prompt = jnp.asarray([[5, 9, 3], [1, 2, 4]], jnp.int32)
+    a, _ = generate_speculative_sampled(
+        model, tp, draft, dp, prompt, 6, k=2, temperature=0.8,
+        rng=jax.random.PRNGKey(1))
+    b, _ = generate_speculative_sampled(
+        model, tp, draft, dp, prompt, 6, k=2, temperature=0.8,
+        rng=jax.random.PRNGKey(1))
+    c, _ = generate_speculative_sampled(
+        model, tp, draft, dp, prompt, 6, k=2, temperature=0.8,
+        rng=jax.random.PRNGKey(2))
+    assert (np.asarray(a) == np.asarray(b)).all()
+    assert not (np.asarray(a) == np.asarray(c)).all()
+
+
+def test_sampled_spec_self_draft_accepts_nearly_everything():
+    """draft == target: p and q differ only by chunk-vs-step tiling
+    rounding, so acceptance must sit near 1 — the sampled analog of
+    the greedy self-draft invariant."""
+    tp = _params(S_CFG, 3)
+    model = transformer_lm(**S_CFG, decode=True)
+    prompt = jnp.tile(jnp.asarray([[5, 9, 3]], jnp.int32), (64, 1))
+    _, stats = generate_speculative_sampled(
+        model, tp, model, tp, prompt, 6, k=3, temperature=1.0,
+        rng=jax.random.PRNGKey(5))
+    rate = int(stats["accepted"].sum()) / int(stats["drafted"].sum())
+    assert rate > 0.9, rate
+
+
+@pytest.mark.slow
+def test_sampled_spec_prefix_matches_concatenated_distribution():
+    """Sampled speculation x prefix cache: the spliced-suffix path's
+    output distribution must match plain sampling over the
+    concatenated prompt (suffix-local layout, both models spliced)."""
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+    )
+
+    tp = _params(S_CFG, 3)
+    dp = _params(S_DRAFT_CFG, 9)
+    model = transformer_lm(**S_CFG, decode=True)
+    draft = transformer_lm(**S_DRAFT_CFG, decode=True)
+    pfx = (7, 11, 2)
+    t_kv, t_len = PrefixCache(model, tp,
+                              max_prefix_len=8).get_or_build(pfx)
+    d_kv, _ = PrefixCache(draft, dp, max_prefix_len=8).get_or_build(pfx)
+
+    n, new = 768, 2
+    suffix = jnp.tile(jnp.asarray([[5, 9]], jnp.int32), (n, 1))
+    out_spec, _ = generate_speculative_sampled(
+        model, tp, draft, dp, suffix, new, k=2, temperature=1.0,
+        rng=jax.random.PRNGKey(21), prefix=(t_kv, d_kv, t_len))
+    concat = jnp.tile(jnp.asarray([list(pfx) + [5, 9]], jnp.int32),
+                      (n, 1))
+    out_plain = generate(model, tp, concat, new, temperature=1.0,
+                         rng=jax.random.PRNGKey(22))
+    for pos in range(new):
+        ms = _marginal(out_spec, 2, pos, 13)       # suffix-local
+        mp = _marginal(out_plain, 5, pos, 13)      # concatenated
+        assert 0.5 * np.abs(ms - mp).sum() < 0.15, pos
