@@ -68,8 +68,9 @@ def parse_args(argv=None):
                         "compiled step (models/batching.py); greedy "
                         "requests join/leave mid-flight, sampled "
                         "requests fall back to per-request generate. "
-                        "0 = per-request serving; incompatible with "
-                        "--tp > 1")
+                        "0 = per-request serving; composes with --tp "
+                        "(the fleet cache shards its KV heads over the "
+                        "model axis) and --speculative")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree: shard params Megatron-"
                         "style over this many local devices (decode "
@@ -195,6 +196,8 @@ def build_generate(args):
         tp_mesh = create_mesh(data=1, model=args.tp, devices=devs)
         params = jax.device_put(params, shard_params(params, tp_mesh))
         log.info("params sharded %d-way tensor parallel", args.tp)
+    else:
+        tp_mesh = None
 
     # Speculative decoding: greedy requests draft/verify with the
     # argmax-match acceptance rule (token-exact vs plain greedy);
@@ -279,7 +282,10 @@ def build_generate(args):
 
     def run(prompt, prompt_len, temperature, seed, sample):
         if spec_run is not None:
-            if sample:
+            # sample with temperature <= 0 would divide logits by zero
+            # inside the rejection sampler; treat it as greedy, exactly
+            # like _run's `temperature if sample else 0.0` contract.
+            if sample and temperature > 0:
                 out, acc, dr = spec_run_sampled(
                     prompt, prompt_len, temperature, seed)
             else:
@@ -374,6 +380,9 @@ def build_generate(args):
     run.decode_model = decode_model
     run.params = params
     run.draft = (draft_model, draft_params) if args.speculative else None
+    # --tp --slots: the engine's persistent fleet state joins the same
+    # mesh the params shard over (models/batching.py _place_cache).
+    run.tp_mesh = tp_mesh
 
     # Warm the compile cache for a representative shape (the greedy
     # path — which is spec_run when speculation is on).
@@ -416,7 +425,7 @@ def build_engine(run, args):
         )
     return DecodeEngine(
         run.decode_model, run.params, max_slots=args.slots,
-        max_len=max_len,
+        max_len=max_len, mesh=run.tp_mesh,
     )
 
 
@@ -585,9 +594,6 @@ def validate_args(args):
     """Flag-composition gates — the ONE copy, called by main() and by
     the manifest test (tests/test_manifests.py): a rejected pairing in
     a shipped manifest must fail CI, not CrashLoop on the cluster."""
-    if args.slots and args.tp > 1:
-        raise SystemExit("--slots and --tp > 1 are mutually exclusive "
-                         "(the engine's cache is single-device)")
     if args.speculative and args.tp > 1:
         raise SystemExit("--speculative and --tp > 1 are mutually "
                          "exclusive (the draft runs single-device)")

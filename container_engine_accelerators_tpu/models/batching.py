@@ -79,17 +79,19 @@ class DecodeEngine:
     _margin = 0
 
     def __init__(self, model, params, max_slots: int, max_len: int,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None, mesh=None):
         if not model.decode:
             raise ValueError("DecodeEngine needs a model with decode=True")
         self.model, self.params = model, params
         self.max_slots, self.max_len = max_slots, max_len
         self.eos_id = eos_id
+        self.mesh = mesh
 
-        self.cache = init_cache(model, max_slots, max_len)
-        self.pos = jnp.zeros((max_slots,), jnp.int32)
-        self.last_tok = jnp.zeros((max_slots,), jnp.int32)
-        self.active = jnp.zeros((max_slots,), bool)
+        self.cache = self._place_cache(init_cache(model, max_slots,
+                                                  max_len))
+        self.pos = self._place(jnp.zeros((max_slots,), jnp.int32))
+        self.last_tok = self._place(jnp.zeros((max_slots,), jnp.int32))
+        self.active = self._place(jnp.zeros((max_slots,), bool))
 
         self._free = list(range(max_slots))
         self._req: Dict[int, dict] = {}  # slot -> {id, tokens, remaining}
@@ -120,6 +122,47 @@ class DecodeEngine:
         self._prefill_pfx = jax.jit(_prefill_pfx)
         self._insert_slot = jax.jit(self._insert_slot_impl)
         self._step = jax.jit(self._step_impl)
+
+    # ---- tensor-parallel placement --------------------------------------
+    #
+    # With ``mesh`` set (serve_lm --tp --slots), params arrive
+    # Megatron-sharded (parallel.shard_params) and the engine's
+    # PERSISTENT state must live on the same mesh — the fleet cache
+    # shards its KV-head axis over the model axis (each chip holds the
+    # heads it computes; GSPMD inserts the decode all-reduce), while
+    # the cursor/token/active vectors replicate.  Without a mesh both
+    # helpers are identity, and single-device behavior is unchanged.
+
+    def _place(self, x):
+        if self.mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(x, NamedSharding(self.mesh,
+                                               PartitionSpec()))
+
+    def _place_cache(self, cache):
+        if self.mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from container_engine_accelerators_tpu.parallel.mesh import (
+            MODEL_AXIS,
+        )
+
+        msize = self.mesh.shape.get(MODEL_AXIS, 1)
+
+        def spec(leaf):
+            # KV leaves are [..., B, T, heads, dim] (splice_prefix's
+            # layout rule); shard the heads axis when it divides.
+            if leaf.ndim >= 4 and leaf.shape[-2] % msize == 0:
+                s = [None] * leaf.ndim
+                s[-2] = MODEL_AXIS
+                return NamedSharding(self.mesh, PartitionSpec(*s))
+            return NamedSharding(self.mesh, PartitionSpec())
+
+        return jax.device_put(
+            cache, jax.tree_util.tree_map(spec, cache))
 
     # ---- jitted kernels -------------------------------------------------
 

@@ -322,3 +322,34 @@ def test_spec_engine_eos_retires_early(decode_model, params):
     rid2 = full.submit([5, 17, 42], max_new=8)
     full.run_until_drained()
     assert full.result(rid2) == want
+
+
+def test_tp_engine_matches_solo_generate(decode_model, params):
+    """Tensor-parallel continuous batching (round 5): with params
+    Megatron-sharded and the fleet cache's KV heads sharded over the
+    model axis, interleaved slot decoding must still equal
+    single-device per-request generate()."""
+    from container_engine_accelerators_tpu.parallel import (
+        create_mesh,
+        shard_params,
+    )
+
+    mesh = create_mesh(data=1, model=2, devices=jax.devices()[:2])
+    tp_params = jax.device_put(params, shard_params(params, mesh))
+    eng = DecodeEngine(decode_model, tp_params, max_slots=3, max_len=32,
+                       mesh=mesh)
+    r1 = eng.submit([5, 17, 42], max_new=7)
+    eng.step()
+    r2 = eng.submit([88, 3], max_new=5)
+    eng.run_until_drained()
+    r3 = eng.submit([1, 2, 3], max_new=4)  # slot reuse on the mesh
+    eng.run_until_drained()
+    assert eng.result(r1) == _solo(decode_model, params, [5, 17, 42], 7)
+    assert eng.result(r2) == _solo(decode_model, params, [88, 3], 5)
+    assert eng.result(r3) == _solo(decode_model, params, [1, 2, 3], 4)
+    # The fleet cache is genuinely distributed, not replicated.
+    kv_specs = {
+        str(x.sharding.spec)
+        for x in jax.tree_util.tree_leaves(eng.cache) if x.ndim >= 4
+    }
+    assert any("model" in s for s in kv_specs), kv_specs

@@ -717,3 +717,57 @@ def test_serve_lm_http_prefix_with_speculative_slots(tmp_path):
     finally:
         srv.shutdown()
     assert spliced["tokens"] == concat["tokens"]
+
+
+@pytest.mark.slow
+def test_serve_lm_http_slots_with_tensor_parallel(tmp_path):
+    """--slots x --tp over real HTTP (round 5, VERDICT r4 item 4): the
+    exclusion is gone; the engine built by build_engine joins the tp
+    mesh and the fleet's tokens equal the single-device per-request
+    path's."""
+    serve = _load("serve_lm_slots_tp", "cmd", "serve_lm.py")
+    tiny = ["--vocab-size", "64", "--num-layers", "1", "--num-heads", "2",
+            "--head-dim", "8", "--mlp-dim", "32", "--max-prompt-len", "8",
+            "--max-new-tokens", "4", "--port", "0"]
+    ref_run = serve.build_generate(serve.parse_args(tiny))
+
+    args = serve.parse_args(tiny + ["--tp", "2", "--slots", "2"])
+    serve.validate_args(args)  # composition admitted, not excluded
+    run = serve.build_generate(args)
+    assert run.tp_mesh is not None
+
+    from container_engine_accelerators_tpu.models.batching import (
+        EngineLoop,
+    )
+
+    engine = serve.build_engine(run, args)
+    assert engine.mesh is run.tp_mesh
+    loop = EngineLoop(engine)
+
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0),
+                              serve.make_handler(run, args, loop))
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps({"prompt_ids": [[1, 2, 3], [5]],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as r:
+            batched = json.load(r)
+    finally:
+        srv.shutdown()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    for ids, got in zip([[1, 2, 3], [5]], batched["tokens"]):
+        bucket = serve.bucket_len(len(ids), 8)
+        padded = ids + [0] * (bucket - len(ids))
+        want = np.asarray(ref_run(jnp.asarray([padded], jnp.int32),
+                                  len(ids), 0.0, 0, False))
+        assert got == want[0][: len(ids) + 4].tolist()
