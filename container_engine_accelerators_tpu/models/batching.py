@@ -29,11 +29,14 @@ scaled by an HPA on duty cycle (demo/serving/tensorflow-serving.yaml);
 this engine is the TPU-first replacement for the inner serving loop.
 """
 
+import logging
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
 
 from container_engine_accelerators_tpu.models.generate import (
     _rewind_cache_index,
@@ -136,10 +139,11 @@ class DecodeEngine:
     def _place(self, x):
         if self.mesh is None:
             return x
-        from jax.sharding import NamedSharding, PartitionSpec
+        from container_engine_accelerators_tpu.parallel.mesh import (
+            replicated,
+        )
 
-        return jax.device_put(x, NamedSharding(self.mesh,
-                                               PartitionSpec()))
+        return jax.device_put(x, replicated(self.mesh))
 
     def _place_cache(self, cache):
         if self.mesh is None:
@@ -148,9 +152,11 @@ class DecodeEngine:
 
         from container_engine_accelerators_tpu.parallel.mesh import (
             MODEL_AXIS,
+            replicated,
         )
 
         msize = self.mesh.shape.get(MODEL_AXIS, 1)
+        fallback = [False]
 
         def spec(leaf):
             # KV leaves are [..., B, T, heads, dim] (splice_prefix's
@@ -159,10 +165,19 @@ class DecodeEngine:
                 s = [None] * leaf.ndim
                 s[-2] = MODEL_AXIS
                 return NamedSharding(self.mesh, PartitionSpec(*s))
-            return NamedSharding(self.mesh, PartitionSpec())
+            if leaf.ndim >= 4:
+                fallback[0] = True
+            return replicated(self.mesh)
 
-        return jax.device_put(
+        placed = jax.device_put(
             cache, jax.tree_util.tree_map(spec, cache))
+        if fallback[0]:
+            # Per-chip cache memory will NOT scale 1/tp — an operator
+            # who sized slots for sharded lanes must hear about it.
+            log.warning(
+                "fleet KV heads do not divide the model axis (%d-way); "
+                "cache replicated on every chip", msize)
+        return placed
 
     # ---- jitted kernels -------------------------------------------------
 
