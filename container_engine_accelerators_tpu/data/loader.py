@@ -39,22 +39,44 @@ def _prefetched(batch_fn, start_step: int, num_steps: int,
                 prefetch: int) -> Iterator:
     """Yield ``batch_fn(s)`` for s in [start, start+num) in order,
     produced by a background thread.  Producer errors (e.g. vocab
-    overflow) are re-raised at the consuming step, not swallowed."""
+    overflow) are re-raised at the consuming step, not swallowed.
+
+    An abandoned iterator (exception/SystemExit mid-training, partial
+    consumption) must not leak the producer: a blocking ``q.put``
+    would park the thread forever once the consumer stops draining
+    (ADVICE r4), so every put polls a ``closed`` event that the
+    consumer's ``finally`` sets — generator close/GC wakes the
+    producer within one poll interval and it exits."""
     q: "queue.Queue" = queue.Queue(maxsize=max(prefetch, 1))
+    closed = threading.Event()
+
+    def put_until_closed(item) -> bool:
+        while not closed.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def produce():
         try:
             for s in range(start_step, start_step + num_steps):
-                q.put(batch_fn(s))
+                if not put_until_closed(batch_fn(s)):
+                    return
         except BaseException as e:  # noqa: BLE001 — re-raised below
-            q.put(e)
+            put_until_closed(e)
 
-    threading.Thread(target=produce, daemon=True).start()
-    for _ in range(num_steps):
-        item = q.get()
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    threading.Thread(target=produce, daemon=True,
+                     name="tokenloader-prefetch").start()
+    try:
+        for _ in range(num_steps):
+            item = q.get()
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        closed.set()
 
 
 class TokenBatchLoader:

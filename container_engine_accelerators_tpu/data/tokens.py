@@ -34,13 +34,26 @@ def write_token_shards(directory: str, streams: Iterable[np.ndarray],
     existing shards; the index is rebuilt from the directory contents
     so it always reflects what is actually on disk.
     """
-    os.makedirs(directory, exist_ok=True)
-    paths = []
+    # Validate EVERY stream before writing ANY shard: a mid-loop
+    # rejection would leave earlier shards on disk with no index
+    # rebuild — an orphan a later write's directory-scan rebuild would
+    # silently adopt.
+    arrays = []
     for i, stream in enumerate(streams):
         arr = np.ascontiguousarray(np.asarray(stream), dtype=_DTYPE)
         if arr.ndim != 1:
             raise ValueError(f"stream {i}: want 1-D tokens, got "
                              f"shape {arr.shape}")
+        if arr.size == 0:
+            # A 0-byte shard would crash TokenShardReader inside
+            # np.memmap with an opaque mmap error (ADVICE r4); fail at
+            # the format level, at write time.
+            raise ValueError(f"stream {i}: empty token stream — a "
+                             f"zero-byte shard cannot be memory-mapped")
+        arrays.append(arr)
+    os.makedirs(directory, exist_ok=True)
+    paths = []
+    for i, arr in enumerate(arrays):
         path = os.path.join(directory, f"{name_offset + i:05d}.tokens")
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:

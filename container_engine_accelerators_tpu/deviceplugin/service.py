@@ -4,8 +4,11 @@ TPU-native port of the reference's pluginServiceV1Beta1
 (ref: pkg/gpu/nvidia/beta_plugin.go:35-103): ListAndWatch streams the
 device list and re-sends it on every health transition; Allocate validates
 sharing, maps device IDs to device nodes, and attaches default devices,
-library mounts, and the env contract.  PreStartContainer and
-GetPreferredAllocation are intentionally no-ops (beta_plugin.go:95-103).
+library mounts, and the env contract.  PreStartContainer stays a logged
+no-op like the reference's (beta_plugin.go:95-103), but — unlike the
+reference, whose host GPUs are interchangeable — GetPreferredAllocation
+is REAL here: TPU chips sit on an ICI mesh, so the plugin opts into the
+kubelet hook and returns ICI-aware picks (deviceplugin/preferred.py).
 """
 
 import logging

@@ -85,6 +85,48 @@ def test_loader_vocab_overflow_raises_at_consumer(tmp_path):
         list(loader.iter_batches(0, 1))
 
 
+def test_write_rejects_empty_stream(tmp_path):
+    """ADVICE r4: a zero-length stream would write a 0-byte shard that
+    TokenShardReader cannot memory-map (opaque mmap crash); the writer
+    must reject it at the format level instead."""
+    with pytest.raises(ValueError, match="empty token stream"):
+        write_token_shards(str(tmp_path / "ds"),
+                           [np.asarray([], np.uint32)])
+    # A GOOD stream ahead of the empty one must not leave an orphan
+    # shard behind (validation precedes any write).
+    with pytest.raises(ValueError, match="empty token stream"):
+        write_token_shards(str(tmp_path / "ds"),
+                           [np.asarray([1, 2, 3], np.uint32),
+                            np.asarray([], np.uint32)])
+    made = (tmp_path / "ds")
+    assert not made.exists() or not list(made.glob("*.tokens"))
+
+
+def test_prefetch_producer_exits_when_iterator_abandoned(tmp_path):
+    """ADVICE r4: abandoning iter_batches mid-stream (exception or
+    early break in the training loop) must not park the producer
+    thread forever on a full queue."""
+    import threading
+    import time
+
+    d = _dataset(tmp_path, [list(range(10_000))])
+    loader = TokenBatchLoader(TokenShardReader(d), batch_size=1,
+                              seq_len=4, prefetch=1)
+    it = loader.iter_batches(0, 500)
+    next(it)  # producer now blocks on the size-1 queue
+    time.sleep(0.1)
+    alive = [t for t in threading.enumerate()
+             if t.name == "tokenloader-prefetch"]
+    assert alive, "producer thread not found (rename broke the test?)"
+    it.close()  # abandon: the finally must set the closed event
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and any(
+            t.is_alive() for t in alive):
+        time.sleep(0.05)
+    assert not any(t.is_alive() for t in alive), (
+        "producer thread leaked after iterator close")
+
+
 def test_steps_per_epoch(tmp_path):
     d = _dataset(tmp_path, [list(range(100))])
     loader = TokenBatchLoader(TokenShardReader(d), batch_size=2,

@@ -314,6 +314,21 @@ def test_lm_serving_manifest_args_accepted():
     assert args.slots and args.prefix_cache
     assert args.weights == "int8" and args.kv_heads == 4
 
+    # Train/serve architecture coherence (ADVICE r4): the serving
+    # Deployment restores the training Job's checkpoint, so every
+    # architecture flag must agree or the pod CrashLoops on an orbax
+    # tree mismatch.
+    train_mod = _load_cmd_module("train_lm.py")
+    tc = _find_container(
+        os.path.join(REPO, "demo", "tpu-training", "lm-data-tpu.yaml"),
+        "lm-data-tpu")
+    targs = train_mod.parse_args(tc["command"][2:])
+    for f in ("num_layers", "num_heads", "head_dim", "mlp_dim",
+              "kv_heads", "vocab_size"):
+        assert getattr(args, f) == getattr(targs, f), (
+            f"serving manifest {f}={getattr(args, f)} != training "
+            f"manifest {f}={getattr(targs, f)}")
+
 
 def test_lm_data_manifest_args_accepted_and_wired():
     """The data-pipeline training Job: trainer argv parses, the init
