@@ -114,6 +114,28 @@ test-asan: native-asan
 	DCNXFERD_BIN=$(ASAN_BUILD)/dcnxferd \
 	    $(PY) -m pytest tests/test_dcnxferd.py -x -q
 
+# TSan build + test — the race half of the `go test -race` analog.
+# dcnxferd is a single-threaded poll loop TODAY; the gate costs one
+# rebuild and guards the day that changes (the reference runs -race
+# unconditionally, Makefile:20-22).  The genuinely threaded Python
+# components get deliberate stress tests instead
+# (tests/test_concurrency_stress.py).
+TSAN_BUILD := native/dcnxferd/build-tsan
+
+.PHONY: native-tsan test-tsan
+
+native-tsan: $(TSAN_BUILD)/dcnxferd
+
+$(TSAN_BUILD)/dcnxferd: native/dcnxferd/dcnxferd.cc
+	mkdir -p $(TSAN_BUILD)
+	g++ -std=c++17 -O1 -g -Wall -Wextra \
+	    -fsanitize=thread -fno-omit-frame-pointer \
+	    -o $(TSAN_BUILD)/dcnxferd native/dcnxferd/dcnxferd.cc
+
+test-tsan: native-tsan
+	DCNXFERD_BIN=$(TSAN_BUILD)/dcnxferd \
+	    $(PY) -m pytest tests/test_dcnxferd.py -x -q
+
 # Container images (ref: Makefile:44-60's four image targets).
 REGISTRY ?= gcr.io/gke-release
 VERSION ?= $(shell cat VERSION)
@@ -151,4 +173,4 @@ proto:
 
 clean:
 	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD) $(DCNFASTSOCK_BUILD) \
-	    $(DCNCOLLPERF_BUILD) $(ASAN_BUILD) $(TOKPACK_BUILD)
+	    $(DCNCOLLPERF_BUILD) $(ASAN_BUILD) $(TSAN_BUILD) $(TOKPACK_BUILD)

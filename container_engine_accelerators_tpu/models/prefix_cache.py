@@ -37,6 +37,7 @@ the bucket grammar via ``bucket_len``.
 
 import threading
 from collections import OrderedDict
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -56,6 +57,13 @@ from container_engine_accelerators_tpu.models.generate import (
 # continuous-batching engine); re-exported here for callers that think
 # in prefix-cache terms.
 _splice_prefix = splice_prefix
+
+
+@partial(jax.jit, static_argnames=("model",))
+def _build_prefix(model, params, pfx, plen):
+    """Prefill a prefix block — shared across PrefixCache instances on
+    an equal model (flax modules hash by config)."""
+    return prefill(model, params, pfx, plen, pfx.shape[1])[0]
 
 
 def generate_with_prefix(
@@ -117,11 +125,13 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
-        # One compile per prefix bucket (shape-keyed jit).
-        self._build = jax.jit(
-            lambda pfx, plen: prefill(
-                model, params, pfx, plen, pfx.shape[1])[0]
-        )
+        # One compile per prefix bucket (shape-keyed jit), SHARED
+        # across caches on an equal model (module-level jit with the
+        # flax module static — a per-instance jit of this lambda would
+        # recompile per cache by function identity; see
+        # models/batching.py's shared-kernel note).
+        self._build = lambda pfx, plen: _build_prefix(
+            model, params, pfx, plen)
 
     def get_or_build(self, ids: Tuple[int, ...]):
         """-> (prefix_kv tree, prefix_len) for the exact prefix ``ids``.

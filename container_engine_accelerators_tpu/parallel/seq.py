@@ -17,7 +17,11 @@ mesh axis (no new infrastructure):
   way the scaling-book recipe prescribes; memory per device is
   O(seq/n_devices).  ``layout="zigzag"`` adds the causally-balanced
   striped layout + fully-masked-chunk skipping (~2x causal critical
-  path at scale; see the layout comment above
+  path at scale — an executed-work accounting pinned by tests, NOT a
+  measured wall-clock claim: the 8-way virtual CPU mesh measures
+  1.19x because its ranks share cores, and >= 2 real chips are needed
+  to verify the dedicated-hardware number; always report both, see
+  BENCH_HW.md round 4.  Layout comment above
   :func:`zigzag_permutation`).
 - :func:`ulysses_attention` — ``lax.all_to_all`` reshuffles the
   sequence shard into a head shard so each device runs *dense* attention
