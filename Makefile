@@ -73,6 +73,7 @@ bench-hw:
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=int8 python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_SPEC=4 BENCH_DECODE_SPEC_DRAFT=self python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_SPEC=4 BENCH_DECODE_SPEC_DRAFT=1L python bench.py
+	-BENCH_WORKLOAD=decode BENCH_DECODE_SPEC=4 BENCH_DECODE_SPEC_DRAFT=self BENCH_DECODE_SPEC_SAMPLED=1 python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=f32 BENCH_DECODE_FLASH=0 BENCH_DECODE_PROMPT=1984 BENCH_DECODE_NEW=64 python bench.py
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=f32 BENCH_DECODE_FLASH=1 BENCH_DECODE_PROMPT=1984 BENCH_DECODE_NEW=64 python bench.py
 	-python cmd/bench_serving.py --slots 4 --requests 12 --max-new 64 --num-layers 12 --num-heads 16 --head-dim 64 --mlp-dim 4096 --vocab-size 32768

@@ -494,10 +494,24 @@ def _run_decode(on_accel: bool):
     # token), so the >100% replay guard still protects the number.
     spec = int(os.environ.get("BENCH_DECODE_SPEC", "0"))
     spec_draft = os.environ.get("BENCH_DECODE_SPEC_DRAFT", "self")
+    # BENCH_DECODE_SPEC_SAMPLED=1: the distribution-exact rejection-
+    # sampling round (generate_speculative_sampled) instead of the
+    # greedy argmax round — measures the sampled path's per-round
+    # machinery at the same draft brackets.  BENCH_DECODE_TEMP sets
+    # the sampling temperature (must be > 0).
+    spec_sampled = os.environ.get("BENCH_DECODE_SPEC_SAMPLED", "0") == "1"
+    spec_temp = float(os.environ.get("BENCH_DECODE_TEMP", "1.0"))
+    if spec_sampled and spec_temp <= 0:
+        # temperature divides the logits inside the rejection sampler;
+        # 0 would bank a valid-looking entry full of NaN-driven tokens.
+        raise ValueError(
+            f"BENCH_DECODE_TEMP={spec_temp} must be > 0 for the "
+            f"sampled speculation stage")
     spec_stats = None
     if spec:
         from container_engine_accelerators_tpu.models.speculative import (
             generate_speculative,
+            generate_speculative_sampled,
         )
 
         if spec_draft == "self":
@@ -514,12 +528,23 @@ def _run_decode(on_accel: bool):
         else:
             raise ValueError(
                 f"BENCH_DECODE_SPEC_DRAFT={spec_draft!r}: want self|1L")
-        run = jax.jit(
-            lambda p: generate_speculative(
-                model, params, draft_model, draft_params, p, new_tokens,
-                k=spec,
+        if spec_sampled:
+            # Fixed rng is replay-safe: every timed call's PROMPT is
+            # nonce-distinct, so no two dispatches are identical.
+            run = jax.jit(
+                lambda p: generate_speculative_sampled(
+                    model, params, draft_model, draft_params, p,
+                    new_tokens, k=spec, temperature=spec_temp,
+                    rng=jax.random.PRNGKey(123),
+                )
             )
-        )
+        else:
+            run = jax.jit(
+                lambda p: generate_speculative(
+                    model, params, draft_model, draft_params, p,
+                    new_tokens, k=spec,
+                )
+            )
     else:
         run = jax.jit(lambda p: generate(model, params, p, new_tokens))
 
@@ -609,6 +634,7 @@ def _run_decode(on_accel: bool):
     gqa, wtag, ftag, ltag, stag = _decode_variant_tags(
         kv, weights, flash_decode, max_len,
         (prompt_len, new_tokens) != default_ctx, spec, spec_draft,
+        spec_sampled,
     )
     result = {
         "metric":
@@ -637,23 +663,31 @@ def _run_decode(on_accel: bool):
         result["spec_rounds"] = int(spec_stats["rounds"])
         result["spec_accept_rate"] = round(
             int(spec_stats["accepted"].sum()) / max(drafted, 1), 4)
+        if spec_sampled:
+            result["spec_sampled"] = True
+            result["spec_temperature"] = spec_temp
     return result
 
 
 def _decode_variant_tags(kv, weights, flash, max_len, explicit_ctx,
-                         spec=0, spec_draft="self"):
+                         spec=0, spec_draft="self", spec_sampled=False):
     """Metric-name tags for a decode variant — the ONE place the tag
     grammar lives; the writer (_run_decode) and the evidence-log reader
     (_latest_logged_tpu) both use it, so they cannot drift.  A default
     run carries no tags; the contrast stages stay distinct in the log.
     ``explicit_ctx`` is value-based (shape != the mode's default), so
     pinning the default shape in a stage env adds no tag."""
+    stag = ""
+    if spec:
+        stag = f"_speck{spec}{spec_draft}"
+        if spec_sampled:
+            stag += "samp"
     return (
         f"_gqa{kv}" if kv else "",
         f"_w{weights}" if weights != "f32" else "",
         "_flashdec" if flash else "",
         f"_L{max_len}" if explicit_ctx else "",
-        f"_speck{spec}{spec_draft}" if spec else "",
+        stag,
     )
 
 
@@ -719,6 +753,7 @@ def _latest_logged_tpu(workload: str):
         decode_tags = _decode_variant_tags(
             kv, w, flash, prompt + new, (prompt, new) != (64, 192),
             spec, os.environ.get("BENCH_DECODE_SPEC_DRAFT", "self"),
+            os.environ.get("BENCH_DECODE_SPEC_SAMPLED", "0") == "1",
         )
     for line in reversed(lines):
         line = line.strip()

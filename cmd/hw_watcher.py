@@ -149,6 +149,14 @@ DEFAULT_STAGES = [
      "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_SPEC="4",
                  BENCH_DECODE_SPEC_DRAFT="1L"),
      "timeout": _BENCH_STAGE_TIMEOUT},
+    # Sampled (rejection) speculation, self-draft: the distribution-
+    # exact round's machinery cost at acceptance ~1.
+    {"name": "bench_decode_spec_sampled",
+     "cmd": [sys.executable, "bench.py"],
+     "env": dict(_DECODE_DEFAULTS, BENCH_DECODE_SPEC="4",
+                 BENCH_DECODE_SPEC_DRAFT="self",
+                 BENCH_DECODE_SPEC_SAMPLED="1"),
+     "timeout": _BENCH_STAGE_TIMEOUT},
     # Long-context decode A/B: einsum-over-masked-buffer vs the
     # flash-decode kernel's streamed+skipped reads, same 2048 cache.
     {"name": "bench_decode_long", "cmd": [sys.executable, "bench.py"],

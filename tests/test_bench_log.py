@@ -337,6 +337,18 @@ def test_decode_prefix_roundtrip(bench, monkeypatch):
     assert bench._latest_logged_tpu("decode")["value"] == 6.0
     monkeypatch.setenv("BENCH_DECODE_SPEC_DRAFT", "1L")
     assert bench._latest_logged_tpu("decode") is None  # no 1L entry yet
+    # Sampled (rejection) speculation is its own variant: the greedy
+    # and sampled entries never stand in for each other.
+    monkeypatch.setenv("BENCH_DECODE_SPEC_DRAFT", "self")
+    bench._log_tpu_result(
+        {"metric": "decode_12L_speck4selfsamp_bf16_tokens_per_sec_1chip",
+         "value": 7.0})
+    assert bench._latest_logged_tpu("decode")["value"] == 6.0  # greedy
+    monkeypatch.setenv("BENCH_DECODE_SPEC_SAMPLED", "1")
+    assert bench._latest_logged_tpu("decode")["value"] == 7.0
+    monkeypatch.delenv("BENCH_DECODE_SPEC_SAMPLED", raising=False)
+    monkeypatch.delenv("BENCH_DECODE_SPEC", raising=False)
+    monkeypatch.delenv("BENCH_DECODE_SPEC_DRAFT", raising=False)
 
 
 def test_committed_log_is_valid_and_has_tpu_entry():

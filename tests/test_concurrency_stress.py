@@ -115,8 +115,10 @@ def test_prefetch_error_surfaces_then_thread_exits():
 
 
 def test_manager_health_churn_under_concurrent_readers(tmp_path):
-    """Health transitions raced against device-list readers and the
-    ListAndWatch health queue: no exceptions, no lost final state."""
+    """Health transitions raced against device-list readers: no
+    exceptions, no lost final state.  (The ListAndWatch health-queue
+    streaming path is exercised separately by the gRPC tests in
+    test_device_plugin.py — not churned here.)"""
     from container_engine_accelerators_tpu.deviceplugin.manager import (
         TpuManager,
     )

@@ -193,7 +193,8 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
                  "inject_error.py", "lm", "decode", "BENCH_DECODE_KV",
                  "BENCH_DECODE_WEIGHTS=int8", "BENCH_DECODE_FLASH=1",
                  "BENCH_DECODE_PROMPT=1984", "BENCH_DECODE_SPEC=4",
-                 "BENCH_DECODE_SPEC_DRAFT=1L", "bench_serving.py",
+                 "BENCH_DECODE_SPEC_DRAFT=1L",
+                 "BENCH_DECODE_SPEC_SAMPLED=1", "bench_serving.py",
                  "--speculative", "inception"):
         assert tool in joined, tool
         assert tool in mk
