@@ -66,11 +66,14 @@ def parse_args(argv=None):
     p.add_argument("--slots", type=int, default=0,
                    help="continuous batching: N decode lanes share one "
                         "compiled step (models/batching.py); greedy "
-                        "requests join/leave mid-flight, sampled "
-                        "requests fall back to per-request generate. "
+                        "AND sampled requests join/leave mid-flight "
+                        "(sampled lanes ride per-request seed chains, "
+                        "token-identical to the per-request path). "
                         "0 = per-request serving; composes with --tp "
                         "(the fleet cache shards its KV heads over the "
-                        "model axis) and --speculative")
+                        "model axis) and --speculative (whose fleet is "
+                        "greedy-only — sampling then uses the per-"
+                        "request rejection sampler)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree: shard params Megatron-"
                         "style over this many local devices (decode "
@@ -514,11 +517,15 @@ def make_handler(run, args, engine_loop=None):
                     kv, pfx_len = run.prefix_cache.get_or_build(
                         tuple(prefix_ids))
                     rows = [ids[:room] for ids in clean]
-                    if engine_loop is not None and temperature == 0:
-                        # Greedy + slots: the fleet's slots start from
-                        # the spliced block (DecodeEngine.submit
-                        # prefix=); the speculative engine also needs
-                        # the draft model's own spliced block.
+                    if engine_loop is not None and (
+                            temperature == 0
+                            or engine_loop.engine.supports_sampling):
+                        # Slots: the fleet's slots start from the
+                        # spliced block (DecodeEngine.submit prefix=);
+                        # the speculative engine also needs the draft
+                        # model's own spliced block.  Sampled requests
+                        # ride their own per-request key chains
+                        # (seed + i, mirroring the per-request path).
                         if getattr(run, "draft_prefix_cache",
                                    None) is not None:
                             d_kv, _ = run.draft_prefix_cache \
@@ -527,7 +534,9 @@ def make_handler(run, args, engine_loop=None):
                         else:
                             pfx = (kv, pfx_len)
                         outs = engine_loop.generate_many(
-                            rows, max_new, prefix=pfx)
+                            rows, max_new, prefix=pfx,
+                            temperature=temperature,
+                            seeds=[seed + i for i in range(len(rows))])
                         toks = [prefix_ids + ids + gen[:max_new]
                                 for ids, gen in zip(rows, outs)]
                     elif getattr(run, "spec_prefix", None) is not None:
@@ -563,12 +572,18 @@ def make_handler(run, args, engine_loop=None):
                             ))
                             toks.append(prefix_ids + out[0][
                                 : plen + max_new].tolist())
-                elif engine_loop is not None and temperature == 0:
+                elif engine_loop is not None and (
+                        temperature == 0
+                        or engine_loop.engine.supports_sampling):
                     # Continuous batching: all of this request's
                     # prompts join the shared decode fleet CONCURRENTLY
-                    # (greedy lanes only; sampling keeps the
-                    # per-request path below).
-                    outs = engine_loop.generate_many(clean, max_new)
+                    # — sampled prompts as per-request-seeded lanes
+                    # (token-identical to the per-request path; the
+                    # speculative fleet is greedy-only, so sampling
+                    # keeps the per-request rejection sampler below).
+                    outs = engine_loop.generate_many(
+                        clean, max_new, temperature=temperature,
+                        seeds=[seed + i for i in range(len(clean))])
                     toks = [ids + gen[:max_new]
                             for ids, gen in zip(clean, outs)]
                 else:

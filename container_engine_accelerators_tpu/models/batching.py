@@ -83,6 +83,20 @@ def _lane_put(full, one, slot):
 # jit caches one trace per prompt BUCKET width; insert and step trace
 # once (slot index and cursors are traced operands).
 
+def _prefill_pfx_core(model, params, prefix_kv, prefix_len, suffix,
+                      suffix_len, max_len):
+    """Prefix-cache composition — the ONE copy of the splice rule for
+    slot lanes: splice the stored block into a fresh slot-shaped
+    cache, continue-prefill only the suffix (models/prefix_cache.py
+    semantics inside one lane).  Shared by the greedy and sampled
+    prefill heads."""
+    cache = init_cache(model, 1, max_len)
+    cache = splice_prefix(cache, prefix_kv, prefix_len, 1)
+    return prefill_continue(
+        model, params, cache, suffix, prefix_len,
+        prefix_len + suffix_len)
+
+
 @partial(jax.jit, static_argnames=("model", "max_len"))
 def _prefill_slot(model, params, prompt, prompt_len, max_len):
     cache, last = prefill(model, params, prompt, prompt_len, max_len)
@@ -93,16 +107,39 @@ def _prefill_slot(model, params, prompt, prompt_len, max_len):
 @partial(jax.jit, static_argnames=("model", "max_len"))
 def _prefill_slot_pfx(model, params, prefix_kv, prefix_len, suffix,
                       suffix_len, max_len):
-    # Prefix-cache composition: splice the stored block into a fresh
-    # slot-shaped cache, continue-prefill only the suffix
-    # (models/prefix_cache.py semantics inside one slot lane).
-    cache = init_cache(model, 1, max_len)
-    cache = splice_prefix(cache, prefix_kv, prefix_len, 1)
-    cache, last = prefill_continue(
-        model, params, cache, suffix, prefix_len,
-        prefix_len + suffix_len)
+    cache, last = _prefill_pfx_core(model, params, prefix_kv,
+                                    prefix_len, suffix, suffix_len,
+                                    max_len)
     tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
     return cache, tok0
+
+
+@partial(jax.jit, static_argnames=("model", "max_len"))
+def _prefill_slot_sampled(model, params, prompt, prompt_len, max_len,
+                          key, temperature):
+    """Sampled-lane prefill: the first token comes from the request's
+    OWN key chain — ``key, sub = split(key)`` then categorical, exactly
+    generate.py's ``sample_from`` — so a sampled request's tokens are a
+    pure function of (params, prompt, seed), independent of what else
+    is in the fleet."""
+    cache, last = prefill(model, params, prompt, prompt_len, max_len)
+    key, sub = jax.random.split(key)
+    tok0 = jax.random.categorical(
+        sub, last / temperature).astype(jnp.int32)
+    return cache, tok0, key
+
+
+@partial(jax.jit, static_argnames=("model", "max_len"))
+def _prefill_slot_pfx_sampled(model, params, prefix_kv, prefix_len,
+                              suffix, suffix_len, max_len, key,
+                              temperature):
+    cache, last = _prefill_pfx_core(model, params, prefix_kv,
+                                    prefix_len, suffix, suffix_len,
+                                    max_len)
+    key, sub = jax.random.split(key)
+    tok0 = jax.random.categorical(
+        sub, last / temperature).astype(jnp.int32)
+    return cache, tok0, key
 
 
 @jax.jit
@@ -120,15 +157,43 @@ def _insert_slot(cache, pos, last_tok, active, slot_cache, tok0, slot,
 _lane_put_jit = jax.jit(_lane_put)
 
 
-@partial(jax.jit, static_argnames=("model",))
-def _fleet_step(model, params, cache, pos, last_tok, active):
+@partial(jax.jit, static_argnames=("model", "any_sampled"))
+def _fleet_step(model, params, cache, pos, last_tok, active, keys,
+                temps, any_sampled):
+    """One decode step for the whole fleet, mixed greedy/sampled.
+
+    Greedy slots (``temps == 0``) take the argmax; sampled slots draw
+    from their OWN key chain (``key, sub = split(key)`` then a
+    per-row categorical at the slot's temperature — bitwise
+    generate.py's ``sample_from`` for a batch-1 row, so the fleet's
+    sampled output is token-identical to per-request
+    ``generate(seed=...)`` and independent of fleet composition).
+    A slot's key advances only while it is sampled AND active —
+    greedy/retired slots never consume randomness.
+
+    ``any_sampled`` is STATIC (one extra cached trace): an all-greedy
+    fleet — the serving default — must not pay the per-step RNG-bit
+    generation and [slots, vocab] categorical it would discard.
+    """
     logits, mutated = model.apply(
         {"params": params, "cache": cache},
         last_tok[:, None],
         positions=pos[:, None],
         mutable=["cache"],
     )
-    nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+    row = logits[:, 0, :]
+    greedy_tok = jnp.argmax(row, axis=-1).astype(jnp.int32)
+    if any_sampled:
+        split = jax.vmap(jax.random.split)(keys)  # [S, 2, 2]
+        new_keys, subs = split[:, 0], split[:, 1]
+        sampled = temps > 0
+        safe_t = jnp.where(sampled, temps, 1.0)
+        samp_tok = jax.vmap(jax.random.categorical)(
+            subs, row / safe_t[:, None]).astype(jnp.int32)
+        nxt = jnp.where(sampled, samp_tok, greedy_tok)
+        keys = jnp.where((sampled & active)[:, None], new_keys, keys)
+    else:
+        nxt = greedy_tok
     new_pos = jnp.where(active, pos + 1, pos)
     new_tok = jnp.where(active, nxt, last_tok)
     # The model advanced every slot's write cursor; re-pin it to the
@@ -136,7 +201,7 @@ def _fleet_step(model, params, cache, pos, last_tok, active):
     # frozen.  (Their garbage write this step lands inside their own
     # lane, which the next insert overwrites wholesale.)
     cache = _rewind_cache_index(mutated["cache"], new_pos)
-    return cache, new_pos, new_tok, nxt
+    return cache, new_pos, new_tok, nxt, keys
 
 
 @partial(jax.jit, static_argnames=("draft_model", "max_len"))
@@ -226,6 +291,13 @@ class DecodeEngine:
         self.pos = self._place(jnp.zeros((max_slots,), jnp.int32))
         self.last_tok = self._place(jnp.zeros((max_slots,), jnp.int32))
         self.active = self._place(jnp.zeros((max_slots,), bool))
+        # Per-slot sampling state: each sampled request carries its own
+        # key chain (seeded at submit), so its tokens do not depend on
+        # what else shares the fleet; temp 0 marks a greedy lane.
+        self.rngs = self._place(
+            jnp.zeros((max_slots,) + jax.random.PRNGKey(0).shape,
+                      jax.random.PRNGKey(0).dtype))
+        self.temps = self._place(jnp.zeros((max_slots,), jnp.float32))
 
         self._free = list(range(max_slots))
         self._req: Dict[int, dict] = {}  # slot -> {id, tokens, remaining}
@@ -299,8 +371,15 @@ class DecodeEngine:
 
     # ---- host API -------------------------------------------------------
 
+    # Whether sampled (temperature > 0) requests may join this fleet;
+    # the speculative subclass's rounds are greedy-only and overrides
+    # this to False (sampled requests use the per-request rejection
+    # sampler instead).
+    supports_sampling = True
+
     def submit(self, prompt_ids: List[int], max_new: int,
-               prefix=None) -> int:
+               prefix=None, temperature: float = 0.0,
+               seed: int = 0) -> int:
         """Claim a free slot, prefill it, emit the first token.
         Returns a request id; raises if the fleet is full.
 
@@ -309,7 +388,17 @@ class DecodeEngine:
         engine's model/params): the slot starts from the spliced block
         and ``prompt_ids`` are treated as the SUFFIX — same exactness
         contract as the per-request prefix path.
+
+        ``temperature > 0`` makes this a SAMPLED lane: tokens are drawn
+        from the request's own ``PRNGKey(seed)`` chain with exactly
+        generate()'s split/categorical discipline, so the output equals
+        per-request ``generate(..., temperature, rng=PRNGKey(seed))``
+        regardless of what else shares the fleet.
         """
+        if temperature and temperature > 0 and not self.supports_sampling:
+            raise ValueError(
+                f"{type(self).__name__} fleets are greedy-only; route "
+                f"sampled requests to the per-request path")
         if not self._free:
             raise RuntimeError("no free slot — step() until one drains")
         plen = len(prompt_ids)
@@ -340,11 +429,27 @@ class DecodeEngine:
         prompt = jnp.asarray(
             [list(prompt_ids) + [0] * (bucket - plen)], jnp.int32
         )
-        if prefix is None:
-            slot_cache, tok0 = self._prefill(prompt, plen)
+        sampled = bool(temperature and temperature > 0)
+        if sampled:
+            key = jax.random.PRNGKey(int(seed))
+            if prefix is None:
+                slot_cache, tok0, key = _prefill_slot_sampled(
+                    self.model, self.params, prompt, plen,
+                    self.max_len, key, jnp.float32(temperature))
+            else:
+                slot_cache, tok0, key = _prefill_slot_pfx_sampled(
+                    self.model, self.params, prefix[0], prefix[1],
+                    prompt, plen, self.max_len, key,
+                    jnp.float32(temperature))
+            self.rngs = self.rngs.at[slot].set(key)
+            self.temps = self.temps.at[slot].set(temperature)
         else:
-            slot_cache, tok0 = self._prefill_pfx(
-                prefix[0], prefix[1], prompt, plen)
+            if prefix is None:
+                slot_cache, tok0 = self._prefill(prompt, plen)
+            else:
+                slot_cache, tok0 = self._prefill_pfx(
+                    prefix[0], prefix[1], prompt, plen)
+            self.temps = self.temps.at[slot].set(0.0)
         plen = start + plen  # global depth of the slot's cursor
         self.cache, self.pos, self.last_tok, self.active = (
             _insert_slot(self.cache, self.pos, self.last_tok,
@@ -355,7 +460,8 @@ class DecodeEngine:
         self._next_id += 1
         first = int(tok0[0])
         self._req[slot] = {"id": rid, "tokens": [first],
-                           "remaining": max_new - 1}
+                           "remaining": max_new - 1,
+                           "sampled": sampled}
         if self._req[slot]["remaining"] <= 0 or first == self.eos_id:
             self._retire(slot)
         return rid
@@ -374,9 +480,11 @@ class DecodeEngine:
         """One decode step for the whole fleet; returns live-slot count."""
         if not self._req:
             return 0
-        self.cache, self.pos, self.last_tok, nxt = _fleet_step(
+        (self.cache, self.pos, self.last_tok, nxt,
+         self.rngs) = _fleet_step(
             self.model, self.params, self.cache, self.pos,
-            self.last_tok, self.active
+            self.last_tok, self.active, self.rngs, self.temps,
+            any(r["sampled"] for r in self._req.values()),
         )
         tokens = np.asarray(nxt)
         for slot in list(self._req):
@@ -456,15 +564,20 @@ class SpecDecodeEngine(DecodeEngine):
 
     # ---- host API -------------------------------------------------------
 
+    # The spec round's acceptance rule is argmax-match: greedy only.
+    supports_sampling = False
+
     def submit(self, prompt_ids: List[int], max_new: int,
-               prefix=None) -> int:
+               prefix=None, temperature: float = 0.0,
+               seed: int = 0) -> int:
         if prefix is not None:
             t_kv, d_kv, pfx_len = prefix
             self._pending_draft = (d_kv, pfx_len)
             prefix = (t_kv, pfx_len)
         else:
             self._pending_draft = None
-        return super().submit(prompt_ids, max_new, prefix=prefix)
+        return super().submit(prompt_ids, max_new, prefix=prefix,
+                              temperature=temperature, seed=seed)
 
     def _insert_aux(self, slot: int, prompt, plen) -> None:
         if self._pending_draft is None:
@@ -532,23 +645,29 @@ class EngineLoop:
                 self.cond.notify_all()
 
     def generate(self, prompt_ids: List[int], max_new: int,
-                 timeout: float = 300.0, prefix=None) -> List[int]:
+                 timeout: float = 300.0, prefix=None,
+                 temperature: float = 0.0, seed: int = 0) -> List[int]:
         """Submit and block until done; returns the generated tokens."""
         return self.generate_many([prompt_ids], max_new, timeout,
-                                  prefix=prefix)[0]
+                                  prefix=prefix, temperature=temperature,
+                                  seeds=[seed])[0]
 
     def generate_many(self, prompts: List[List[int]], max_new: int,
-                      timeout: float = 300.0,
-                      prefix=None) -> List[List[int]]:
+                      timeout: float = 300.0, prefix=None,
+                      temperature: float = 0.0,
+                      seeds=None) -> List[List[int]]:
         """Run several prompts CONCURRENTLY across the fleet.
 
         Submits each prompt as soon as a slot frees (earlier prompts
         keep decoding meanwhile) and returns all outputs in input
         order — a k-prompt request on a k-slot engine costs ~one
-        request's wall clock, not k.
+        request's wall clock, not k.  ``temperature > 0`` makes every
+        prompt a sampled lane on its own ``seeds[i]`` key chain.
         """
         import time
 
+        if seeds is None:
+            seeds = list(range(len(prompts)))
         deadline = time.monotonic() + timeout
         rids: List[Optional[int]] = [None] * len(prompts)
         outs: List[Optional[List[int]]] = [None] * len(prompts)
@@ -559,8 +678,9 @@ class EngineLoop:
                 progressed = False
                 while unsubmitted and self.engine._free:
                     i = unsubmitted.pop(0)
-                    rids[i] = self.engine.submit(prompts[i], max_new,
-                                                 prefix=prefix)
+                    rids[i] = self.engine.submit(
+                        prompts[i], max_new, prefix=prefix,
+                        temperature=temperature, seed=seeds[i])
                     progressed = True
                 if progressed:
                     self.cond.notify_all()

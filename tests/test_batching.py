@@ -374,3 +374,66 @@ def test_tp_engine_matches_solo_generate(decode_model, params):
         for x in jax.tree_util.tree_leaves(eng.cache) if x.ndim >= 4
     }
     assert any("model" in s for s in kv_specs), kv_specs
+
+
+# ---- sampled continuous batching (round 5) --------------------------
+
+
+def test_sampled_lanes_match_per_request_generate(decode_model, params):
+    """A sampled request in the fleet rides its OWN PRNGKey(seed)
+    chain with generate()'s split/categorical discipline: tokens equal
+    per-request generate(temperature, rng=PRNGKey(seed)) exactly, for
+    any mix of greedy and sampled lanes — and independently of fleet
+    composition."""
+    def solo_sampled(ids, n, temp, seed):
+        prompt = jnp.asarray([ids], jnp.int32)
+        out = np.asarray(generate(decode_model, params, prompt, n,
+                                  temperature=temp,
+                                  rng=jax.random.PRNGKey(seed)))
+        return out[0, len(ids): len(ids) + n].tolist()
+
+    eng = DecodeEngine(decode_model, params, max_slots=3, max_len=32)
+    r1 = eng.submit([5, 17, 42], max_new=6, temperature=0.7, seed=9)
+    eng.step()
+    r2 = eng.submit([88, 3], max_new=5)  # greedy joins mid-flight
+    eng.step()
+    r3 = eng.submit([7, 9, 11], max_new=4, temperature=1.3, seed=4)
+    eng.run_until_drained()
+    assert eng.result(r1) == solo_sampled([5, 17, 42], 6, 0.7, 9)
+    assert eng.result(r2) == _solo(decode_model, params, [88, 3], 5)
+    assert eng.result(r3) == solo_sampled([7, 9, 11], 4, 1.3, 4)
+
+    # Fleet-composition independence: the same request alone in a
+    # 1-slot engine produces the same tokens.
+    eng2 = DecodeEngine(decode_model, params, max_slots=1, max_len=32)
+    ra = eng2.submit([5, 17, 42], max_new=6, temperature=0.7, seed=9)
+    eng2.run_until_drained()
+    assert eng2.result(ra) == eng.result(r1)
+
+
+def test_sampled_lane_with_prefix_matches_generate_with_prefix(
+        decode_model, params):
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+        generate_with_prefix,
+    )
+
+    entry = PrefixCache(decode_model, params,
+                        max_prefix_len=4).get_or_build((5, 17, 42))
+    eng = DecodeEngine(decode_model, params, max_slots=2, max_len=32)
+    rp = eng.submit([7, 9], max_new=5, prefix=entry, temperature=0.9,
+                    seed=11)
+    eng.run_until_drained()
+    kv, plen = entry
+    want = np.asarray(generate_with_prefix(
+        decode_model, params, kv, plen,
+        jnp.asarray([[7, 9]], jnp.int32), 5, temperature=0.9,
+        rng=jax.random.PRNGKey(11)))
+    assert eng.result(rp) == want[0, 2:7].tolist()
+
+
+def test_spec_engine_refuses_sampled_lanes(decode_model, params):
+    eng = SpecDecodeEngine(decode_model, params, decode_model, params,
+                           max_slots=1, max_len=32, k=2)
+    with pytest.raises(ValueError, match="greedy-only"):
+        eng.submit([1, 2], 3, temperature=1.0, seed=0)

@@ -234,9 +234,11 @@ def test_serve_lm_http_continuous_batching_matches_per_request(plain_server):
 
         batched = post({"prompt_ids": [[1, 2, 3], [5]],
                         "max_new_tokens": 4})
-        # Sampling bypasses the engine; both paths must serve.
+        # Sampled requests join the fleet too (round 5): per-request
+        # seed chains make the engine's sampled tokens equal the
+        # per-request path's for the same seed.
         sampled = post({"prompt_ids": [[1, 2]], "max_new_tokens": 4,
-                        "temperature": 1.0})
+                        "temperature": 1.0, "seed": 77})
         assert len(sampled["tokens"][0]) == 6
     finally:
         srv.shutdown()
@@ -251,6 +253,12 @@ def test_serve_lm_http_continuous_batching_matches_per_request(plain_server):
         want = np.asarray(run(jnp.asarray([padded], jnp.int32),
                               len(ids), 0.0, 0, False))
         assert got == want[0][: len(ids) + 4].tolist()
+
+    # The sampled request's engine lane == the per-request sampled
+    # path at the handler's seed derivation (seed + row index 0).
+    want_s = np.asarray(run(jnp.asarray([[1, 2]], jnp.int32), 2,
+                            1.0, 77, True))
+    assert sampled["tokens"][0] == want_s[0][:6].tolist()
 
 
 def test_inject_error_event_consumed_by_tpulib(tmp_path):
