@@ -437,3 +437,26 @@ def test_spec_engine_refuses_sampled_lanes(decode_model, params):
                            max_slots=1, max_len=32, k=2)
     with pytest.raises(ValueError, match="greedy-only"):
         eng.submit([1, 2], 3, temperature=1.0, seed=0)
+
+
+def test_sampled_lane_on_tp_mesh_matches_single_device(decode_model,
+                                                       params):
+    """Sampled lanes x tensor parallelism: the per-request key chain
+    is sharding-independent, so a sampled lane on the tp mesh equals
+    single-device per-request sampled generate."""
+    from container_engine_accelerators_tpu.parallel import (
+        create_mesh,
+        shard_params,
+    )
+
+    mesh = create_mesh(data=1, model=2, devices=jax.devices()[:2])
+    tp_params = jax.device_put(params, shard_params(params, mesh))
+    eng = DecodeEngine(decode_model, tp_params, max_slots=2,
+                       max_len=32, mesh=mesh)
+    r = eng.submit([5, 17, 42], max_new=6, temperature=0.7, seed=9)
+    eng.submit([88, 3], max_new=4)  # greedy shares the fleet
+    eng.run_until_drained()
+    out = np.asarray(generate(
+        decode_model, params, jnp.asarray([[5, 17, 42]], jnp.int32), 6,
+        temperature=0.7, rng=jax.random.PRNGKey(9)))
+    assert eng.result(r) == out[0, 3:9].tolist()
