@@ -71,9 +71,8 @@ def parse_args(argv=None):
                         "token-identical to the per-request path). "
                         "0 = per-request serving; composes with --tp "
                         "(the fleet cache shards its KV heads over the "
-                        "model axis) and --speculative (whose fleet is "
-                        "greedy-only — sampling then uses the per-"
-                        "request rejection sampler)")
+                        "model axis) and --speculative (sampled lanes "
+                        "then run the rejection round per slot)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel degree: shard params Megatron-"
                         "style over this many local devices (decode "
@@ -87,9 +86,10 @@ def parse_args(argv=None):
                         "exact rejection sampling (output distribution "
                         "identical to plain temperature sampling). "
                         "0 = off; composes with --prefix-cache and "
-                        "--slots (the greedy fleet drafts/verifies per "
-                        "round — SpecDecodeEngine), incompatible with "
-                        "--tp > 1")
+                        "--slots (the fleet drafts/verifies per round "
+                        "— SpecDecodeEngine — with sampled lanes "
+                        "running the rejection round per slot), "
+                        "incompatible with --tp > 1")
     p.add_argument("--draft-layers", type=int, default=0,
                    help="draft depth for --speculative (0 = "
                         "num_layers/4, min 1)")
@@ -517,9 +517,7 @@ def make_handler(run, args, engine_loop=None):
                     kv, pfx_len = run.prefix_cache.get_or_build(
                         tuple(prefix_ids))
                     rows = [ids[:room] for ids in clean]
-                    if engine_loop is not None and (
-                            temperature == 0
-                            or engine_loop.engine.supports_sampling):
+                    if engine_loop is not None:
                         # Slots: the fleet's slots start from the
                         # spliced block (DecodeEngine.submit prefix=);
                         # the speculative engine also needs the draft
@@ -572,15 +570,13 @@ def make_handler(run, args, engine_loop=None):
                             ))
                             toks.append(prefix_ids + out[0][
                                 : plen + max_new].tolist())
-                elif engine_loop is not None and (
-                        temperature == 0
-                        or engine_loop.engine.supports_sampling):
+                elif engine_loop is not None:
                     # Continuous batching: all of this request's
                     # prompts join the shared decode fleet CONCURRENTLY
-                    # — sampled prompts as per-request-seeded lanes
-                    # (token-identical to the per-request path; the
-                    # speculative fleet is greedy-only, so sampling
-                    # keeps the per-request rejection sampler below).
+                    # — sampled prompts as per-request-seeded lanes,
+                    # token-identical to the per-request path (plain
+                    # fleets mirror generate()'s chain; speculative
+                    # fleets the rejection sampler's).
                     outs = engine_loop.generate_many(
                         clean, max_new, temperature=temperature,
                         seeds=[seed + i for i in range(len(clean))])

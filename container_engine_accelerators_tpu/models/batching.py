@@ -223,25 +223,53 @@ def _prefill_draft_lane_pfx(draft_model, draft_params, prefix_kv,
     return cache
 
 
-@partial(jax.jit, static_argnames=("model", "draft_model", "k"))
+@partial(jax.jit,
+         static_argnames=("model", "draft_model", "k", "any_sampled"))
 def _spec_fleet_step(model, draft_model, params, draft_params, t_cache,
-                     d_cache, pos, last_tok, active, k):
+                     d_cache, pos, last_tok, active, keys, temps, k,
+                     any_sampled):
+    """One speculative round for the whole fleet — ONE kernel for both
+    lane kinds, like ``_fleet_step``: greedy lanes use the argmax-match
+    acceptance rule and never consume randomness; sampled lanes
+    (``temps > 0``) run the per-slot rejection round, bit-matching
+    generate_speculative_sampled's B=1 rng discipline — per round
+    ``(rkey, kd, ka, kr) = split(key, 4)`` per slot, draft proposals
+    from ``categorical(fold_in(kd, i), logits/temp)``, acceptance
+    ``u*q < p`` with ``u = uniform(ka, (k,))``, residual/bonus from
+    ``categorical(kr, log(max(p-q,0) or p))``.  ``any_sampled`` is
+    STATIC: an all-greedy fleet's trace carries no RNG work at all.
+    """
     s = active.shape[0]
+    if any_sampled:
+        rounds = jax.vmap(lambda key: jax.random.split(key, 4))(keys)
+        new_keys, kd, ka, kr = (rounds[:, 0], rounds[:, 1],
+                                rounds[:, 2], rounds[:, 3])
+        sampled = temps > 0
+        safe_t = jnp.where(sampled, temps, 1.0)
 
-    def dstep(c, _):
+    def dstep(c, i):
         cache, tok, p = c
         logits, mut = draft_model.apply(
             {"params": draft_params, "cache": cache},
             tok[:, None], positions=p[:, None], mutable=["cache"],
         )
-        nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
-        return (mut["cache"], nxt, p + 1), nxt
+        row = logits[:, 0, :]
+        greedy_nxt = jnp.argmax(row, axis=-1).astype(jnp.int32)
+        if not any_sampled:
+            return (mut["cache"], greedy_nxt, p + 1), (greedy_nxt, 0.0)
+        srow = row / safe_t[:, None]
+        keys_i = jax.vmap(jax.random.fold_in, in_axes=(0, None))(kd, i)
+        samp_nxt = jax.vmap(jax.random.categorical)(
+            keys_i, srow).astype(jnp.int32)
+        nxt = jnp.where(sampled, samp_nxt, greedy_nxt)
+        return (mut["cache"], nxt, p + 1), (
+            nxt, jax.nn.softmax(srow, axis=-1))
 
     # k+1 draft steps (the extra one keeps the draft cache complete
     # when every proposal is accepted — speculative.py's rule).
-    (d_cache, _, _), drafts = jax.lax.scan(
-        dstep, (d_cache, last_tok, pos), None, length=k + 1)
-    drafts = drafts.transpose(1, 0)[:, :k]  # [S, k]
+    (d_cache, _, _), (draft_toks, draft_qs) = jax.lax.scan(
+        dstep, (d_cache, last_tok, pos), jnp.arange(k + 1))
+    drafts = draft_toks.transpose(1, 0)[:, :k]       # [S, k]
 
     chunk = jnp.concatenate([last_tok[:, None], drafts], axis=1)
     pos_chunk = pos[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None]
@@ -252,17 +280,43 @@ def _spec_fleet_step(model, draft_model, params, draft_params, t_cache,
     t_cache = mut["cache"]
     tgt_choice = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    matches = (drafts == tgt_choice[:, :k]).astype(jnp.int32)
+    g_matches = (drafts == tgt_choice[:, :k]).astype(jnp.int32)
+    if any_sampled:
+        qs = draft_qs.transpose(1, 0, 2)[:, :k, :]   # [S, k, V]
+        ps = jax.nn.softmax(logits / safe_t[:, None, None], axis=-1)
+        p_at = jnp.take_along_axis(
+            ps[:, :k, :], drafts[..., None], axis=-1)[..., 0]
+        q_at = jnp.take_along_axis(qs, drafts[..., None], axis=-1)[..., 0]
+        u = jax.vmap(lambda key: jax.random.uniform(key, (k,)))(ka)
+        s_matches = (u * q_at < p_at).astype(jnp.int32)
+        matches = jnp.where(sampled[:, None], s_matches, g_matches)
+    else:
+        matches = g_matches
     m = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # [S]
-    next_tok = jnp.take_along_axis(tgt_choice, m[:, None], axis=1)[:, 0]
+
+    g_next = jnp.take_along_axis(tgt_choice, m[:, None], axis=1)[:, 0]
+    if any_sampled:
+        qs_pad = jnp.concatenate(
+            [qs, jnp.zeros_like(ps[:, :1, :])], axis=1)
+        p_m = jnp.take_along_axis(ps, m[:, None, None], axis=1)[:, 0, :]
+        q_m = jnp.take_along_axis(qs_pad, m[:, None, None], axis=1)[:, 0, :]
+        res = jnp.maximum(p_m - q_m, 0.0)
+        res_sum = jnp.sum(res, axis=-1, keepdims=True)
+        safe = jnp.where(res_sum > 0, res, p_m)
+        s_next = jax.vmap(jax.random.categorical)(
+            kr, jnp.log(safe + 1e-30)).astype(jnp.int32)
+        next_tok = jnp.where(sampled, s_next, g_next)
+        keys = jnp.where((sampled & active)[:, None], new_keys, keys)
+    else:
+        next_tok = g_next
+
     row = jnp.concatenate([drafts, jnp.zeros((s, 1), jnp.int32)], axis=1)
     row = row.at[jnp.arange(s), m].set(next_tok)
-
     new_pos = jnp.where(active, pos + m + 1, pos)
     new_tok = jnp.where(active, next_tok, last_tok)
     t_cache = _rewind_cache_index(t_cache, new_pos)
     d_cache = _rewind_cache_index(d_cache, new_pos)
-    return t_cache, d_cache, new_pos, new_tok, row, m
+    return t_cache, d_cache, new_pos, new_tok, row, m, keys
 
 
 class DecodeEngine:
@@ -371,12 +425,6 @@ class DecodeEngine:
 
     # ---- host API -------------------------------------------------------
 
-    # Whether sampled (temperature > 0) requests may join this fleet;
-    # the speculative subclass's rounds are greedy-only and overrides
-    # this to False (sampled requests use the per-request rejection
-    # sampler instead).
-    supports_sampling = True
-
     def submit(self, prompt_ids: List[int], max_new: int,
                prefix=None, temperature: float = 0.0,
                seed: int = 0) -> int:
@@ -395,10 +443,6 @@ class DecodeEngine:
         per-request ``generate(..., temperature, rng=PRNGKey(seed))``
         regardless of what else shares the fleet.
         """
-        if temperature and temperature > 0 and not self.supports_sampling:
-            raise ValueError(
-                f"{type(self).__name__} fleets are greedy-only; route "
-                f"sampled requests to the per-request path")
         if not self._free:
             raise RuntimeError("no free slot — step() until one drains")
         plen = len(prompt_ids)
@@ -537,6 +581,15 @@ class SpecDecodeEngine(DecodeEngine):
     prefix_len)`` — each model's own spliced block, as in
     ``generate_speculative(prefix=)``.
 
+    SAMPLED lanes (``temperature > 0`` at submit) run the rejection
+    round per slot (``_spec_fleet_step``'s sampled path), bit-matching
+    ``generate_speculative_sampled``'s B=1 rng discipline on the
+    request's own seed chain — so sampled lanes are token-identical
+    to the per-request rejection sampler regardless of fleet
+    composition, while greedy lanes in the same fleet keep the
+    argmax contract.  An all-greedy fleet keeps the randomness-free
+    trace.
+
     Acceptance telemetry: ``spec_rounds`` / ``spec_drafted`` /
     ``spec_accepted`` accumulate across rounds (live slots only);
     acceptance rate is the lever that decides the realized speedup.
@@ -564,9 +617,6 @@ class SpecDecodeEngine(DecodeEngine):
 
     # ---- host API -------------------------------------------------------
 
-    # The spec round's acceptance rule is argmax-match: greedy only.
-    supports_sampling = False
-
     def submit(self, prompt_ids: List[int], max_new: int,
                prefix=None, temperature: float = 0.0,
                seed: int = 0) -> int:
@@ -592,14 +642,17 @@ class SpecDecodeEngine(DecodeEngine):
         self.d_cache = _lane_put_jit(self.d_cache, lane, slot)
 
     def step(self) -> int:
-        """One speculative round for the whole fleet."""
+        """One speculative round for the whole fleet; sampled lanes
+        (if any) run the rejection round, greedy lanes the argmax
+        round — an all-greedy fleet keeps its randomness-free trace."""
         if not self._req:
             return 0
-        (self.cache, self.d_cache, self.pos, self.last_tok, row, m) = (
-            _spec_fleet_step(self.model, self.draft_model, self.params,
-                             self.draft_params, self.cache,
-                             self.d_cache, self.pos, self.last_tok,
-                             self.active, self.k)
+        (self.cache, self.d_cache, self.pos, self.last_tok, row, m,
+         self.rngs) = _spec_fleet_step(
+            self.model, self.draft_model, self.params,
+            self.draft_params, self.cache, self.d_cache, self.pos,
+            self.last_tok, self.active, self.rngs, self.temps, self.k,
+            any(r["sampled"] for r in self._req.values()),
         )
         rows = np.asarray(row)
         accepts = np.asarray(m)

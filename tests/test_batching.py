@@ -432,11 +432,44 @@ def test_sampled_lane_with_prefix_matches_generate_with_prefix(
     assert eng.result(rp) == want[0, 2:7].tolist()
 
 
-def test_spec_engine_refuses_sampled_lanes(decode_model, params):
-    eng = SpecDecodeEngine(decode_model, params, decode_model, params,
-                           max_slots=1, max_len=32, k=2)
-    with pytest.raises(ValueError, match="greedy-only"):
-        eng.submit([1, 2], 3, temperature=1.0, seed=0)
+def test_spec_engine_sampled_lanes_match_per_request(decode_model,
+                                                     params, draft):
+    """Sampled lanes in the SPECULATIVE fleet run the rejection round
+    per slot on the request's own seed chain: token-identical to
+    per-request generate_speculative_sampled, mixed freely with
+    greedy spec lanes, independent of fleet composition."""
+    from container_engine_accelerators_tpu.models.speculative import (
+        generate_speculative_sampled,
+    )
+
+    dm, dp = draft
+
+    def solo(ids, n, temp, seed):
+        out, _ = generate_speculative_sampled(
+            decode_model, params, dm, dp,
+            jnp.asarray([ids], jnp.int32), n, k=3, temperature=temp,
+            rng=jax.random.PRNGKey(seed))
+        return np.asarray(out)[0, len(ids): len(ids) + n].tolist()
+
+    eng = SpecDecodeEngine(decode_model, params, dm, dp, max_slots=3,
+                           max_len=40, k=3)
+    r1 = eng.submit([5, 17, 42], max_new=6, temperature=0.7, seed=9)
+    eng.step()
+    r2 = eng.submit([88, 3], max_new=5)  # greedy spec lane mid-flight
+    eng.step()
+    r3 = eng.submit([7, 9, 11], max_new=4, temperature=1.3, seed=4)
+    eng.run_until_drained()
+    assert eng.result(r1) == solo([5, 17, 42], 6, 0.7, 9)
+    assert eng.result(r2) == _solo_spec(decode_model, params, dm, dp,
+                                        [88, 3], 5, 3)
+    assert eng.result(r3) == solo([7, 9, 11], 4, 1.3, 4)
+
+    # Fleet-composition independence for the sampled spec lane.
+    eng2 = SpecDecodeEngine(decode_model, params, dm, dp, max_slots=1,
+                            max_len=40, k=3)
+    ra = eng2.submit([5, 17, 42], max_new=6, temperature=0.7, seed=9)
+    eng2.run_until_drained()
+    assert eng2.result(ra) == eng.result(r1)
 
 
 def test_sampled_lane_on_tp_mesh_matches_single_device(decode_model,

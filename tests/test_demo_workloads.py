@@ -674,8 +674,11 @@ def test_serve_lm_http_speculative_with_slots(spec_slots_server):
 
         batched = post({"prompt_ids": [[1, 2, 3], [5]],
                         "max_new_tokens": 4})
+        # Sampled requests land in the speculative fleet as rejection-
+        # round lanes (round 5) — seed-pinned against the per-request
+        # rejection sampler below.
         sampled = post({"prompt_ids": [[1, 2]], "max_new_tokens": 4,
-                        "temperature": 1.0})
+                        "temperature": 1.0, "seed": 31})
         assert len(sampled["tokens"][0]) == 6
     finally:
         srv.shutdown()
@@ -693,6 +696,12 @@ def test_serve_lm_http_speculative_with_slots(spec_slots_server):
         want = np.asarray(run(jnp.asarray([padded], jnp.int32),
                               len(ids), 0.0, 0, False))
         assert got == want[0][: len(ids) + 4].tolist()
+
+    # The sampled lane == the per-request rejection sampler at the
+    # handler's seed derivation (seed + row index 0).
+    want_s = np.asarray(run(jnp.asarray([[1, 2]], jnp.int32), 2,
+                            1.0, 31, True))
+    assert sampled["tokens"][0] == want_s[0][:6].tolist()
 
 
 @pytest.mark.slow
