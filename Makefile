@@ -78,6 +78,7 @@ bench-hw:
 	-BENCH_WORKLOAD=decode BENCH_DECODE_KV=0 BENCH_DECODE_WEIGHTS=f32 BENCH_DECODE_FLASH=1 BENCH_DECODE_PROMPT=1984 BENCH_DECODE_NEW=64 python bench.py
 	-python cmd/bench_serving.py --slots 4 --requests 12 --max-new 64 --num-layers 12 --num-heads 16 --head-dim 64 --mlp-dim 4096 --vocab-size 32768
 	-python cmd/bench_serving.py --slots 4 --requests 12 --max-new 64 --num-layers 12 --num-heads 16 --head-dim 64 --mlp-dim 4096 --vocab-size 32768 --speculative 4
+	-python cmd/bench_serving.py --slots 4 --requests 12 --max-new 64 --num-layers 12 --num-heads 16 --head-dim 64 --mlp-dim 4096 --vocab-size 32768 --temperature 1.0
 	-python cmd/bench_prefix.py
 	-BENCH_WORKLOAD=lm python bench.py
 	-BENCH_WORKLOAD=inception python bench.py

@@ -72,6 +72,20 @@ def parse_args(argv=None):
                         "random 1-layer draft (acceptance ~0 — bounds "
                         "the per-round overhead); bench.py's decode "
                         "stages use the same bracket")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="sampled serving: both paths sample with "
+                        "per-request seed chains (engine lanes "
+                        "replicate per-request generate's key chain); "
+                        "measures the RNG/categorical overhead the "
+                        "sampled lanes add per step.  Gated by "
+                        "--sampled-exact-floor (no per-position logit "
+                        "triage: sampled flips need the gumbel-"
+                        "perturbed ranking).  0 = greedy")
+    p.add_argument("--sampled-exact-floor", type=float, default=0.5,
+                   help="sampled mode fails if exact_match_fraction "
+                        "drops below this — a key-chain desync zeroes "
+                        "agreement, while bf16 tiling tie-flips cost "
+                        "at most a few requests")
     p.add_argument("--tie-margin", type=float, default=0.02,
                    help="logit gap below which a sequential/engine "
                         "token mismatch counts as a bf16 argmax "
@@ -145,11 +159,33 @@ def main(argv=None) -> int:
         draft_params = d_state.params
 
     # --- sequential path (compile outside the clock, per bucket) ----
+    # Sampled mode: request i rides seed SEED0+i on BOTH paths — the
+    # engine's lanes replicate generate()'s key chain, so exactness
+    # stays checkable.
+    SEED0 = 1000
+    temp = args.temperature
     if args.speculative:
+        if temp > 0:
+            from container_engine_accelerators_tpu.models.speculative \
+                import generate_speculative_sampled
+
+            run = jax.jit(
+                lambda p, n, s: generate_speculative_sampled(
+                    model, params, draft_model, draft_params, p,
+                    args.max_new, k=args.speculative, temperature=temp,
+                    rng=jax.random.PRNGKey(s), prompt_len=n)[0]
+            )
+        else:
+            run = jax.jit(
+                lambda p, n: generate_speculative(
+                    model, params, draft_model, draft_params, p,
+                    args.max_new, k=args.speculative, prompt_len=n)[0]
+            )
+    elif temp > 0:
         run = jax.jit(
-            lambda p, n: generate_speculative(
-                model, params, draft_model, draft_params, p,
-                args.max_new, k=args.speculative, prompt_len=n)[0]
+            lambda p, n, s: generate(
+                model, params, p, args.max_new, temperature=temp,
+                rng=jax.random.PRNGKey(s), prompt_len=n)
         )
     else:
         run = jax.jit(
@@ -157,18 +193,19 @@ def main(argv=None) -> int:
                                   prompt_len=n)
         )
 
-    def seq_one(ids):
+    def seq_one(ids, seed=0):
         bucket = bucket_len(len(ids), max_prompt)
         padded = jnp.asarray([ids + [0] * (bucket - len(ids))], jnp.int32)
-        out = np.asarray(run(padded, len(ids)))
+        out = np.asarray(run(padded, len(ids), seed) if temp > 0
+                         else run(padded, len(ids)))
         return out[0, len(ids): len(ids) + args.max_new].tolist()
 
     for ln in sorted(set(lens)):  # warm each bucket
         seq_one([0] * ln)
     seq_out, seq_ttft = [], []
     t0 = time.perf_counter()
-    for ids in prompts:
-        seq_out.append(seq_one(ids))
+    for i, ids in enumerate(prompts):
+        seq_out.append(seq_one(ids, SEED0 + i))
         # The request's first token becomes OBSERVABLE when its fused
         # call returns — i.e. after every predecessor fully finished.
         seq_ttft.append(time.perf_counter() - t0)
@@ -194,7 +231,8 @@ def main(argv=None) -> int:
             while queue and eng._free:
                 i = queue.pop(0)
                 rids[i] = eng.submit([int(t) for t in reqs[i]],
-                                     args.max_new)
+                                     args.max_new, temperature=temp,
+                                     seed=SEED0 + i)
                 ttft[i] = time.perf_counter() - t0  # tok0 observable
             eng.step()
             for i, rid in list(rids.items()):
@@ -255,17 +293,28 @@ def main(argv=None) -> int:
         return j, float(row[seq_toks[j]]) - float(row[eng_toks[j]])
 
     ties, real = [], []
-    for i, (a, b) in enumerate(zip(seq_out, eng_out)):
-        if a == b[: args.max_new]:
-            continue
-        j, gap = _divergence_gap(prompts[i], a, b)
-        (ties if abs(gap) <= args.tie_margin else real).append(
-            {"request": i, "pos": j, "gap": round(gap, 5)})
-    assert not real, (
-        f"engine genuinely diverged from generate() (|gap| > "
-        f"{args.tie_margin} at the first divergent position — not a "
-        f"bf16 near-tie): {real}"
-    )
+    if temp == 0:
+        for i, (a, b) in enumerate(zip(seq_out, eng_out)):
+            if a == b[: args.max_new]:
+                continue
+            j, gap = _divergence_gap(prompts[i], a, b)
+            (ties if abs(gap) <= args.tie_margin else real).append(
+                {"request": i, "pos": j, "gap": round(gap, 5)})
+        assert not real, (
+            f"engine genuinely diverged from generate() (|gap| > "
+            f"{args.tie_margin} at the first divergent position — not "
+            f"a bf16 near-tie): {real}"
+        )
+    # Sampled mode has no raw-logit triage (a flip needs the
+    # gumbel-perturbed ranking, not the logits, to be near-tied), but
+    # it still gates: a key-chain desync zeroes agreement, while
+    # legitimate tie-flips cost at most a few requests.
+    if temp > 0:
+        assert exact >= args.sampled_exact_floor, (
+            f"sampled engine agreement {exact:.3f} below the "
+            f"{args.sampled_exact_floor} floor — per-request key "
+            f"chains have desynced from generate()'s"
+        )
 
     tokens = args.requests * args.max_new
     mean_seq_ttft = sum(seq_ttft) / len(seq_ttft)
@@ -277,6 +326,8 @@ def main(argv=None) -> int:
           f"{mean_eng_ttft * 1e3:.0f}ms)", file=sys.stderr)
     stag = (f"_speck{args.speculative}{args.spec_draft}"
             if args.speculative else "")
+    if temp > 0:
+        stag += f"_sampledT{temp:g}"
     result = {
         "metric": "serving_continuous_batching_ttft_speedup" + stag,
         "value": round(mean_seq_ttft / mean_eng_ttft, 3),

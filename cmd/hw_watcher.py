@@ -183,6 +183,15 @@ DEFAULT_STAGES = [
              "--num-heads", "16", "--head-dim", "64", "--mlp-dim", "4096",
              "--vocab-size", "32768", "--speculative", "4"],
      "timeout": 1800},
+    # Sampled lanes: per-request seed chains through the fleet; the
+    # stage measures the RNG/categorical per-step overhead vs the
+    # greedy engine stage above.
+    {"name": "bench_serving_sampled",
+     "cmd": [sys.executable, "cmd/bench_serving.py", "--slots", "4",
+             "--requests", "12", "--max-new", "64", "--num-layers", "12",
+             "--num-heads", "16", "--head-dim", "64", "--mlp-dim", "4096",
+             "--vocab-size", "32768", "--temperature", "1.0"],
+     "timeout": 1800},
     # Prefix-cache TTFT lever: full-vs-spliced prefill at serving
     # shapes (one compile each; cheap next to the train stages).
     {"name": "bench_prefix",

@@ -493,3 +493,45 @@ def test_sampled_lane_on_tp_mesh_matches_single_device(decode_model,
         decode_model, params, jnp.asarray([[5, 17, 42]], jnp.int32), 6,
         temperature=0.7, rng=jax.random.PRNGKey(9)))
     assert eng.result(r) == out[0, 3:9].tolist()
+
+
+@pytest.mark.slow
+def test_bench_serving_cli_sampled():
+    """cmd/bench_serving.py --temperature (round 5): the sampled
+    sequential-reference lambdas and seed plumbing run end-to-end and
+    the exact-floor gate passes — on CPU the engine's key chains
+    replicate generate()'s bit-for-bit, so agreement is 1.0."""
+    import contextlib
+    import importlib.util
+    import io
+    import json as _json
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_serving_cli_sampled",
+        os.path.join(repo, "cmd", "bench_serving.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main(["--slots", "2", "--requests", "4", "--max-new",
+                       "6", "--prompt-lens", "3,5",
+                       "--temperature", "1.0"])
+    assert rc == 0
+    line = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["metric"].endswith("_sampledT1")
+    assert line["exact_match_fraction"] == 1.0
+    # Speculative + sampled through the same CLI (rejection rounds in
+    # the fleet, 1L draft so real rejections happen).
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main(["--slots", "2", "--requests", "3", "--max-new",
+                       "5", "--prompt-lens", "4", "--temperature",
+                       "1.0", "--speculative", "2",
+                       "--spec-draft", "1L"])
+    assert rc == 0
+    line = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert line["metric"].endswith("_speck21L_sampledT1")
+    assert line["exact_match_fraction"] == 1.0
+    assert 0 <= line["spec_accept_rate"] < 0.95

@@ -245,3 +245,51 @@ def test_prefix_cache_concurrent_get_or_build(engine_bits):
     assert not any(t.is_alive() for t in ts), "prefix cache deadlock"
     assert not errs, errs
     assert len(pc2) == 1
+
+
+@pytest.mark.slow
+def test_engine_loop_mixed_sampled_churn(engine_bits):
+    """Round-5 sampled lanes under thread churn: greedy and sampled
+    requests interleave across waves; every sampled response must
+    equal its per-request generate(seed) — the per-request key chain
+    must survive arbitrary fleet interleavings under the EngineLoop's
+    locking."""
+    model, params = engine_bits
+    loop = EngineLoop(DecodeEngine(model, params, max_slots=2,
+                                   max_len=32))
+    prompts = [[5, 17, 42], [9, 8], [7], [1, 2, 3, 4]]
+
+    def want(p, i):
+        if i % 2 == 0:  # greedy
+            out = np.asarray(generate(model, params,
+                                      jnp.asarray([p], jnp.int32), 5))
+        else:
+            out = np.asarray(generate(
+                model, params, jnp.asarray([p], jnp.int32), 5,
+                temperature=0.8, rng=jax.random.PRNGKey(100 + i)))
+        return out[0, len(p): len(p) + 5].tolist()
+
+    refs = {i: want(prompts[i % len(prompts)], i) for i in range(8)}
+    results, errors = {}, []
+
+    def ask(i):
+        try:
+            p = prompts[i % len(prompts)]
+            if i % 2 == 0:
+                results[i] = loop.generate(p, 5, timeout=120)
+            else:
+                results[i] = loop.generate(p, 5, timeout=120,
+                                           temperature=0.8,
+                                           seed=100 + i)
+        except Exception as e:  # noqa: BLE001
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=ask, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "engine deadlock"
+    assert not errors, errors
+    for i in range(8):
+        assert results[i] == refs[i], i

@@ -195,6 +195,6 @@ def test_default_stages_match_bench_hw_suite(watcher_mod):
                  "BENCH_DECODE_PROMPT=1984", "BENCH_DECODE_SPEC=4",
                  "BENCH_DECODE_SPEC_DRAFT=1L",
                  "BENCH_DECODE_SPEC_SAMPLED=1", "bench_serving.py",
-                 "--speculative", "inception"):
+                 "--speculative", "--temperature", "inception"):
         assert tool in joined, tool
         assert tool in mk
