@@ -33,11 +33,11 @@ def _params_for(cfg):
     return state.params
 
 
-def _solo(model, params, n=5):
+def _solo(model, params, n=5, prompt=PROMPT):
     out = np.asarray(
-        generate(model, params, jnp.asarray([PROMPT], jnp.int32), n)
+        generate(model, params, jnp.asarray([prompt], jnp.int32), n)
     )
-    return out[0, len(PROMPT): len(PROMPT) + n].tolist()
+    return out[0, len(prompt): len(prompt) + n].tolist()
 
 
 def _engine(model, params, n=5):
@@ -280,3 +280,23 @@ def test_spec_engine_with_moe_target_matches_solo():
         model, params, dm, dp, jnp.asarray([PROMPT], jnp.int32), 5, k=3)
     want = np.asarray(out)[0, len(PROMPT): len(PROMPT) + 5].tolist()
     assert eng.result(rid) == want
+
+
+@pytest.mark.slow
+def test_engine_with_flash_decode_matches_solo():
+    """Continuous batching x the flash-decode kernel (round-5 audit:
+    this pairing had no pin): the fleet step's per-slot depths drive
+    the kernel's per-sequence skip logic, and interleaved slot output
+    must equal per-request generate() on the same flash model."""
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+    params = _params_for(cfg)
+    fm = transformer_lm(**cfg, decode=True, use_flash_decode=True)
+
+    eng = DecodeEngine(fm, params, max_slots=2, max_len=32)
+    r1 = eng.submit(PROMPT, 5)
+    eng.step()
+    r2 = eng.submit([88, 3], 4)  # joins mid-flight, different depth
+    eng.run_until_drained()
+    assert eng.result(r1) == _solo(fm, params, 5)
+    assert eng.result(r2) == _solo(fm, params, 4, prompt=[88, 3])
