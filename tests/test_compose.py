@@ -300,3 +300,50 @@ def test_engine_with_flash_decode_matches_solo():
     eng.run_until_drained()
     assert eng.result(r1) == _solo(fm, params, 5)
     assert eng.result(r2) == _solo(fm, params, 4, prompt=[88, 3])
+
+
+@pytest.mark.slow
+def test_flash_decode_with_prefix_and_speculative_matches_einsum():
+    """Flash-decode x prefix-cache and x speculation (round-5 audit:
+    serve_lm admits both pairings; neither had a pin).  The spliced
+    cursor feeds the kernel's per-sequence visible length, and the
+    speculative round mixes flash single-token drafts with einsum
+    chunk verifies — each must equal the all-einsum path exactly."""
+    from container_engine_accelerators_tpu.models.prefix_cache import (
+        PrefixCache,
+        generate_with_prefix,
+    )
+    from container_engine_accelerators_tpu.models.speculative import (
+        generate_speculative,
+    )
+
+    cfg = dict(vocab_size=97, num_layers=2, num_heads=4, head_dim=8,
+               mlp_dim=32, num_kv_heads=2)
+    params = _params_for(cfg)
+    em = transformer_lm(**cfg, decode=True)
+    fm = transformer_lm(**cfg, decode=True, use_flash_decode=True)
+
+    # Prefix splice: kernel skip driven by the spliced visible length.
+    pfx = (5, 17, 42)
+    suffix = jnp.asarray([[7, 9]], jnp.int32)
+
+    def spliced(model):
+        kv, plen = PrefixCache(model, params,
+                               max_prefix_len=4).get_or_build(pfx)
+        return np.asarray(generate_with_prefix(
+            model, params, kv, plen, suffix, 5))
+
+    np.testing.assert_array_equal(spliced(fm), spliced(em))
+
+    # Speculation: flash drafts + einsum chunk verify == all-einsum.
+    d_cfg = dict(cfg, num_layers=1)
+    dp = _params_for(d_cfg)
+    prompt = jnp.asarray([PROMPT], jnp.int32)
+    base, _ = generate_speculative(
+        em, params, transformer_lm(**d_cfg, decode=True), dp, prompt,
+        5, k=3)
+    flash, _ = generate_speculative(
+        fm, params,
+        transformer_lm(**d_cfg, decode=True, use_flash_decode=True),
+        dp, prompt, 5, k=3)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(flash))
