@@ -31,6 +31,10 @@ COPY --from=builder /src/native/dcnxferd/build/dcnxferd \
     /usr/local/bin/dcnxferd
 COPY --from=builder /src/native/dcnfastsock/build/libdcnfastsock.so \
     /usr/local/lib/libdcnfastsock.so
+# The data-pipeline Job's init container invokes the packer at its
+# in-tree path (demo/tpu-training/lm-data-tpu.yaml).
+COPY --from=builder /src/native/tokpack/build/tokpack \
+    /app/native/tokpack/build/tokpack
 
 ENV PYTHONPATH=/app
 CMD ["python3", "/app/cmd/tpu_device_plugin.py"]

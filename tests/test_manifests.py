@@ -354,3 +354,40 @@ def test_lm_data_manifest_args_accepted_and_wired():
         for cc in pod["containers"] + pod["initContainers"]
     }
     assert all("data" in m for m in data_mounts.values())
+
+
+def test_manifest_app_paths_exist_in_image():
+    """Every /app/<path> a shipped manifest or script invokes must
+    exist in the release image: either under a tree the Dockerfile
+    copies wholesale (cmd/, demo/, example/, the package) with the
+    file present in the repo, or via an explicit COPY destination (the
+    native binaries are copied file-by-file — round 5 caught the
+    lm-data Job's tokpack path missing exactly this way)."""
+    import re
+
+    dockerfile = open(os.path.join(REPO, "Dockerfile")).read()
+    wholesale = tuple(
+        m.rstrip("/") for m in re.findall(
+            r"^COPY (\S+)/ \1/$", dockerfile, re.M))
+    assert "cmd" in wholesale and "demo" in wholesale
+    # Only genuine COPY destinations count — a comment or CMD line
+    # mentioning the path must not satisfy the guard.
+    explicit = set(re.findall(r"^\s*(?:COPY|ADD)\b[^\n]*?/app/(\S+)$",
+                              dockerfile, re.M))
+
+    refs = set()
+    scan = MANIFESTS + sorted(
+        glob.glob(os.path.join(REPO, "**", "*.sh"), recursive=True))
+    for path in scan:
+        if "/.git/" in path or "/build/" in path:
+            continue
+        refs.update(re.findall(r"/app/([\w./-]+)", open(path).read()))
+    assert refs, "no /app references found — the scan broke"
+    for ref in sorted(refs):
+        top = ref.split("/")[0]
+        ok = (os.path.exists(os.path.join(REPO, ref))
+              if top in wholesale else ref in explicit)
+        assert ok, (f"a manifest/script references /app/{ref} but the "
+                    f"Dockerfile neither copies its tree wholesale "
+                    f"(with the file present in the repo) nor COPYs "
+                    f"it explicitly")
