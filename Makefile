@@ -53,6 +53,20 @@ test: native
 test-all: native
 	$(PY) -m pytest tests/ -x -q
 
+# Chaos gate: the self-healing suite, then again under TPU_FAULT_SPEC
+# permutations — the same binaries absorbing injected connect/send
+# faults, a dropped health stream, a refused kubelet Register, and a
+# spec that is pure garbage (which must be ignored, not fatal).
+CHAOS_RUN := $(PY) -m pytest tests/test_chaos.py -q -p no:randomly
+
+.PHONY: chaos
+chaos:
+	$(CHAOS_RUN)
+	TPU_FAULT_SPEC="dcn.send:fail@2;health.stream:drop@1" $(CHAOS_RUN)
+	TPU_FAULT_SPEC="dcn.connect:drop@1x2;kubelet.register:fail@1" $(CHAOS_RUN)
+	TPU_FAULT_SPEC="checkpoint.save:fail@1;dcn.send:drop@5x3" $(CHAOS_RUN)
+	TPU_FAULT_SPEC="total@@garbage;;not-a-spec" $(CHAOS_RUN)
+
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests
 	bash build/check_boilerplate.sh

@@ -56,6 +56,14 @@ def parse_args(argv=None):
     )
     p.add_argument("--enable-container-tpu-metrics", action="store_true")
     p.add_argument("--enable-health-monitoring", action="store_true")
+    p.add_argument(
+        "--health-recovery-window",
+        type=float,
+        default=None,
+        help="Seconds of quiescence after which an Unhealthy device is "
+             "re-announced Healthy (default: the checker's built-in "
+             "window; 0 disables recovery entirely)",
+    )
     p.add_argument("--tpu-metrics-port", type=int, default=2112)
     p.add_argument(
         "--tpu-metrics-collection-interval",
@@ -123,8 +131,16 @@ def main(argv=None):
         ).start()
 
     if args.enable_health_monitoring:
+        hc_kwargs = {}
+        if args.health_recovery_window is not None:
+            hc_kwargs["recovery_window_s"] = (
+                args.health_recovery_window
+                if args.health_recovery_window > 0 else None
+            )
         TpuHealthChecker(
-            manager, lib, critical_codes=manager.list_health_critical_codes()
+            manager, lib,
+            critical_codes=manager.list_health_critical_codes(),
+            **hc_kwargs,
         ).start()
 
     manager.serve(args.plugin_directory)

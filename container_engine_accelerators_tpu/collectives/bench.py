@@ -25,6 +25,7 @@ import argparse
 import dataclasses
 import functools
 import json
+import logging
 import sys
 import time
 from typing import Callable, Dict, List, Optional
@@ -39,6 +40,9 @@ except ImportError:  # pragma: no cover — older pinned jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+log = logging.getLogger(__name__)
+
+
 @dataclasses.dataclass
 class CollectiveResult:
     op: str
@@ -46,6 +50,87 @@ class CollectiveResult:
     time_us: float
     alg_bw_gbps: float  # GB/s
     bus_bw_gbps: float
+
+
+class DcnBenchAccounting:
+    """Mirror sweep traffic into the node dcnxferd's flow accounting.
+
+    When the pod env carries ``DCN_UDS_DIR`` the bench registers a flow
+    with the node transfer daemon and records each sweep point's bytes,
+    so per-node `stats` (and anything scraping them) sees bench traffic
+    exactly like workload traffic.  The client is the *resilient* one:
+    a daemon restart mid-sweep reconnects, replays the flow, and the
+    sweep finishes.  If the daemon stays gone past the retry budget the
+    accounting degrades gracefully — logged once, disabled, bench
+    results unaffected.
+    """
+
+    # Accounting only: reserve the minimum staging buffer, not the
+    # sweep's max message size (the pool belongs to real transfers).
+    FLOW_BYTES = 4096
+
+    def __init__(self, client, flow: str):
+        self._client = client
+        self._flow = flow
+        if self._client is not None:
+            self._client.register_flow(self._flow, peer="bench",
+                                       bytes=self.FLOW_BYTES)
+
+    @classmethod
+    def from_env(cls, flow: str) -> "DcnBenchAccounting":
+        from container_engine_accelerators_tpu.parallel import dcn
+        from container_engine_accelerators_tpu.parallel.dcn_client import (
+            DcnXferError,
+        )
+        from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+        client = None
+        try:
+            # Small budget for the initial probe: optional accounting
+            # must not stall bench startup ~30s when the sidecar is
+            # down.  Once connected, swap in the full budget so a
+            # mid-sweep daemon restart is actually covered.
+            client = dcn.make_xfer_client(
+                resilient=True,
+                retry=RetryPolicy(max_attempts=3, initial_backoff_s=0.1,
+                                  max_backoff_s=0.5, deadline_s=2.0),
+            )
+            acct = cls(client, flow)
+            if client is not None:
+                from container_engine_accelerators_tpu.parallel.dcn_client \
+                    import DEFAULT_DCN_RETRY
+
+                client._retry = DEFAULT_DCN_RETRY
+            return acct
+        except (DcnXferError, OSError) as e:
+            log.error("dcn accounting unavailable: %s", e)
+            if client is not None:  # connected but register_flow refused
+                try:
+                    client.close()
+                except OSError:
+                    pass
+            return cls(None, flow)
+
+    def record(self, result: "CollectiveResult") -> None:
+        if self._client is None:
+            return
+        from container_engine_accelerators_tpu.parallel.dcn_client import (
+            DcnXferError,
+        )
+
+        try:
+            self._client.record_transfer(self._flow, result.size_bytes)
+        except (DcnXferError, OSError) as e:
+            log.error("dcn accounting disabled after terminal error: %s", e)
+            self.close()
+            self._client = None
+
+    def close(self) -> None:
+        if self._client is not None:
+            try:
+                self._client.close()
+            except OSError:
+                pass
 
 
 def _parse_size(s: str) -> int:
@@ -146,6 +231,7 @@ def run_sweep(
     warmup: int = 5,
     op: str = "all_reduce",
     dtype=jnp.bfloat16,
+    on_result: Optional[Callable[[CollectiveResult], None]] = None,
 ) -> List[CollectiveResult]:
     if step_factor < 2:
         raise ValueError(f"step factor must be >= 2, got {step_factor}")
@@ -182,15 +268,18 @@ def run_sweep(
         if op == "all_gather":
             payload_bytes *= n
         alg_bw = payload_bytes / dt / 1e9
-        results.append(
-            CollectiveResult(
-                op=op,
-                size_bytes=payload_bytes,
-                time_us=dt * 1e6,
-                alg_bw_gbps=alg_bw,
-                bus_bw_gbps=alg_bw * _bus_factor(op, n),
-            )
+        result = CollectiveResult(
+            op=op,
+            size_bytes=payload_bytes,
+            time_us=dt * 1e6,
+            alg_bw_gbps=alg_bw,
+            bus_bw_gbps=alg_bw * _bus_factor(op, n),
         )
+        results.append(result)
+        if on_result is not None:
+            # Per-size hook (DCN accounting rides here) so a daemon
+            # restart mid-sweep is exercised mid-sweep, not after it.
+            on_result(result)
         size *= step_factor
     return results
 
@@ -223,16 +312,21 @@ def main(argv=None):
     from container_engine_accelerators_tpu.parallel import dcn
 
     dcn.initialize()
+    acct = DcnBenchAccounting.from_env(f"bench-{args.op}")
 
-    results = run_sweep(
-        min_bytes=_parse_size(args.min_bytes),
-        max_bytes=_parse_size(args.max_bytes),
-        step_factor=args.step_factor,
-        iters=args.iters,
-        warmup=args.warmup,
-        op=args.op,
-        dtype=jnp.dtype(args.dtype),
-    )
+    try:
+        results = run_sweep(
+            min_bytes=_parse_size(args.min_bytes),
+            max_bytes=_parse_size(args.max_bytes),
+            step_factor=args.step_factor,
+            iters=args.iters,
+            warmup=args.warmup,
+            op=args.op,
+            dtype=jnp.dtype(args.dtype),
+            on_result=acct.record,
+        )
+    finally:
+        acct.close()
 
     n = len(jax.devices())
     print(f"# {args.op} over {n} devices ({jax.devices()[0].platform})")

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional
 import grpc
 
 from container_engine_accelerators_tpu.deviceplugin import api, preferred
+from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.partition.subslice import (
     SubsliceDeviceManager,
 )
@@ -40,7 +41,9 @@ from container_engine_accelerators_tpu.sharing import (
 )
 from container_engine_accelerators_tpu.sharing.gate import CoreSharingGate
 from container_engine_accelerators_tpu.tpulib.types import TpuLib
+from container_engine_accelerators_tpu.utils import faults
 from container_engine_accelerators_tpu.utils.config import TPUConfig
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 from container_engine_accelerators_tpu.utils.device import (
     HEALTHY,
     Device,
@@ -60,6 +63,13 @@ SOCKET_CHECK_INTERVAL_S = 1.0  # kubelet-restart poll (pluginSocketCheckInterval
 CORE_PERCENTAGE_ENV = "TPU_CORE_PERCENTAGE"
 HBM_LIMIT_ENV = "TPU_HBM_LIMIT_BYTES"
 MEM_FRACTION_ENV = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+
+# A kubelet mid-restart refuses Register for a few seconds; ride it out
+# instead of crashing the DaemonSet pod (which would race the kubelet's
+# own plugin-dir wipe and lose the socket watch).
+REGISTER_RETRY = RetryPolicy(
+    max_attempts=6, initial_backoff_s=0.5, max_backoff_s=5.0, deadline_s=30.0
+)
 
 
 class TpuManager:
@@ -184,11 +194,27 @@ class TpuManager:
         with self.devices_mutex:
             if TPU_DEVICE_RE.match(name):
                 self.devices[name] = Device(id=name, health=health)
-                # A chip fault takes down the sub-slice that owns the chip.
+                # A chip fault takes down the sub-slice that owns the
+                # chip; a chip recovery re-heals the slice only once
+                # EVERY member chip is healthy again (the slice is the
+                # unit the kubelet actually sees, so without this the
+                # health checker's recovery would be a silent no-op on
+                # partitioned nodes).
                 if self.config.partition_size and self.subslice_manager:
                     slice_id = self.subslice_manager.slice_for_chip(name)
-                    if slice_id is not None and health != HEALTHY:
+                    if slice_id is None:
+                        return
+                    if health != HEALTHY:
                         self.subslice_manager.set_device_health(slice_id, health)
+                    elif all(
+                        self.devices.get(
+                            c.name, Device(id=c.name, health="")
+                        ).health == HEALTHY
+                        for c in self.subslice_manager.members(slice_id)
+                    ):
+                        self.subslice_manager.set_device_health(
+                            slice_id, HEALTHY
+                        )
             elif self.subslice_manager is not None:
                 self.subslice_manager.set_device_health(name, health)
 
@@ -347,17 +373,52 @@ class TpuManager:
 
             try:
                 if register_with_kubelet:
-                    api.register_with_v1beta1_kubelet(
+                    if not self._register_with_retry(
                         os.path.join(plugin_mount_path, kubelet_endpoint),
                         endpoint,
-                        self.resource_name,
-                    )
+                    ):
+                        # Budget exhausted: tear the server down and
+                        # restart the loop on a fresh socket rather than
+                        # crash — the kubelet may still be coming up.
+                        continue
                     log.info("device-plugin registered with the kubelet")
 
                 self._status_check(endpoint_path)
             finally:
                 server.stop(grace=1).wait()
                 self.grpc_server = None
+
+    def _register_with_retry(self, kubelet_socket: str, endpoint: str) -> bool:
+        """Register with the kubelet under REGISTER_RETRY; False when the
+        budget is exhausted (caller restarts the serve loop).  Fault site
+        ``kubelet.register`` fires before each attempt."""
+        last = None
+        for attempt in self._retry_attempts():
+            if self._stop.is_set():
+                return False
+            try:
+                faults.check("kubelet.register")
+                api.register_with_v1beta1_kubelet(
+                    kubelet_socket, endpoint, self.resource_name
+                )
+                if attempt > 0:
+                    counters.inc("kubelet.register.retried")
+                return True
+            except (grpc.RpcError, grpc.FutureTimeoutError, OSError) as e:
+                # FutureTimeoutError: channel_ready_future never went
+                # ready — the kubelet socket exists but nothing answers
+                # (mid-restart), the classic transient.
+                last = e
+                counters.inc("kubelet.register.failed")
+                log.error(
+                    "kubelet registration attempt %d failed: %s", attempt + 1, e
+                )
+        log.error("kubelet registration budget exhausted: %s", last)
+        return False
+
+    def _retry_attempts(self):
+        # Sleep on the stop event so shutdown interrupts the backoff.
+        return REGISTER_RETRY.attempts(sleep=self._stop.wait)
 
     def _status_check(self, endpoint_path: str) -> None:
         last_device_check = time.monotonic()
@@ -368,6 +429,7 @@ class TpuManager:
             # tear down and re-register (manager.go:475-481).
             if not os.path.lexists(endpoint_path):
                 log.info("plugin socket %s deleted; restarting", endpoint_path)
+                counters.inc("kubelet.reregister")
                 return
             if time.monotonic() - last_device_check >= self.device_check_interval_s:
                 last_device_check = time.monotonic()

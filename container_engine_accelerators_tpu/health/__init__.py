@@ -2,6 +2,11 @@
 from container_engine_accelerators_tpu.health.health_checker import (
     TpuHealthChecker,
     DEFAULT_CRITICAL_CODES,
+    DEFAULT_RECOVERY_WINDOW_S,
 )
 
-__all__ = ["TpuHealthChecker", "DEFAULT_CRITICAL_CODES"]
+__all__ = [
+    "TpuHealthChecker",
+    "DEFAULT_CRITICAL_CODES",
+    "DEFAULT_RECOVERY_WINDOW_S",
+]

@@ -11,7 +11,21 @@ of NVML's Xid events; the state machine is the same:
   (health_checker.go:192-201);
 - transitions are pushed into the manager's health queue, which
   ListAndWatch drains and re-announces to the kubelet
-  (beta_plugin.go:39-54).
+  (beta_plugin.go:39-54);
+- RECOVERY (ours; the reference has no path back to Healthy): a device
+  that has seen no further critical events for ``recovery_window_s``
+  is re-announced Healthy through the same queue.  TPU faults are
+  frequently transient at the node level — a runtime restart clears a
+  TensorCore hang, a re-init clears most ICI link flaps — and without
+  recovery a single blip permanently shrinks the node's allocatable
+  count until a human deletes the pod.  Every fresh critical event
+  re-stamps the quiescence clock, so a genuinely sick chip that keeps
+  faulting never recovers — and a chip that re-faults shortly AFTER a
+  recovery (load-triggered faults are invisible while nothing schedules
+  on it) gets an exponentially escalating window (flap backoff), so it
+  decays toward permanently-out rather than killing a workload per
+  cycle.  Transition counts are exported through metrics/counters.py
+  (``health.unhealthy``, ``health.recovered``, ``health.flap_backoff``).
 
 TPU error code registry (ours; the Xid-number analog):
   48  HBM uncorrectable ECC error          (critical by default, like Xid 48)
@@ -35,15 +49,36 @@ means updating runtime_map's patterns, not this state machine.
 
 import logging
 import threading
-from typing import Iterable, Optional, Set
+import time
+from typing import Dict, Iterable, Optional, Set
 
+from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.tpulib.types import TpuErrorEvent, TpuLib
-from container_engine_accelerators_tpu.utils.device import UNHEALTHY, Device
+from container_engine_accelerators_tpu.utils import faults
+from container_engine_accelerators_tpu.utils.device import (
+    HEALTHY,
+    UNHEALTHY,
+    Device,
+)
 
 log = logging.getLogger(__name__)
 
 DEFAULT_CRITICAL_CODES = frozenset({48})
 EVENT_WAIT_TIMEOUT_S = 5.0  # nvml.WaitForEvent(5000) analog
+# Default quiescence window before an Unhealthy device is re-announced
+# Healthy.  Chosen >> the event stream's own latency so a fault burst in
+# flight can't race the recovery, and long enough that CrashLooping
+# workloads on the sick chip have drained.  Tests pass tiny values.
+DEFAULT_RECOVERY_WINDOW_S = 300.0
+# Quiescence alone cannot see load-triggered faults: an unscheduled bad
+# chip is quiet BECAUSE nothing touches it.  A re-fault within
+# FLAP_RESET_FACTOR windows of a recovery therefore counts as a flap and
+# doubles the next window (capped at 2**MAX_FLAP_DOUBLINGS = 64x, 300s →
+# ~5.3h), so a chip that only breaks under traffic decays toward
+# effectively-permanent Unhealthy instead of killing a workload every
+# 300s forever.
+FLAP_RESET_FACTOR = 4
+MAX_FLAP_DOUBLINGS = 6
 
 
 class TpuHealthChecker:
@@ -52,11 +87,21 @@ class TpuHealthChecker:
         manager,
         lib: TpuLib,
         critical_codes: Optional[Iterable[int]] = None,
+        recovery_window_s: Optional[float] = DEFAULT_RECOVERY_WINDOW_S,
+        event_wait_timeout_s: float = EVENT_WAIT_TIMEOUT_S,
     ):
         self.manager = manager
         self.lib = lib
         self.critical_codes: Set[int] = set(DEFAULT_CRITICAL_CODES)
         self.critical_codes.update(critical_codes or [])
+        self.event_wait_timeout_s = event_wait_timeout_s
+        # None disables recovery (strict reference semantics: Unhealthy
+        # is forever).
+        self.recovery_window_s = recovery_window_s
+        self._unhealthy_since: Dict[str, float] = {}
+        self._recovered_at: Dict[str, float] = {}
+        self._flaps: Dict[str, int] = {}
+        self._mu = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -73,23 +118,28 @@ class TpuHealthChecker:
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=2 * EVENT_WAIT_TIMEOUT_S)
+            self._thread.join(timeout=2 * self.event_wait_timeout_s)
 
     # -- event loop ----------------------------------------------------------
 
     def _listen_to_events(self) -> None:
         while not self._stop.is_set():
+            event = None
             try:
-                event = self.lib.wait_for_event(EVENT_WAIT_TIMEOUT_S)
+                faults.check("health.stream")
+                event = self.lib.wait_for_event(self.event_wait_timeout_s)
             except Exception as e:
-                # Keep monitoring alive across transient backend errors, but
-                # back off so a persistent failure can't spin the CPU.
+                # Keep monitoring alive across transient backend errors
+                # (and injected ``health.stream`` faults), but back off
+                # so a persistent failure can't spin the CPU.
                 log.error("TPU event wait failed: %s; backing off", e)
-                self._stop.wait(EVENT_WAIT_TIMEOUT_S)
-                continue
-            if event is None:
-                continue
-            self.catch_error(event)
+                counters.inc("health.stream.errors")
+                self._stop.wait(self.event_wait_timeout_s)
+            if event is not None:
+                self.catch_error(event)
+            # Recovery runs even while the event stream is down: an
+            # outage of the *detector* must not pin devices Unhealthy.
+            self.maybe_recover()
 
     def catch_error(self, event: TpuErrorEvent) -> None:
         """Decide which devices an event takes down
@@ -129,4 +179,67 @@ class TpuHealthChecker:
         self._mark_unhealthy(event.device)
 
     def _mark_unhealthy(self, name: str) -> None:
+        now = time.monotonic()
+        with self._mu:
+            # Re-stamp on EVERY critical event: a device that keeps
+            # faulting keeps pushing its quiescence window out.
+            self._unhealthy_since[name] = now
+            recovered_at = self._recovered_at.pop(name, None)
+            if recovered_at is not None and self.recovery_window_s:
+                window = self._window_for(name)
+                if now - recovered_at < FLAP_RESET_FACTOR * window:
+                    # Broke again soon after we re-announced it Healthy:
+                    # likely a load-triggered fault that quiescence can't
+                    # see.  Escalate the next window.
+                    self._flaps[name] = min(
+                        self._flaps.get(name, 0) + 1, MAX_FLAP_DOUBLINGS
+                    )
+                    counters.inc("health.flap_backoff")
+                else:
+                    self._flaps.pop(name, None)  # stayed good: forgiven
+        counters.inc("health.unhealthy")
         self.manager.health_events.put(Device(id=name, health=UNHEALTHY))
+
+    def _window_for(self, name: str) -> float:
+        """Effective quiescence window: doubled per recorded flap."""
+        return self.recovery_window_s * (2 ** self._flaps.get(name, 0))
+
+    # -- recovery ------------------------------------------------------------
+
+    def maybe_recover(self, now: Optional[float] = None) -> int:
+        """Re-announce devices whose quiescence window has passed.
+
+        Called from the event loop every wakeup; public so tests (and
+        operators via a debug hook) can drive it deterministically.
+        Returns the number of devices recovered this pass.
+        """
+        # Falsy (None or 0) means disabled — 0 must never mean "recover
+        # instantly": the CLI documents 0 as off, and an accidental 0
+        # would silently defeat health monitoring.
+        if not self.recovery_window_s:
+            return 0
+        now = time.monotonic() if now is None else now
+        recovered = []
+        with self._mu:
+            for name, since in list(self._unhealthy_since.items()):
+                window = self._window_for(name)
+                if now - since < window:
+                    continue
+                del self._unhealthy_since[name]
+                self._recovered_at[name] = now
+                recovered.append((name, window))
+        announced = 0
+        for name, window in recovered:
+            if name not in self.manager.devices:
+                # Hotplug/repartition removed it while Unhealthy; there
+                # is nothing to re-announce.
+                log.info("device %s vanished while unhealthy; dropping", name)
+                continue
+            log.warning(
+                "device %s quiet for %.0fs after critical fault: "
+                "re-announcing Healthy", name, window,
+            )
+            counters.inc("health.recovered")
+            self.manager.health_events.put(Device(id=name, health=HEALTHY))
+            announced += 1
+        return announced

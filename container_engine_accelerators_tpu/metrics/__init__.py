@@ -1,4 +1,22 @@
-"""Public re-exports for the metrics package."""
-from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+"""Public re-exports for the metrics package.
 
-__all__ = ["MetricServer"]
+``MetricServer`` resolves lazily (PEP 562): the robustness counters in
+``metrics.counters`` are stdlib-only and imported at module scope by
+utils/ and parallel/, so importing this package must not drag in
+prometheus_client/grpc — those load only when the exporter itself is
+requested (cmd/tpu_device_plugin.py defers that behind
+``--enable-container-tpu-metrics``).
+"""
+from container_engine_accelerators_tpu.metrics import counters
+
+__all__ = ["MetricServer", "counters"]
+
+
+def __getattr__(name):
+    if name == "MetricServer":
+        from container_engine_accelerators_tpu.metrics.metrics import (
+            MetricServer,
+        )
+
+        return MetricServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

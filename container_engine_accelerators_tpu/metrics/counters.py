@@ -1,0 +1,50 @@
+"""Process-wide robustness counters: retries, reconnects, health flips.
+
+The self-healing layer (utils/retry.py, parallel/dcn_client.py,
+health/health_checker.py, deviceplugin/manager.py) needs its recovery
+behavior to be *observable*, not just tested — an agent that silently
+reconnects forty times a minute is a failing node that still looks
+green.  Components increment flat named counters here; the MetricServer
+exports the snapshot as the ``agent_events{event=...}`` gauge
+family next to the duty-cycle/HBM gauges (metrics/metrics.py), so the
+same Prometheus scrape that feeds the HPA also shows recovery churn.
+
+Kept dependency-free (stdlib only) so utils/ and parallel/ can import
+it without dragging in prometheus_client or grpc.
+
+Counter name convention: dotted ``<component>.<event>`` —
+``dcn.reconnect.success``, ``health.recovered``, ``retry.exhausted``,
+``fault.fired.<site>``.
+"""
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def inc(name: str, n: int = 1) -> int:
+    """Add ``n`` to counter ``name`` (created at 0); returns the new value."""
+    with _lock:
+        value = _counters.get(name, 0) + n
+        _counters[name] = value
+        return value
+
+
+def get(name: str) -> int:
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> Dict[str, int]:
+    """Point-in-time copy of every counter (what the exporter publishes)."""
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    """Zero everything — test isolation only; production counters are
+    cumulative for the life of the agent process."""
+    with _lock:
+        _counters.clear()

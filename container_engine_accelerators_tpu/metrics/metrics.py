@@ -14,6 +14,9 @@ reset periodically so pods that vanish stop being reported
 Exported gauges (container): duty_cycle, memory_total, memory_used, request
            (node):           duty_cycle_tpu_node, memory_total_tpu_node,
                              memory_used_tpu_node
+           (agent):          agent_events{event=...} — the
+                             self-healing counters from metrics/counters.py
+                             (retries, reconnects, health transitions)
 """
 
 import logging
@@ -23,6 +26,7 @@ from typing import Optional, Tuple
 
 from prometheus_client import CollectorRegistry, Gauge, start_http_server
 
+from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.metrics.devices import (
     POD_RESOURCES_SOCKET,
     PodResourcesClient,
@@ -120,6 +124,13 @@ class MetricServer:
         self.memory_used_node = g(
             "memory_used_tpu_node", "Node-level used HBM (bytes)", _NODE_LABELS
         )
+        self.agent_events = g(
+            "agent_events",
+            "Cumulative self-healing/robustness events on this node agent "
+            "(retries, reconnects, flow replays, health transitions, "
+            "injected faults) keyed by metrics/counters.py name",
+            ["event"],
+        )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -151,6 +162,7 @@ class MetricServer:
             self.duty_cycle_node,
             self.memory_total_node,
             self.memory_used_node,
+            self.agent_events,
         ):
             gauge.clear()
 
@@ -201,6 +213,12 @@ class MetricServer:
                     self.duty_cycle.labels(**labels).set(duty)
                     self.memory_total.labels(**labels).set(hbm.total_bytes)
                     self.memory_used.labels(**labels).set(hbm.used_bytes)
+
+        # Robustness counters are cumulative process state, re-published
+        # wholesale each pass (so the periodic registry reset cannot lose
+        # them the way it drops vanished pods' series).
+        for name, value in counters.snapshot().items():
+            self.agent_events.labels(event=name).set(value)
 
         for chip in self.collector.devices():
             try:

@@ -21,8 +21,17 @@ import jax
 import orbax.checkpoint as ocp
 
 from container_engine_accelerators_tpu.models.train import TrainState
+from container_engine_accelerators_tpu.utils import faults
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
+
+# Checkpoint targets are typically GCS-fuse / NFS mounts that flap under
+# node pressure; a failed interval save must not kill a training job
+# that could checkpoint fine 100ms later.  Small budget: a save that
+# fails 3 times is a real outage and should surface.
+SAVE_RETRY = RetryPolicy(max_attempts=3, initial_backoff_s=0.2,
+                         max_backoff_s=2.0)
 
 
 class TrainCheckpointer:
@@ -47,9 +56,33 @@ class TrainCheckpointer:
 
     def save(self, state: TrainState, wait: bool = False) -> None:
         step = int(jax.device_get(state.step))
-        self.manager.save(step, args=ocp.args.StandardSave(self._tree(state)))
-        if wait:
-            self.manager.wait_until_finished()
+
+        # Transient filesystem faults (and the armed ``checkpoint.save``
+        # site) retry under a small budget.  A failed attempt may still
+        # have committed (the error hit after orbax's atomic rename), so
+        # each retry first checks whether the step already landed —
+        # re-saving a recorded step raises in orbax.
+        last: Optional[Exception] = None
+        for attempt in SAVE_RETRY.attempts():
+            try:
+                # The dedupe probe sits INSIDE the try: it touches the
+                # same flaky filesystem the retry exists for.
+                if attempt and self.manager.latest_step() == step:
+                    log.warning("checkpoint step %d landed despite the "
+                                "previous attempt's error; continuing", step)
+                    return
+                faults.check("checkpoint.save")
+                self.manager.save(
+                    step, args=ocp.args.StandardSave(self._tree(state))
+                )
+                if wait:
+                    self.manager.wait_until_finished()
+                return
+            except OSError as e:
+                log.warning("checkpoint save attempt %d for step %d "
+                            "failed: %s", attempt + 1, step, e)
+                last = e
+        raise last
 
     def restore_latest(
         self, state: TrainState

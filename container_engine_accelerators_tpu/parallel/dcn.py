@@ -12,15 +12,23 @@ Env contract (set by the Job manifest, deploy/xla-collectives/):
     TPU_WORKER_COUNT      number of processes  (Job parallelism)
     TPU_COORDINATOR_ADDR  host:port of process 0; when unset it is derived
                           as <job>-0.<service>:8476 from JOB_NAME/SERVICE.
+    DCN_UDS_DIR           UDS directory of the node dcnxferd sidecar; when
+                          set, make_xfer_client()/exchange_shard() stage
+                          cross-slice legs through the daemon.
 """
 
 import logging
 import os
-from typing import Optional, Tuple
+import time
+from typing import Callable, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
 DEFAULT_COORDINATOR_PORT = 8476
+
+# Env contract for the node dcnxferd sidecar (set by the Job manifest
+# next to the worker-id/coordinator vars above).
+DCN_UDS_ENV = "DCN_UDS_DIR"
 
 
 def resolve_cluster(env=None) -> Tuple[Optional[str], int, int]:
@@ -77,3 +85,111 @@ def initialize(env=None) -> Tuple[int, int]:
         coordinator_address=addr, num_processes=num, process_id=pid
     )
     return num, pid
+
+
+# ---- dcnxferd transfer path -------------------------------------------------
+
+
+def make_xfer_client(
+    uds_dir: Optional[str] = None,
+    resilient: bool = True,
+    env=None,
+    **kwargs,
+):
+    """Build the node dcnxferd client from the pod env contract.
+
+    Resolution order: explicit ``uds_dir`` arg, then ``DCN_UDS_DIR``
+    env.  Returns None when neither is set (no sidecar on this node —
+    callers degrade to pure in-process collectives).  ``resilient=True``
+    (the default for workloads) returns a
+    :class:`~container_engine_accelerators_tpu.parallel.dcn_client.ResilientDcnXferClient`
+    that rides out daemon restarts; pass False for the fail-fast
+    transport client.
+    """
+    from container_engine_accelerators_tpu.parallel.dcn_client import (
+        DcnXferClient,
+        ResilientDcnXferClient,
+    )
+
+    env = env if env is not None else os.environ
+    uds = uds_dir or env.get(DCN_UDS_ENV)
+    if not uds:
+        return None
+    cls = ResilientDcnXferClient if resilient else DcnXferClient
+    return cls(uds, **kwargs)
+
+
+def wait_flow_rx(client, flow: str, nbytes: int,
+                 timeout_s: float = 60.0) -> None:
+    """Block until ``flow`` has landed ``nbytes`` (RX accounting is
+    asynchronous on the daemon side)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        f = next(
+            (x for x in client.stats()["flows"] if x["flow"] == flow), None
+        )
+        if f is not None and f["rx_bytes"] >= nbytes:
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"flow {flow!r} never received {nbytes} bytes")
+
+
+def exchange_shard(
+    client,
+    *,
+    local_flow: str,
+    peer_flow: str,
+    data: bytes,
+    peer_host: str,
+    peer_port: int,
+    barrier: Optional[Callable[[], object]] = None,
+    timeout_s: float = 60.0,
+) -> bytes:
+    """One cross-pod leg of a DCN collective, staged through dcnxferd.
+
+    Registers both directions (``local_flow`` to send, ``peer_flow`` to
+    land the peer's shard), stages ``data`` via the data plane, streams
+    it to the peer daemon, and returns the peer's shard read back out of
+    the local daemon — the pattern the jax.distributed integration rig
+    drives (tests/dcn_xfer_worker.py).  ``barrier`` runs after flow
+    registration and before the send: the peer must have registered its
+    landing flow or the payload counts as unmatched and is dropped
+    (``multihost_utils.sync_global_devices`` in real workers).
+
+    With a resilient client the leg survives a daemon restart up to and
+    during ``put`` (flows are replayed on reconnect; ``put``'s retry
+    budget restages the payload).  A restart in the window *after* a
+    completed put loses the staged bytes — the replayed flow comes back
+    empty and the rx wait times out; callers retry the whole leg
+    (restaging transparently is a ROADMAP open item).
+    """
+    from container_engine_accelerators_tpu.parallel.dcn_client import (
+        DcnXferError,
+    )
+
+    nbytes = len(data)
+    try:
+        # Registration inside the try: if the SECOND register fails
+        # (max_flows, name collision) the finally still releases the
+        # first instead of leaking it into every retry of the leg.
+        client.register_flow(local_flow, peer=peer_host, bytes=nbytes)
+        client.register_flow(peer_flow, bytes=nbytes)
+        if barrier is not None:
+            barrier()
+        client.put(local_flow, data)
+        wait_flow_rx(client, local_flow, nbytes, timeout_s)
+        client.send(local_flow, peer_host, peer_port, nbytes)
+        wait_flow_rx(client, peer_flow, nbytes, timeout_s)
+        return client.read(peer_flow, nbytes)
+    finally:
+        # Release both flows so repeated legs on a long-lived client
+        # neither hit the daemon's duplicate-flow rejection nor leak
+        # staging buffers toward max_flows/pool exhaustion.  By here the
+        # peer's send into peer_flow has landed (we waited + read), and
+        # local_flow's payload has been streamed out, so the releases
+        # touch only this node's daemon state.
+        for flow in (local_flow, peer_flow):
+            try:
+                client.release_flow(flow)
+            except (DcnXferError, OSError):
+                pass  # cleanup: a restarted daemon already forgot it

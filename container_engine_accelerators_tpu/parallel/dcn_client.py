@@ -4,13 +4,29 @@ The role the NCCL GPUDirect plugin plays against tcpgpudmarxd's UDS
 control socket (SURVEY.md §2.2): workers doing cross-slice DCN transfers
 register flows with the per-node daemon, which owns the pinned staging
 buffers; accounting rides the same socket.  Newline-delimited JSON.
+
+Two clients, two contracts:
+
+- :class:`DcnXferClient` is fail-fast: the first transport failure
+  poisons it (a buffered partial response must never satisfy a retry).
+- :class:`ResilientDcnXferClient` layers reconnect-with-backoff and
+  flow-table replay on top, for callers that must survive the daemon
+  restarting underneath them (the self-healing node-agent contract;
+  see tests/test_chaos.py).
 """
 
 import base64
 import json
+import logging
 import socket
 import struct
-from typing import Optional
+from typing import Dict, Optional
+
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.utils import faults
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+log = logging.getLogger(__name__)
 
 DEFAULT_UDS_DIR = "/run/tpu-dcn"
 SOCKET_NAME = "xferd.sock"
@@ -22,17 +38,35 @@ class DcnXferError(Exception):
 
 class DcnXferClient:
     def __init__(self, uds_dir: str = DEFAULT_UDS_DIR, timeout_s: float = 10.0):
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.settimeout(timeout_s)
-        self._sock.connect(f"{uds_dir.rstrip('/')}/{SOCKET_NAME}")
-        self._rfile = self._sock.makefile("r")
+        self._uds_dir = uds_dir.rstrip("/")
+        self._timeout_s = timeout_s
+        self._sock: Optional[socket.socket] = None
+        self._rfile = None
+        self._broken = False
+        self._connect()
+
+    def _connect(self) -> None:
+        """(Re)establish the control connection.  Fault site
+        ``dcn.connect`` fires here, before the real connect."""
+        faults.check("dcn.connect")
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self._timeout_s)
+        try:
+            sock.connect(f"{self._uds_dir}/{SOCKET_NAME}")
+        except OSError:
+            sock.close()
+            raise
+        self._sock = sock
+        self._rfile = sock.makefile("r")
         self._broken = False
 
     def close(self) -> None:
         """Closing releases every flow this client registered (the daemon
         ties buffer lifetime to the connection, like rxdm)."""
-        self._rfile.close()
-        self._sock.close()
+        if self._rfile is not None:
+            self._rfile.close()
+        if self._sock is not None:
+            self._sock.close()
 
     def __enter__(self):
         return self
@@ -46,6 +80,7 @@ class DcnXferClient:
                 "connection broken by earlier timeout; reconnect"
             )
         try:
+            faults.check("dcn.send")
             self._sock.sendall((json.dumps(req) + "\n").encode())
             line = self._rfile.readline()
         except (socket.timeout, OSError) as e:
@@ -149,3 +184,177 @@ class DcnXferClient:
 
     def stats(self) -> dict:
         return self._call(op="stats")
+
+
+# Reconnect budget tuned to ride out a daemon restart (the DaemonSet's
+# CrashLoopBackOff floor is 10s) without masking a genuinely dead node:
+# connect refusals fail instantly, so coverage is the SUM of the sleeps —
+# 0.05+0.1+0.2+0.4+0.8+1.6+3+3+3+3+3 ≈ 18s (> the 10s floor), with the
+# 30s deadline as the hard wall-clock cap.
+DEFAULT_DCN_RETRY = RetryPolicy(
+    max_attempts=12,
+    initial_backoff_s=0.05,
+    max_backoff_s=3.0,
+    deadline_s=30.0,
+)
+
+
+class ResilientDcnXferClient(DcnXferClient):
+    """A :class:`DcnXferClient` that survives daemon churn.
+
+    The base client is deliberately fail-fast: one connection failure
+    poisons it, because the buffered reader may hold a stale partial
+    response and the daemon has already released its flows (buffer
+    lifetime is tied to the connection, like rxdm).  That is the right
+    *transport* semantic — but a node agent or bench that dies because
+    the sidecar daemon restarted is a robustness hole.  This subclass
+    closes the loop:
+
+    - connection failures trigger reconnect with exponential backoff
+      under a bounded :class:`RetryPolicy` budget;
+    - a client-side **flow table** (flow → register args) is replayed
+      after every reconnect — mandatory for correctness, not a
+      convenience: the restarted/reconnected daemon has no memory of
+      this client's flows, so any op on an unreplayed flow would fail
+      with ``unknown flow``;
+    - daemon-level errors (``ok:false`` responses) still fail fast:
+      retrying a rejected request is wrong, only transport loss is
+      retried;
+    - once the budget is exhausted the client turns terminal: every
+      further call raises a clear ``DcnXferError`` immediately
+      (graceful degradation instead of hammering a dead socket).
+
+    Retrying an op whose response was lost cannot double-account on the
+    daemon: the connection's death released the server-side flow, so
+    the replayed registration starts from zero and the retried op runs
+    against fresh state.
+    """
+
+    def __init__(
+        self,
+        uds_dir: str = DEFAULT_UDS_DIR,
+        timeout_s: float = 10.0,
+        retry: Optional[RetryPolicy] = None,
+    ):
+        self._retry = retry or DEFAULT_DCN_RETRY
+        self._flows: Dict[str, dict] = {}
+        self._exhausted = False
+        # The initial connect rides the same budget: the client may come
+        # up before its node sidecar does.
+        self._retry.call(
+            super().__init__, uds_dir, timeout_s, retry_on=(OSError,)
+        )
+
+    # -- reconnect machinery -------------------------------------------------
+
+    def _reconnect_and_replay(self) -> None:
+        try:
+            self.close()
+        except OSError:  # a half-dead socket may refuse even close()
+            pass
+        counters.inc("dcn.reconnect.attempts")
+        self._connect()  # OSError propagates to the retry loop
+        counters.inc("dcn.reconnect.success")
+        for flow, kw in list(self._flows.items()):
+            try:
+                DcnXferClient._call(
+                    self, op="register_flow", flow=flow, **kw
+                )
+                counters.inc("dcn.replayed_flows")
+            except DcnXferError as e:
+                if self._broken:
+                    raise  # transport died again: retry loop handles it
+                if "exist" in str(e).lower():
+                    # An alive-but-slow daemon may not have processed the
+                    # old connection's EOF yet, so our own previous
+                    # registration still holds the name.  Mark broken and
+                    # surface as transport-level: the outer retry's
+                    # backoff gives the daemon time to release it.
+                    self._broken = True
+                    raise DcnXferError(
+                        f"flow replay raced old-connection cleanup: {e}"
+                    )
+                # Other daemon-level rejection (e.g. another client took
+                # the name): keep replaying the rest; ops on this flow
+                # will surface the daemon's own error.
+                log.error("replay of flow %r failed: %s", flow, e)
+        log.warning(
+            "dcn control connection re-established; %d flow(s) replayed",
+            len(self._flows),
+        )
+
+    def _with_budget(self, attempt, what: str, latch: bool):
+        """Run ``attempt`` under the retry budget; daemon-level errors
+        (ok:false with an intact transport) fail fast, transport loss
+        retries.  ``latch=True`` turns the client terminal on
+        exhaustion; the data plane passes False so a data-port-only
+        outage cannot poison still-healthy control-plane ops."""
+        if self._exhausted:
+            raise DcnXferError(
+                "dcn retry budget exhausted; client is terminal "
+                "(daemon stayed unreachable through "
+                f"{self._retry.max_attempts} attempts)"
+            )
+        last: Optional[BaseException] = None
+        for _attempt in self._retry.attempts():
+            try:
+                return attempt()
+            except DcnXferError as e:
+                if not self._broken or self._exhausted:
+                    # Daemon-level error, or a nested control-plane call
+                    # already latched terminal: fail fast — looping a
+                    # second budget over a terminal client only doubles
+                    # the hang.
+                    raise
+                last = e  # transport loss: reconnect on the next attempt
+            except OSError as e:  # reconnect/data-plane connect failed
+                last = e
+        if latch:
+            self._exhausted = True
+        counters.inc("dcn.retry.exhausted")
+        raise DcnXferError(
+            f"dcn {what} unreachable after "
+            f"{self._retry.max_attempts} attempts: {last}"
+        )
+
+    def _call(self, **req) -> dict:
+        def attempt():
+            if self._broken or self._sock is None:
+                self._reconnect_and_replay()
+            return DcnXferClient._call(self, **req)
+
+        return self._with_budget(attempt, "transfer daemon", latch=True)
+
+    # -- flow-table bookkeeping ----------------------------------------------
+
+    def register_flow(self, flow: str, peer: str = "",
+                      bytes: Optional[int] = None) -> dict:
+        resp = super().register_flow(flow, peer, bytes)
+        kw = {"peer": peer}
+        if bytes is not None:
+            kw["bytes"] = bytes
+        self._flows[flow] = kw
+        return resp
+
+    def release_flow(self, flow: str) -> None:
+        super().release_flow(flow)
+        self._flows.pop(flow, None)
+
+    def put(self, flow: str, data: bytes, host: str = "127.0.0.1",
+            port: Optional[int] = None) -> None:
+        """Data-plane staging with the same budget.  After a failure the
+        port is re-resolved via the (self-healing) control plane: a
+        restarted daemon binds a fresh ephemeral data port, so a cached
+        one dials a dead listener."""
+        state = {"port": port}
+
+        def attempt():
+            try:
+                return DcnXferClient.put(self, flow, data, host,
+                                         state["port"])
+            except OSError:
+                state["port"] = None
+                raise
+
+        return self._with_budget(attempt, "data plane", latch=False)
+

@@ -7,10 +7,12 @@ from container_engine_accelerators_tpu.utils.config import (
     TPUConfig,
     TPUSharingConfig,
 )
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
 __all__ = [
     "device_name_from_path",
     "device_path_from_name",
+    "RetryPolicy",
     "TPUConfig",
     "TPUSharingConfig",
 ]

@@ -20,10 +20,13 @@ Schema (accepts both lowerCamel and the reference's Go-style keys):
 
 import dataclasses
 import json
+import logging
 import os
 from typing import List, Optional
 
 from container_engine_accelerators_tpu.sharing import SharingStrategy
+
+log = logging.getLogger(__name__)
 
 # Valid sub-slice partition sizes for a 4-chip (2x2) tray / 8-chip host.
 # TPU analog of the reference's MIG partition-size table (mig.go:33-46).
@@ -147,7 +150,14 @@ class TPUConfig:
         self, env: Optional[dict] = None
     ) -> None:
         """Parse critical error codes from TPU_ERR_CONFIG env (csv ints),
-        mirroring AddHealthCriticalXid (manager.go:113-133)."""
+        mirroring AddHealthCriticalXid (manager.go:113-133).
+
+        A malformed entry is logged and skipped — NEVER raised: this
+        runs at node-agent startup, and one typo'd env var crashing the
+        device plugin into CrashLoopBackOff takes every TPU on the node
+        offline.  If no entry parses, the existing (file/default) codes
+        are kept.
+        """
         env = env if env is not None else os.environ
         raw = env.get(TPU_ERR_CONFIG_ENV, "")
         if not raw:
@@ -155,8 +165,19 @@ class TPUConfig:
         codes = []
         for part in raw.split(","):
             part = part.strip()
+            if not part:
+                continue
             try:
                 codes.append(int(part))
             except ValueError:
-                raise ValueError(f"Invalid TPU_ERR_CONFIG entry: {part!r}")
-        self.health_critical_codes = codes
+                log.error(
+                    "ignoring invalid %s entry %r (keeping defaults for it)",
+                    TPU_ERR_CONFIG_ENV, part,
+                )
+        if codes:
+            self.health_critical_codes = codes
+        else:
+            log.error(
+                "%s=%r contained no valid codes; keeping %s",
+                TPU_ERR_CONFIG_ENV, raw, self.health_critical_codes,
+            )

@@ -76,6 +76,19 @@ class PreemptionGuard:
             signal.signal(s, prev)
         self._previous.clear()
 
+    # Context-manager form so drivers and tests cannot leak the SIGTERM
+    # handler past their scope (a leaked handler redirects a LATER
+    # test's/process-phase's SIGTERM into a stale guard's flag):
+    #
+    #     with PreemptionGuard() as guard:
+    #         ...
+    def __enter__(self) -> "PreemptionGuard":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False
+
 
 def checkpoint_and_exit(checkpointer, state, step: int,
                         checkpoint_interval: int,

@@ -1,0 +1,118 @@
+"""Shared retry policy for everything that talks to a socket.
+
+Every node-agent data path has the same failure shape: a daemon or the
+kubelet restarts underneath an established connection, the call fails
+with a transient OSError/RpcError, and the correct response is
+exponential backoff with jitter under a bounded budget — never an
+unbounded spin, never a one-strike crash.  Before this module each
+component hand-rolled (or skipped) that loop; now ``RetryPolicy`` is
+the single place the budget lives:
+
+- ``parallel/dcn_client.py``  reconnect + flow replay against dcnxferd
+- ``deviceplugin/manager.py`` kubelet Register after kubelet restarts
+- ``models/checkpoint.py``    checkpoint saves over flaky filesystems
+- ``collectives/bench.py``    bench accounting riding the DCN daemon
+
+Jitter is multiplicative (±``jitter`` fraction) to de-synchronize a
+node's worth of agents retrying against one restarted daemon; the
+optional ``deadline_s`` caps the whole loop's wall clock so a retry
+budget can never outlive, say, a kubelet plugin-socket poll interval.
+"""
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Iterator, Optional, Tuple, Type
+
+from container_engine_accelerators_tpu.metrics import counters
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + jitter + deadline.
+
+    ``max_attempts`` counts total tries (first try included).  Sleeps
+    happen *between* attempts: ``backoff_s(0)`` is the delay after the
+    first failure.
+    """
+
+    max_attempts: int = 5
+    initial_backoff_s: float = 0.05
+    max_backoff_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.1  # ± fraction of the computed backoff
+    deadline_s: Optional[float] = None
+
+    def backoff_s(self, attempt: int, rng: Callable[[], float] = random.random
+                  ) -> float:
+        base = min(
+            self.initial_backoff_s * (self.multiplier ** attempt),
+            self.max_backoff_s,
+        )
+        if self.jitter:
+            base *= 1.0 + self.jitter * (2.0 * rng() - 1.0)
+        return max(base, 0.0)
+
+    def attempts(
+        self,
+        sleep: Callable[[float], object] = time.sleep,
+        monotonic: Callable[[], float] = time.monotonic,
+    ) -> Iterator[int]:
+        """Yield attempt indices 0..max_attempts-1, sleeping the backoff
+        between yields and stopping early once ``deadline_s`` would be
+        exceeded.  The caller breaks out on success; exhausting the
+        iterator means the budget is spent::
+
+            for attempt in policy.attempts():
+                try:
+                    return do_thing()
+                except OSError as e:
+                    last = e
+            raise TerminalError(...) from last
+
+        ``sleep`` is injectable so servers can wait on a stop event
+        (``sleep=stop.wait``) and tests can run the loop instantly.
+        """
+        start = monotonic()
+        for attempt in range(max(1, self.max_attempts)):
+            yield attempt
+            if attempt + 1 >= self.max_attempts:
+                break
+            delay = self.backoff_s(attempt)
+            if (
+                self.deadline_s is not None
+                and monotonic() - start + delay > self.deadline_s
+            ):
+                log.debug("retry deadline %.1fs reached after attempt %d",
+                          self.deadline_s, attempt + 1)
+                break
+            counters.inc("retry.attempts")
+            sleep(delay)
+
+    def call(
+        self,
+        fn: Callable,
+        *args,
+        retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+        sleep: Callable[[float], object] = time.sleep,
+        on_retry: Optional[Callable[[int, BaseException], object]] = None,
+        **kwargs,
+    ):
+        """Run ``fn`` under this policy; re-raises the last error once
+        the budget is exhausted."""
+        last: Optional[BaseException] = None
+        for attempt in self.attempts(sleep=sleep):
+            try:
+                return fn(*args, **kwargs)
+            except retry_on as e:  # noqa: PERF203 — the loop IS the feature
+                last = e
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                log.warning("attempt %d/%d of %s failed: %s", attempt + 1,
+                            self.max_attempts, getattr(fn, "__name__", fn), e)
+        counters.inc("retry.exhausted")
+        assert last is not None
+        raise last

@@ -19,26 +19,10 @@ a real jax.distributed process instead of the daemon's own tests.
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from container_engine_accelerators_tpu.parallel import dcn  # noqa: E402
-from container_engine_accelerators_tpu.parallel.dcn_client import (  # noqa: E402
-    DcnXferClient,
-)
-
-
-def _wait_rx(client: DcnXferClient, flow: str, nbytes: int, timeout=60.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        f = next(
-            (x for x in client.stats()["flows"] if x["flow"] == flow), None
-        )
-        if f is not None and f["rx_bytes"] >= nbytes:
-            return
-        time.sleep(0.02)
-    raise AssertionError(f"flow {flow} never received {nbytes} bytes")
 
 
 def main() -> None:
@@ -66,26 +50,28 @@ def main() -> None:
         jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
     )
 
-    # DCN leg: stage local bytes -> peer daemon -> peer reads -> reduce.
-    uds = os.environ["DCN_UDS_DIR"]
+    # DCN leg via the production transfer path (parallel/dcn.py): the
+    # resilient client + exchange_shard helper the workloads use.
     peer_host = os.environ["DCN_PEER_HOST"]
     peer_port = int(os.environ["DCN_PEER_DATA_PORT"])
-    nbytes = local_data.nbytes
-    with DcnXferClient(uds) as c:
-        c.register_flow(f"shard{pid}", peer=f"worker{peer}", bytes=nbytes)
-        c.register_flow(f"shard{peer}", peer=f"worker{peer}", bytes=nbytes)
-        # Barrier: the peer must have registered its landing flow before
-        # we send, or the payload counts as unmatched and is dropped.
-        multihost_utils.sync_global_devices("flows-ready")
-
-        c.put(f"shard{pid}", local_data.tobytes())
-        _wait_rx(c, f"shard{pid}", nbytes)
-        c.send(f"shard{pid}", peer_host, peer_port, nbytes)
-
-        _wait_rx(c, f"shard{peer}", nbytes)
-        peer_data = np.frombuffer(
-            c.read(f"shard{peer}", nbytes), np.float32
-        ).reshape(local_data.shape)
+    client = dcn.make_xfer_client()
+    assert client is not None, "DCN_UDS_DIR is not set in the worker env"
+    with client as c:
+        raw = dcn.exchange_shard(
+            c,
+            local_flow=f"shard{pid}",
+            peer_flow=f"shard{peer}",
+            data=local_data.tobytes(),
+            peer_host=peer_host,
+            peer_port=peer_port,
+            # Barrier: the peer must have registered its landing flow
+            # before we send, or the payload counts as unmatched and is
+            # dropped.
+            barrier=lambda: multihost_utils.sync_global_devices(
+                "flows-ready"
+            ),
+        )
+        peer_data = np.frombuffer(raw, np.float32).reshape(local_data.shape)
 
     dcn_total = float(local_data.sum() + peer_data.sum())
     ok = abs(dcn_total - jax_total) < 1e-2 * max(1.0, abs(jax_total))

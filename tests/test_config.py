@@ -96,10 +96,18 @@ def test_err_config_env_parse():
     assert cfg.health_critical_codes == [32, 79, 74]
 
 
-def test_err_config_env_invalid():
+def test_err_config_env_invalid_entry_skipped_not_fatal():
+    """A typo'd entry must not crash the node agent at startup: the bad
+    entry is logged + skipped, valid entries still apply."""
     cfg = TPUConfig()
-    with pytest.raises(ValueError, match="TPU_ERR_CONFIG"):
-        cfg.add_health_critical_codes(env={"TPU_ERR_CONFIG": "32,abc"})
+    cfg.add_health_critical_codes(env={"TPU_ERR_CONFIG": "32,abc"})
+    assert cfg.health_critical_codes == [32]
+
+
+def test_err_config_env_all_invalid_keeps_existing_codes():
+    cfg = TPUConfig(health_critical_codes=[48, 63])
+    cfg.add_health_critical_codes(env={"TPU_ERR_CONFIG": "abc,,!!"})
+    assert cfg.health_critical_codes == [48, 63]
 
 
 def test_err_config_env_absent_keeps_file_codes():

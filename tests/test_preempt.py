@@ -52,6 +52,22 @@ def test_guard_latches_sigterm_and_uninstalls():
     assert signal.getsignal(signal.SIGTERM) == before
 
 
+def test_guard_context_manager_uninstalls_on_exit():
+    """`with PreemptionGuard()` must restore the handler on BOTH the
+    clean path and the raising path — a leaked SIGTERM handler
+    redirects a later drain into a dead guard's flag."""
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert signal.getsignal(signal.SIGTERM) != before
+        assert not guard.should_stop
+    assert signal.getsignal(signal.SIGTERM) == before
+
+    with pytest.raises(RuntimeError):
+        with PreemptionGuard():
+            raise RuntimeError("driver blew up mid-step")
+    assert signal.getsignal(signal.SIGTERM) == before
+
+
 @pytest.mark.slow
 def test_train_lm_sigterm_checkpoints_and_resumes(tmp_path):
     """Real binary, real signal: SIGTERM after observed progress must

@@ -1,0 +1,211 @@
+"""XferdStub — an in-process dcnxferd control-plane double.
+
+Companion to tests/kubelet_stub.py: where KubeletStub stands in for the
+kubelet's Registration service, XferdStub stands in for the native
+transfer daemon's UDS control protocol (native/dcnxferd/), so chaos
+tests can kill and restart "the daemon" deterministically and instantly
+— no native build, no process spawn latency, and the restart window is
+exactly as long as the test wants it to be.
+
+Faithful to the daemon semantics the clients rely on:
+
+- newline-delimited JSON over a UDS at ``<dir>/xferd.sock``;
+- flows are OWNED by the registering connection; a client disconnect
+  releases its flows (buffer lifetime == connection lifetime, like
+  rxdm) — this is exactly why ResilientDcnXferClient must replay its
+  flow table after a reconnect;
+- ``register_flow`` rejects duplicates, ``record_transfer`` accumulates
+  per-flow and globally, ``stats`` reports both.
+
+Only the control plane is modeled (register/record/release/stats/
+ping/version); data-plane put/send/read chaos runs against the real
+binary in tests/test_chaos.py when it is built.
+"""
+
+import json
+import os
+import socket
+import threading
+from typing import Dict, Optional
+
+VERSION = "xferd-stub/1"
+
+
+class XferdStub:
+    def __init__(self, uds_dir: str):
+        self.uds_dir = uds_dir
+        self.sock_path = os.path.join(uds_dir, "xferd.sock")
+        self._flows: Dict[str, dict] = {}
+        self._total_transferred = 0
+        self._lock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._threads = []
+        self._conns = set()
+        self._stopping = threading.Event()
+        # Restart visibility: how many times this "daemon" has come up.
+        self.generation = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "XferdStub":
+        os.makedirs(self.uds_dir, exist_ok=True)
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)  # the real daemon unlinks-then-binds
+        self._stopping.clear()
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.sock_path)
+        srv.listen(16)
+        self._server = srv
+        self.generation += 1
+        t = threading.Thread(
+            target=self._accept_loop, name="xferd-stub-accept", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self, *, crash: bool = False) -> None:
+        """Stop serving.  ``crash=True`` models SIGKILL: connections die,
+        the socket file lingers until the next start() unlinks it (a
+        restarting client sees ECONNREFUSED, not ENOENT)."""
+        self._stopping.set()
+        if self._server is not None:
+            try:
+                # shutdown() before close(): close() alone leaves the
+                # accept thread blocked on the old fd and the listener
+                # still accepting (observed on Linux) — shutdown wakes
+                # it and refuses new connects immediately.
+                try:
+                    self._server.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self._server.close()
+            finally:
+                self._server = None
+        # Process death severs established connections too — without
+        # this, clients would keep talking to a "dead" daemon.
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+        if not crash and os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+        # Daemon death releases every flow (state lives in the process).
+        with self._lock:
+            self._flows.clear()
+            self._total_transferred = 0
+
+    # -- wire protocol -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        srv = self._server
+        while not self._stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            if self._stopping.is_set():
+                # AF_UNIX accept() does not wake on shutdown(): the
+                # kernel can pair one last connect with the blocked
+                # accept after stop().  Model process death: sever it.
+                conn.close()
+                return
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,),
+                name="xferd-stub-conn", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn_id = id(conn)
+        with self._lock:
+            self._conns.add(conn)
+        rfile = conn.makefile("r")
+        try:
+            for line in rfile:
+                try:
+                    req = json.loads(line)
+                    resp = self._handle(conn_id, req)
+                except (ValueError, KeyError) as e:
+                    resp = {"ok": False, "error": f"bad request: {e}"}
+                try:
+                    conn.sendall((json.dumps(resp) + "\n").encode())
+                except OSError:
+                    break
+        finally:
+            rfile.close()
+            conn.close()
+            with self._lock:
+                self._conns.discard(conn)
+            self._release_owned(conn_id)
+
+    def _release_owned(self, conn_id: int) -> None:
+        with self._lock:
+            for name in [
+                n for n, f in self._flows.items() if f["owner"] == conn_id
+            ]:
+                del self._flows[name]
+
+    def _handle(self, conn_id: int, req: dict) -> dict:
+        op = req.get("op")
+        with self._lock:
+            if op == "version":
+                return {"ok": True, "version": VERSION}
+            if op == "ping":
+                return {"ok": True}
+            if op == "register_flow":
+                flow = req["flow"]
+                if flow in self._flows:
+                    return {"ok": False, "error": f"flow already exists: {flow}"}
+                nbytes = int(req.get("bytes") or 4096)
+                self._flows[flow] = {
+                    "owner": conn_id,
+                    "peer": req.get("peer", ""),
+                    "buffer_bytes": nbytes,
+                    "transferred": 0,
+                }
+                return {"ok": True, "flow": flow, "buffer_bytes": nbytes}
+            if op == "record_transfer":
+                f = self._flows.get(req["flow"])
+                if f is None:
+                    return {"ok": False, "error": "unknown flow"}
+                if f["owner"] != conn_id:
+                    return {"ok": False,
+                            "error": "flow owned by another client"}
+                nbytes = req.get("bytes")
+                if not isinstance(nbytes, int) or nbytes < 0:
+                    return {"ok": False, "error": "invalid 'bytes'"}
+                f["transferred"] += nbytes
+                self._total_transferred += nbytes
+                return {"ok": True, "flow_bytes": f["transferred"]}
+            if op == "release_flow":
+                f = self._flows.get(req["flow"])
+                if f is None:
+                    return {"ok": False, "error": "unknown flow"}
+                if f["owner"] != conn_id:
+                    return {"ok": False,
+                            "error": "flow owned by another client"}
+                del self._flows[req["flow"]]
+                return {"ok": True}
+            if op == "stats":
+                return {
+                    "ok": True,
+                    "active_flows": len(self._flows),
+                    "total_transferred": self._total_transferred,
+                    "generation": self.generation,
+                    "flows": [
+                        {
+                            "flow": name,
+                            "peer": f["peer"],
+                            "transferred": f["transferred"],
+                            "rx_bytes": 0,
+                        }
+                        for name, f in self._flows.items()
+                    ],
+                }
+            return {"ok": False, "error": f"unknown op: {op}"}
