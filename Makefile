@@ -65,7 +65,16 @@ chaos:
 	TPU_FAULT_SPEC="dcn.send:fail@2;health.stream:drop@1" $(CHAOS_RUN)
 	TPU_FAULT_SPEC="dcn.connect:drop@1x2;kubelet.register:fail@1" $(CHAOS_RUN)
 	TPU_FAULT_SPEC="checkpoint.save:fail@1;dcn.send:drop@5x3" $(CHAOS_RUN)
+	TPU_FAULT_SPEC="k8s.patch:conflict@1;dcn.send:fail@4" $(CHAOS_RUN)
 	TPU_FAULT_SPEC="total@@garbage;;not-a-spec" $(CHAOS_RUN)
+
+# Observability gate: the obs/ layer (spans, histograms, flight
+# recorder), its exporter surface, and the no-undocumented-counters
+# README lint.
+.PHONY: obs
+obs:
+	$(PY) -m pytest tests/test_obs.py tests/test_metrics.py \
+	    tests/test_chaos.py -q -p no:randomly
 
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests

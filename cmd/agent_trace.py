@@ -1,0 +1,177 @@
+#!/usr/bin/env python3
+"""Summarize a node-agent trace JSONL: where does recovery time go?
+
+The agent-side companion of cmd/trace_summary.py — that tool digests
+XLA xplanes from a profiled training step; this one digests the span
+JSONL a node agent writes when ``TPU_TRACE_FILE`` is set
+(obs/trace.py), answering the operational questions a chaos run or a
+flapping node raises:
+
+- which ops dominate wall clock (dcn.send vs dcn.replay vs
+  health.event), with count / total / mean / p50 / p95 / p99 per name;
+- how many spans failed, and which fault sites killed them
+  (``attrs.fault`` stamped by utils/faults.py);
+- optionally one full trace reconstructed as a parent/child tree
+  (``--trace <id>``), e.g. a reconnect with its flow replays nested
+  under it.
+
+Also accepts flight-recorder dumps (obs/flight.py): a line whose
+object carries ``flight_recorder`` contributes its ``spans`` list.
+
+Usage:
+  python cmd/agent_trace.py <trace.jsonl> [--top 20] [--trace ID]
+                            [--slowest 5]
+Prints one JSON line (machine-readable) after a human table, exactly
+like trace_summary.py.
+"""
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="trace JSONL (TPU_TRACE_FILE output) or a "
+                                "flight-recorder dump")
+    p.add_argument("--top", type=int, default=20,
+                   help="span names to show in the table")
+    p.add_argument("--slowest", type=int, default=5,
+                   help="individually slowest spans to list")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="print this trace id as a span tree instead of "
+                        "aggregating")
+    return p.parse_args(argv)
+
+
+def load_spans(path):
+    """Tolerant reader: skips malformed lines (a crash mid-write must
+    not make the evidence unreadable), unwraps flight-recorder blobs."""
+    spans, skipped = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if obj.get("flight_recorder"):
+                spans.extend(obj.get("spans", []))
+            elif "span" in obj and "name" in obj:
+                spans.append(obj)
+            else:
+                skipped += 1
+    return spans, skipped
+
+
+def _pct(ordered, q):
+    if not ordered:
+        return 0.0
+    return ordered[min(len(ordered) - 1, int(len(ordered) * q))]
+
+
+def aggregate(spans, top=20, slowest=5):
+    per_name = defaultdict(list)
+    errors = defaultdict(int)
+    faults = defaultdict(int)
+    for s in spans:
+        per_name[s["name"]].append(float(s.get("dur_us", 0.0)))
+        if s.get("status") == "error":
+            errors[s["name"]] += 1
+        fault = (s.get("attrs") or {}).get("fault")
+        if fault:
+            faults[fault] += 1
+    rows = []
+    for name, durs in per_name.items():
+        durs.sort()
+        rows.append({
+            "name": name,
+            "count": len(durs),
+            "errors": errors.get(name, 0),
+            "total_ms": round(sum(durs) / 1e3, 3),
+            "mean_us": round(sum(durs) / len(durs), 1),
+            "p50_us": round(_pct(durs, 0.50), 1),
+            "p95_us": round(_pct(durs, 0.95), 1),
+            "p99_us": round(_pct(durs, 0.99), 1),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    slow = sorted(spans, key=lambda s: -float(s.get("dur_us", 0.0)))[:slowest]
+    return {
+        "spans": len(spans),
+        "traces": len({s.get("trace") for s in spans}),
+        "rows": rows[:top],
+        "fault_injections": dict(faults),
+        "slowest": [
+            {"name": s["name"], "dur_us": s.get("dur_us"),
+             "trace": s.get("trace"), "status": s.get("status"),
+             "attrs": s.get("attrs", {})}
+            for s in slow
+        ],
+    }
+
+
+def print_table(summary, file=sys.stderr):
+    rows = summary["rows"]
+    width = max([len(r["name"]) for r in rows] + [10])
+    print(f"{'span':<{width}} {'count':>7} {'err':>5} {'total_ms':>10} "
+          f"{'mean_us':>10} {'p50_us':>10} {'p95_us':>10} {'p99_us':>10}",
+          file=file)
+    for r in rows:
+        print(f"{r['name']:<{width}} {r['count']:>7} {r['errors']:>5} "
+              f"{r['total_ms']:>10.3f} {r['mean_us']:>10.1f} "
+              f"{r['p50_us']:>10.1f} {r['p95_us']:>10.1f} "
+              f"{r['p99_us']:>10.1f}", file=file)
+    if summary["fault_injections"]:
+        print(f"fault injections: {summary['fault_injections']}", file=file)
+
+
+def print_tree(spans, trace_id, file=sys.stderr):
+    """One trace as an indented parent/child tree, start-ordered."""
+    mine = [s for s in spans if s.get("trace") == trace_id]
+    mine.sort(key=lambda s: s.get("ts", 0.0))
+    children = defaultdict(list)
+    ids = {s["span"] for s in mine}
+    roots = []
+    for s in mine:
+        parent = s.get("parent")
+        if parent in ids:
+            children[parent].append(s)
+        else:
+            roots.append(s)  # parent evicted from the ring: treat as root
+
+    def walk(s, depth):
+        attrs = s.get("attrs") or {}
+        extra = f" {attrs}" if attrs else ""
+        mark = " !" if s.get("status") == "error" else ""
+        print(f"{'  ' * depth}{s['name']} {s.get('dur_us', 0):.0f}us"
+              f"{mark}{extra}", file=file)
+        for c in children.get(s["span"], []):
+            walk(c, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    return len(mine)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    spans, skipped = load_spans(args.path)
+    if not spans:
+        raise SystemExit(f"no spans in {args.path} ({skipped} bad lines)")
+    if args.trace:
+        n = print_tree(spans, args.trace)
+        print(json.dumps({"trace": args.trace, "spans": n}))
+        return
+    summary = aggregate(spans, args.top, args.slowest)
+    summary["skipped_lines"] = skipped
+    print_table(summary)
+    print(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,11 @@ class CollectiveResult:
     time_us: float
     alg_bw_gbps: float  # GB/s
     bus_bw_gbps: float
+    # Filled only by per-iteration timing (--percentiles): tail latency
+    # of individual collective rounds, which a mean can't show (one
+    # straggler link doubles p99 long before it moves the average).
+    p50_us: Optional[float] = None
+    p99_us: Optional[float] = None
 
 
 class DcnBenchAccounting:
@@ -232,7 +237,14 @@ def run_sweep(
     op: str = "all_reduce",
     dtype=jnp.bfloat16,
     on_result: Optional[Callable[[CollectiveResult], None]] = None,
+    per_iter: bool = False,
 ) -> List[CollectiveResult]:
+    """Message-size sweep.  Default timing runs the whole chained loop
+    on-device (nccl-tests semantics: no per-iteration dispatch in the
+    measurement).  ``per_iter=True`` instead times each round
+    individually — dispatch overhead included, which is WHY it is not
+    the default — emitting one ``bench.iter`` span per round (histogram
+    ``bench.<op>``) so results carry p50/p99, not just means."""
     if step_factor < 2:
         raise ValueError(f"step factor must be >= 2, got {step_factor}")
     if mesh is None:
@@ -260,13 +272,30 @@ def run_sweep(
             NamedSharding(mesh, P(mesh.axis_names[0])),
         )
         jax.block_until_ready(fn(x, max(warmup, 1)))  # compile + warmup
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(x, iters))
-        dt = (time.perf_counter() - t0) / iters
-
         payload_bytes = local_elems * itemsize
         if op == "all_gather":
             payload_bytes *= n
+        p50_us = p99_us = None
+        if per_iter:
+            from container_engine_accelerators_tpu.obs import trace
+
+            samples = []
+            for i in range(iters):
+                with trace.span("bench.iter", histogram=f"bench.{op}",
+                                op=op, size_bytes=payload_bytes,
+                                iteration=i) as s:
+                    jax.block_until_ready(fn(x, 1))
+                samples.append(s.duration_s)
+            dt = sum(samples) / iters
+            ordered = sorted(samples)
+            p50_us = ordered[len(ordered) // 2] * 1e6
+            p99_us = ordered[min(len(ordered) - 1,
+                                 int(len(ordered) * 0.99))] * 1e6
+        else:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(x, iters))
+            dt = (time.perf_counter() - t0) / iters
+
         alg_bw = payload_bytes / dt / 1e9
         result = CollectiveResult(
             op=op,
@@ -274,6 +303,8 @@ def run_sweep(
             time_us=dt * 1e6,
             alg_bw_gbps=alg_bw,
             bus_bw_gbps=alg_bw * _bus_factor(op, n),
+            p50_us=p50_us,
+            p99_us=p99_us,
         )
         results.append(result)
         if on_result is not None:
@@ -297,6 +328,13 @@ def main(argv=None):
         choices=["all_reduce", "all_gather", "reduce_scatter", "ppermute"],
     )
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "--percentiles", action="store_true",
+        help="time every round individually (one bench.iter span each) "
+             "and report p50/p99 next to the mean; per-round dispatch "
+             "overhead is included, so means run slightly higher than "
+             "the default chained-loop timing",
+    )
     p.add_argument("--line-rate-gbps", type=float, default=None,
                    help="ICI/DCN line rate; enables the >=threshold pass bar")
     p.add_argument("--pass-threshold", type=float, default=0.9)
@@ -324,22 +362,26 @@ def main(argv=None):
             op=args.op,
             dtype=jnp.dtype(args.dtype),
             on_result=acct.record,
+            per_iter=args.percentiles,
         )
     finally:
         acct.close()
 
     n = len(jax.devices())
     print(f"# {args.op} over {n} devices ({jax.devices()[0].platform})")
+    tail_hdr = f" {'p50(us)':>10} {'p99(us)':>10}" if args.percentiles else ""
     print(f"# {'bytes':>12} {'time(us)':>12} {'algbw(GB/s)':>12} "
-          f"{'busbw(GB/s)':>12}")
+          f"{'busbw(GB/s)':>12}{tail_hdr}")
     best = 0.0
     for r in results:
         best = max(best, r.bus_bw_gbps)
         if args.json:
             print(json.dumps(dataclasses.asdict(r)))
         else:
+            tail = (f" {r.p50_us:>10.1f} {r.p99_us:>10.1f}"
+                    if r.p50_us is not None else "")
             print(f"  {r.size_bytes:>12} {r.time_us:>12.1f} "
-                  f"{r.alg_bw_gbps:>12.2f} {r.bus_bw_gbps:>12.2f}")
+                  f"{r.alg_bw_gbps:>12.2f} {r.bus_bw_gbps:>12.2f}{tail}")
     ok = True
     frac = None
     if args.line_rate_gbps:

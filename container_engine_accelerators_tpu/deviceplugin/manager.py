@@ -31,6 +31,7 @@ import grpc
 
 from container_engine_accelerators_tpu.deviceplugin import api, preferred
 from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import trace
 from container_engine_accelerators_tpu.partition.subslice import (
     SubsliceDeviceManager,
 )
@@ -397,10 +398,13 @@ class TpuManager:
             if self._stop.is_set():
                 return False
             try:
-                faults.check("kubelet.register")
-                api.register_with_v1beta1_kubelet(
-                    kubelet_socket, endpoint, self.resource_name
-                )
+                with trace.span("kubelet.register",
+                                histogram="kubelet.register",
+                                attempt=attempt, endpoint=endpoint):
+                    faults.check("kubelet.register")
+                    api.register_with_v1beta1_kubelet(
+                        kubelet_socket, endpoint, self.resource_name
+                    )
                 if attempt > 0:
                     counters.inc("kubelet.register.retried")
                 return True

@@ -19,6 +19,7 @@ import grpc
 from container_engine_accelerators_tpu.deviceplugin import (
     deviceplugin_v1beta1_pb2 as pb,
 )
+from container_engine_accelerators_tpu.obs import trace
 from container_engine_accelerators_tpu.sharing import validate_request
 
 log = logging.getLogger(__name__)
@@ -78,54 +79,66 @@ class DevicePluginService:
             except queue.Empty:
                 continue
             log.info("device-plugin: %s device marked as %s", d.id, d.health)
-            self.manager.set_device_health(d.id, d.health)
-            yield self._device_list_response()
+            # The re-announce latency the kubelet actually experiences:
+            # applying the transition + rebuilding the device list.
+            with trace.span("plugin.health_announce",
+                            histogram="plugin.health_announce",
+                            device=d.id, health=d.health):
+                self.manager.set_device_health(d.id, d.health)
+                resp = self._device_list_response()
+            yield resp
 
     # -- Allocate ------------------------------------------------------------
 
     def Allocate(self, request, context):
         resps = pb.AllocateResponse()
         for rqt in request.container_requests:
-            try:
-                self.manager.verify_allocatable()
-                validate_request(
-                    list(rqt.devicesIDs),
-                    len(self.manager.list_physical_devices()),
-                    self.manager.config.sharing.strategy,
-                )
-                resp = pb.ContainerAllocateResponse()
-                seen_nodes = set()
-                for device_id in rqt.devicesIDs:
-                    for spec in self.manager.device_spec(device_id):
-                        # Multiple vtpus / sub-slices can map to the same
-                        # node; inject each node once.
-                        if spec.host_path in seen_nodes:
-                            continue
-                        seen_nodes.add(spec.host_path)
-                        resp.devices.append(
-                            pb.DeviceSpec(
-                                host_path=spec.host_path,
-                                container_path=spec.container_path,
-                                permissions=spec.permissions,
-                            )
-                        )
-                for d in self.manager.default_devices:
+            with trace.span("plugin.allocate",
+                            histogram="plugin.allocate",
+                            devices=len(rqt.devicesIDs)):
+                self._allocate_one(rqt, resps, context)
+        return resps
+
+    def _allocate_one(self, rqt, resps, context):
+        try:
+            self.manager.verify_allocatable()
+            validate_request(
+                list(rqt.devicesIDs),
+                len(self.manager.list_physical_devices()),
+                self.manager.config.sharing.strategy,
+            )
+            resp = pb.ContainerAllocateResponse()
+            seen_nodes = set()
+            for device_id in rqt.devicesIDs:
+                for spec in self.manager.device_spec(device_id):
+                    # Multiple vtpus / sub-slices can map to the same
+                    # node; inject each node once.
+                    if spec.host_path in seen_nodes:
+                        continue
+                    seen_nodes.add(spec.host_path)
                     resp.devices.append(
                         pb.DeviceSpec(
-                            host_path=d, container_path=d, permissions="mrw"
+                            host_path=spec.host_path,
+                            container_path=spec.container_path,
+                            permissions=spec.permissions,
                         )
                     )
-                for m in self.manager.mount_paths:
-                    resp.mounts.append(
-                        pb.Mount(
-                            host_path=m.host_path,
-                            container_path=m.container_path,
-                            read_only=m.read_only,
-                        )
+            for d in self.manager.default_devices:
+                resp.devices.append(
+                    pb.DeviceSpec(
+                        host_path=d, container_path=d, permissions="mrw"
                     )
-                for k, v in self.manager.envs(list(rqt.devicesIDs)).items():
-                    resp.envs[k] = v
-            except ValueError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
-            resps.container_responses.append(resp)
-        return resps
+                )
+            for m in self.manager.mount_paths:
+                resp.mounts.append(
+                    pb.Mount(
+                        host_path=m.host_path,
+                        container_path=m.container_path,
+                        read_only=m.read_only,
+                    )
+                )
+            for k, v in self.manager.envs(list(rqt.devicesIDs)).items():
+                resp.envs[k] = v
+        except ValueError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        resps.container_responses.append(resp)

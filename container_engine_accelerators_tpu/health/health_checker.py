@@ -53,6 +53,7 @@ import time
 from typing import Dict, Iterable, Optional, Set
 
 from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import histo, trace
 from container_engine_accelerators_tpu.tpulib.types import TpuErrorEvent, TpuLib
 from container_engine_accelerators_tpu.utils import faults
 from container_engine_accelerators_tpu.utils.device import (
@@ -99,6 +100,10 @@ class TpuHealthChecker:
         # is forever).
         self.recovery_window_s = recovery_window_s
         self._unhealthy_since: Dict[str, float] = {}
+        # First fault of the current Unhealthy episode (NOT re-stamped
+        # by repeat faults): the unhealthy→recovered latency histogram
+        # measures the whole outage, not just the final quiet window.
+        self._unhealthy_first: Dict[str, float] = {}
         self._recovered_at: Dict[str, float] = {}
         self._flaps: Dict[str, int] = {}
         self._mu = threading.Lock()
@@ -144,7 +149,16 @@ class TpuHealthChecker:
     def catch_error(self, event: TpuErrorEvent) -> None:
         """Decide which devices an event takes down
         (ref: health_checker.go:179-226).  Public so tests can feed
-        synthetic events, like the reference's catchError tests."""
+        synthetic events, like the reference's catchError tests.
+
+        The whole decision is one span (``health.event``, histogram of
+        the same name): event→unhealthy latency is the time from the
+        stream handing us the event to the transitions being queued."""
+        with trace.span("health.event", histogram="health.event",
+                        code=event.code, device=event.device):
+            self._catch_error(event)
+
+    def _catch_error(self, event: TpuErrorEvent) -> None:
         if event.code not in self.critical_codes:
             log.info(
                 "TPU error code %d is not critical; skipping (device=%s, %s)",
@@ -184,6 +198,7 @@ class TpuHealthChecker:
             # Re-stamp on EVERY critical event: a device that keeps
             # faulting keeps pushing its quiescence window out.
             self._unhealthy_since[name] = now
+            self._unhealthy_first.setdefault(name, now)
             recovered_at = self._recovered_at.pop(name, None)
             if recovered_at is not None and self.recovery_window_s:
                 window = self._window_for(name)
@@ -226,10 +241,11 @@ class TpuHealthChecker:
                 if now - since < window:
                     continue
                 del self._unhealthy_since[name]
+                first = self._unhealthy_first.pop(name, since)
                 self._recovered_at[name] = now
-                recovered.append((name, window))
+                recovered.append((name, window, now - first))
         announced = 0
-        for name, window in recovered:
+        for name, window, outage_s in recovered:
             if name not in self.manager.devices:
                 # Hotplug/repartition removed it while Unhealthy; there
                 # is nothing to re-announce.
@@ -240,6 +256,11 @@ class TpuHealthChecker:
                 "re-announcing Healthy", name, window,
             )
             counters.inc("health.recovered")
+            # Whole-episode outage latency (first fault → re-announce);
+            # the marker span correlates it with the rest of the trace.
+            histo.observe("health.recovery", outage_s)
+            trace.event("health.recover", device=name,
+                        outage_s=round(outage_s, 3), window_s=window)
             self.manager.health_events.put(Device(id=name, health=HEALTHY))
             announced += 1
         return announced

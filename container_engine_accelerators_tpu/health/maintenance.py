@@ -25,8 +25,10 @@ import logging
 import time
 from typing import Callable, List, Optional
 
+from container_engine_accelerators_tpu.obs import trace
 from container_engine_accelerators_tpu.scheduler.k8s import ApiException
 from container_engine_accelerators_tpu.tpulib.sysfs import write_event_file
+from container_engine_accelerators_tpu.utils import faults
 
 log = logging.getLogger(__name__)
 
@@ -74,7 +76,10 @@ def reconcile(
     ``patch_node_taints``), so each write carries the read's
     ``resourceVersion`` and retries on 409 Conflict: a taint added
     concurrently by another controller between our read and patch must
-    re-enter the list we send, not get silently wiped.
+    re-enter the list we send, not get silently wiped.  Fault site
+    ``k8s.patch`` fires before each patch; its ``conflict`` mode
+    (``k8s.patch:conflict@1``) exercises this exact retry loop from a
+    chaos spec.
 
     Returns the active maintenance event (None when clear).
     """
@@ -94,10 +99,13 @@ def reconcile(
                 # taint value and post a fresh event — consumers
                 # selecting on TERMINATE must see the escalation, not
                 # the stale first notice.
-                api.patch_node_taints(
-                    node_name, _with_taint(taints, event),
-                    resource_version=rv,
-                )
+                with trace.span("k8s.patch", histogram="k8s.patch",
+                                node=node_name, attempt=attempt):
+                    faults.check("k8s.patch")
+                    api.patch_node_taints(
+                        node_name, _with_taint(taints, event),
+                        resource_version=rv,
+                    )
                 write_event_file(
                     events_dir, MAINTENANCE_CODE, None,
                     f"host maintenance imminent: {event}",
@@ -107,12 +115,19 @@ def reconcile(
                     event, node_name, MAINTENANCE_CODE,
                 )
             elif not event and current is not None:
-                api.patch_node_taints(
-                    node_name, _without_taint(taints), resource_version=rv,
-                )
+                with trace.span("k8s.patch", histogram="k8s.patch",
+                                node=node_name, attempt=attempt):
+                    faults.check("k8s.patch")
+                    api.patch_node_taints(
+                        node_name, _without_taint(taints),
+                        resource_version=rv,
+                    )
                 log.info("maintenance cleared: untainted node %s", node_name)
-        except ApiException as e:
-            if e.status == 409 and attempt < _CONFLICT_RETRIES - 1:
+        except (ApiException, faults.FaultInjectedError) as e:
+            # An injected InjectedConflict carries status=409 just like
+            # a real stale-resourceVersion rejection; both retry here.
+            if getattr(e, "status", None) == 409 \
+                    and attempt < _CONFLICT_RETRIES - 1:
                 log.info("taint update conflicted (409); re-reading node")
                 continue
             raise

@@ -16,7 +16,17 @@ Exported gauges (container): duty_cycle, memory_total, memory_used, request
                              memory_used_tpu_node
            (agent):          agent_events{event=...} — the
                              self-healing counters from metrics/counters.py
-                             (retries, reconnects, health transitions)
+                             (retries, reconnects, health transitions);
+                             agent_latency{op=...,bucket=...} — the
+                             log2 latency histograms from obs/histo.py
+                             as cumulative ``le``-style buckets in
+                             microseconds (bucket="+Inf" = total count)
+
+``start`` retries a port conflict under a bounded backoff budget (a
+node agent racing its own previous incarnation's socket TIME_WAIT, or a
+stray scraper squatting the port, must not kill the DaemonSet pod), and
+``rebind`` moves a live server to a fresh port without restarting
+collection.
 """
 
 import logging
@@ -32,12 +42,21 @@ from container_engine_accelerators_tpu.metrics.devices import (
     PodResourcesClient,
     TPU_RESOURCE_NAME,
 )
+from container_engine_accelerators_tpu.obs import histo
 from container_engine_accelerators_tpu.tpulib.types import HbmInfo, TpuLib
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
 
 MAKE = "google"
 RESET_INTERVAL_S = 60.0  # metricsResetInterval analog
+
+# Rides out a previous incarnation's listener lingering through its
+# grace period (or TIME_WAIT on a SO_REUSEADDR-less kernel) without
+# masking a genuinely squatted port forever.
+BIND_RETRY = RetryPolicy(
+    max_attempts=6, initial_backoff_s=0.2, max_backoff_s=2.0, deadline_s=15.0
+)
 
 _CONTAINER_LABELS = [
     "namespace",
@@ -131,18 +150,67 @@ class MetricServer:
             "injected faults) keyed by metrics/counters.py name",
             ["event"],
         )
+        self.agent_latency = g(
+            "agent_latency",
+            "Log2-bucket latency histograms for node-agent operations "
+            "(obs/histo.py): bucket is a cumulative le upper bound in "
+            "microseconds; bucket=\"+Inf\" is the total observation count",
+            ["op", "bucket"],
+        )
+        self._httpd = None
+        self._http_thread = None
 
     # -- lifecycle -----------------------------------------------------------
 
-    def start(self) -> None:
-        start_http_server(self.port, registry=self.registry)
+    def _bind(self, retry: RetryPolicy) -> None:
+        """Bind the HTTP listener under a retry budget; OSError past the
+        budget propagates (a squatted port is a real outage — but it
+        costs the caller the budget, not a one-strike crash)."""
+
+        def attempt():
+            return start_http_server(self.port, registry=self.registry)
+
+        bound = retry.call(
+            attempt,
+            retry_on=(OSError,),
+            on_retry=lambda a, e: counters.inc("metrics.bind.retried"),
+        )
+        if isinstance(bound, tuple):  # prometheus_client >= 0.17
+            self._httpd, self._http_thread = bound
+            # port=0 means "any free port": reflect the real one so
+            # callers (and tests) can find the listener.
+            self.port = self._httpd.server_port
+
+    def start(self, retry: Optional[RetryPolicy] = None) -> None:
+        self._bind(retry or BIND_RETRY)
         t = threading.Thread(
             target=self._collect_loop, name="tpu-metrics", daemon=True
         )
         t.start()
 
+    def rebind(self, port: Optional[int] = None,
+               retry: Optional[RetryPolicy] = None) -> int:
+        """Move the listener to ``port`` (0 = any free port) without
+        restarting collection; returns the bound port.  The recovery
+        path for a port lost after boot — scraping resumes on the new
+        port, gauges and counters carry over untouched."""
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if port is not None:
+            self.port = port
+        self._bind(retry or BIND_RETRY)
+        counters.inc("metrics.rebind")
+        log.warning("metrics server re-bound to port %d", self.port)
+        return self.port
+
     def stop(self) -> None:
         self._stop.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
 
     def _collect_loop(self) -> None:
         while not self._stop.wait(self.collection_interval_s):
@@ -163,6 +231,7 @@ class MetricServer:
             self.memory_total_node,
             self.memory_used_node,
             self.agent_events,
+            self.agent_latency,
         ):
             gauge.clear()
 
@@ -219,6 +288,18 @@ class MetricServer:
         # them the way it drops vanished pods' series).
         for name, value in counters.snapshot().items():
             self.agent_events.labels(event=name).set(value)
+
+        # Latency histograms ride the same contract: cumulative process
+        # state, re-published wholesale.  Buckets are exported
+        # Prometheus-style (cumulative over ascending le bounds) so
+        # histogram_quantile-like math works on the scrape.
+        for op, h in histo.snapshot().items():
+            cumulative = 0
+            for le, count in sorted(h["buckets"].items(),
+                                    key=lambda kv: int(kv[0])):
+                cumulative += count
+                self.agent_latency.labels(op=op, bucket=le).set(cumulative)
+            self.agent_latency.labels(op=op, bucket="+Inf").set(h["count"])
 
         for chip in self.collector.devices():
             try:

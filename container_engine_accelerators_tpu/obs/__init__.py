@@ -1,0 +1,18 @@
+"""Node-agent observability: spans, latency histograms, flight recorder.
+
+Everything here is stdlib-only by contract — utils/, parallel/, and
+health/ sit on these modules, and they must stay importable in
+containers without prometheus_client or grpc (the MetricServer is the
+one that imports *us*, exporting histograms as ``agent_latency`` next
+to the ``agent_events`` counters).  tests/test_obs.py enforces the
+contract with a blocked-import subprocess.
+
+- ``obs.trace``  spans: trace/span ids, thread-local context, JSONL
+                 sink (``TPU_TRACE_FILE``) + in-memory ring buffer
+- ``obs.histo``  log2-bucket latency histograms with percentiles
+- ``obs.flight`` flight recorder: SIGUSR1 / terminal-failure dumps
+"""
+
+from container_engine_accelerators_tpu.obs import flight, histo, trace
+
+__all__ = ["flight", "histo", "trace"]

@@ -1,0 +1,103 @@
+"""Lock-protected log2-bucket latency histograms.
+
+Flat counters (metrics/counters.py) say *how often* the self-healing
+paths fire; these say *how long* they take — the difference between "40
+reconnects" and "40 reconnects, p99 3.1s" is the difference between a
+blip and a flapping link.  Durations are bucketed by the power of two
+of their microsecond value (``2^k us`` upper bounds), so the whole
+histogram is a handful of integers per op: cheap enough for the DCN
+hot path, exact enough for order-of-magnitude percentiles.
+
+The MetricServer exports the registry as the
+``agent_latency{op=...,bucket=...}`` gauge family (cumulative
+Prometheus-style ``le`` buckets in microseconds, plus ``+Inf`` = total
+count) next to ``agent_events``; ``snapshot()``/``percentile()`` serve
+in-process consumers (the flight recorder, bench p50/p99 reporting).
+
+Stdlib-only, like the rest of obs/: importable from utils/ and
+parallel/ without prometheus_client.
+"""
+
+import threading
+from typing import Dict, Iterable, List, Optional
+
+_lock = threading.Lock()
+
+
+class _Histo:
+    __slots__ = ("buckets", "count", "sum_s")
+
+    def __init__(self):
+        self.buckets: Dict[int, int] = {}  # exponent k -> count (le 2^k us)
+        self.count = 0
+        self.sum_s = 0.0
+
+
+_registry: Dict[str, _Histo] = {}
+
+
+def bucket_le_us(seconds: float) -> int:
+    """The log2 bucket a duration falls into: the smallest ``2^k``
+    microseconds >= the duration (sub-microsecond clamps to 1us)."""
+    us = int(seconds * 1e6)
+    if us <= 1:
+        return 1
+    return 1 << (us - 1).bit_length()
+
+
+def observe(op: str, seconds: float) -> None:
+    """Record one duration for ``op`` (created on first observation)."""
+    le = bucket_le_us(seconds)
+    exp = le.bit_length() - 1
+    with _lock:
+        h = _registry.get(op)
+        if h is None:
+            h = _registry[op] = _Histo()
+        h.buckets[exp] = h.buckets.get(exp, 0) + 1
+        h.count += 1
+        h.sum_s += seconds
+
+
+def snapshot() -> Dict[str, dict]:
+    """Point-in-time copy: ``{op: {count, sum_us, buckets{le_us: n}}}``
+    with non-cumulative per-bucket counts (the exporter accumulates)."""
+    with _lock:
+        return {
+            op: {
+                "count": h.count,
+                "sum_us": round(h.sum_s * 1e6, 1),
+                "buckets": {
+                    str(1 << exp): n
+                    for exp, n in sorted(h.buckets.items())
+                },
+            }
+            for op, h in _registry.items()
+        }
+
+
+def percentile(op: str, q: float) -> Optional[float]:
+    """Upper-bound estimate of the ``q``-quantile (0 < q <= 1) in
+    seconds: the bucket boundary at which the cumulative count reaches
+    ``q * count``.  None for an unknown/empty op."""
+    with _lock:
+        h = _registry.get(op)
+        if h is None or h.count == 0:
+            return None
+        target = q * h.count
+        seen = 0
+        for exp in sorted(h.buckets):
+            seen += h.buckets[exp]
+            if seen >= target:
+                return (1 << exp) / 1e6
+        return (1 << max(h.buckets)) / 1e6  # pragma: no cover — q <= 1
+
+
+def percentiles(op: str, qs: Iterable[float]) -> List[Optional[float]]:
+    return [percentile(op, q) for q in qs]
+
+
+def reset() -> None:
+    """Drop every histogram — test isolation only; production
+    histograms are cumulative for the agent's life, like counters."""
+    with _lock:
+        _registry.clear()

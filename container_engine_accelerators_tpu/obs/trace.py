@@ -1,0 +1,254 @@
+"""Dependency-free span tracing for the node agents.
+
+The self-healing layer made recovery *countable* (metrics/counters.py);
+this makes it *traceable*: where does a slow or flapping transfer spend
+its time?  A span records a named, monotonic-clocked interval with
+attributes, a trace/span id pair, and a parent link taken from
+thread-local context, so the DCN client's reconnect, the flow replay it
+triggers, and the retried op that rode it all hang off one trace.
+
+Spans land in two places:
+
+- an in-memory **ring buffer** (always on, bounded) — the flight
+  recorder (obs/flight.py) dumps its tail on SIGUSR1 or terminal
+  failure;
+- a **JSONL sink** when ``TPU_TRACE_FILE`` names a path — one JSON
+  object per completed span, summarized offline by
+  ``cmd/agent_trace.py`` the way ``cmd/trace_summary.py`` digests XLA
+  xplanes.
+
+JSONL schema (one line per span)::
+
+    {"trace": "9f2c…", "span": "a1b2…", "parent": "c3d4…"|null,
+     "name": "dcn.send", "ts": 1722650000.123, "dur_us": 152.4,
+     "status": "ok"|"error", "thread": "MainThread", "attrs": {...}}
+
+``ts`` is wall-clock (correlation with logs/Prometheus scrapes);
+``dur_us`` comes from the monotonic clock (immune to NTP steps).
+
+Kept stdlib-only, like metrics/counters.py, so utils/ and parallel/
+import it without dragging in prometheus_client or grpc.  A sink write
+failure is logged once and disables the sink — tracing must never take
+down a node agent.
+"""
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+TRACE_FILE_ENV = "TPU_TRACE_FILE"
+RING_CAPACITY_ENV = "TPU_TRACE_RING"
+DEFAULT_RING_CAPACITY = 512
+
+
+class Span:
+    """One named interval.  Mutable while active (annotate()); frozen
+    into a dict when it finishes."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "attrs",
+        "status", "ts", "_t0", "duration_s", "thread",
+    )
+
+    def __init__(self, name: str, trace_id: str, span_id: str,
+                 parent_id: Optional[str], attrs: Dict[str, Any]):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.status = "ok"
+        self.ts = time.time()
+        self._t0 = time.monotonic()
+        self.duration_s: float = 0.0
+        self.thread = threading.current_thread().name
+
+    def annotate(self, **attrs: Any) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "trace": self.trace_id,
+            "span": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "ts": round(self.ts, 6),
+            "dur_us": round(self.duration_s * 1e6, 1),
+            "status": self.status,
+            "thread": self.thread,
+            "attrs": self.attrs,
+        }
+
+
+def _env_int(name: str, default: int) -> int:
+    """A malformed tuning knob degrades to the default — config typos
+    must never take a node agent down (the TPU_FAULT_SPEC rule)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        log.error("ignoring malformed %s=%r; using %d", name, raw, default)
+        return default
+
+
+_local = threading.local()  # .stack: List[Span] per thread
+_lock = threading.Lock()  # ring + sink
+_ring: "deque[Dict[str, Any]]" = deque(
+    maxlen=_env_int(RING_CAPACITY_ENV, DEFAULT_RING_CAPACITY)
+)
+# Sink states: None = unresolved (consult env on next span), False =
+# resolved-off, file object = resolved-on.
+_sink = None
+_sink_path: Optional[str] = None
+
+
+def _new_id(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+def _stack() -> List[Span]:
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    return stack
+
+
+def current() -> Optional[Span]:
+    """The active span on this thread, or None."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def annotate(**attrs: Any) -> None:
+    """Attach attributes to the active span; no-op without one (so
+    instrumented leaf code never needs to know whether a caller
+    traced it — faults.py uses this to stamp ``fault=<site>``)."""
+    span = current()
+    if span is not None:
+        span.annotate(**attrs)
+
+
+def _resolve_sink():
+    """Open the JSONL sink from TPU_TRACE_FILE (lazily, once)."""
+    global _sink, _sink_path
+    if _sink is None:
+        path = _sink_path or os.environ.get(TRACE_FILE_ENV)
+        if not path:
+            _sink = False
+        else:
+            try:
+                _sink = open(path, "a", buffering=1)
+                _sink_path = path
+            except OSError as e:
+                log.error("cannot open trace sink %s: %s; tracing to "
+                          "ring buffer only", path, e)
+                _sink = False
+    return _sink
+
+
+def _record(span: Span) -> None:
+    d = span.to_dict()
+    global _sink
+    with _lock:
+        _ring.append(d)
+        sink = _resolve_sink()
+        if sink:
+            try:
+                sink.write(json.dumps(d) + "\n")
+            except (OSError, ValueError) as e:  # ValueError: closed file
+                log.error("trace sink write failed: %s; disabling sink", e)
+                _sink = False
+
+
+@contextlib.contextmanager
+def span(name: str, histogram: Optional[str] = None, **attrs: Any):
+    """Open a span; it closes (and records) when the block exits.
+
+    ``histogram=<op>`` additionally feeds the span's duration into
+    ``obs.histo`` under that op — one call site, both surfaces.  An
+    exception marks the span ``status="error"`` (with the repr in
+    ``attrs.error``) and propagates untouched.
+    """
+    parent = current()
+    s = Span(
+        name,
+        trace_id=parent.trace_id if parent else _new_id(8),
+        span_id=_new_id(4),
+        parent_id=parent.span_id if parent else None,
+        attrs=dict(attrs),
+    )
+    stack = _stack()
+    stack.append(s)
+    try:
+        yield s
+    except BaseException as e:
+        s.status = "error"
+        s.attrs.setdefault("error", repr(e))
+        raise
+    finally:
+        s.duration_s = time.monotonic() - s._t0
+        stack.pop()
+        _record(s)
+        if histogram is not None:
+            from container_engine_accelerators_tpu.obs import histo
+
+            histo.observe(histogram, s.duration_s)
+
+
+def event(name: str, **attrs: Any) -> None:
+    """A zero-duration marker span (a point in the timeline — health
+    transitions, announcements)."""
+    with span(name, **attrs):
+        pass
+
+
+def tail(n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """The last ``n`` completed spans (all buffered ones when None),
+    oldest first — what the flight recorder dumps."""
+    with _lock:
+        spans = list(_ring)
+    return spans if n is None else spans[-n:]
+
+
+def configure(path: Optional[str] = None,
+              ring_capacity: Optional[int] = None) -> None:
+    """Point the sink at ``path`` (None ⇒ re-resolve from env on next
+    span) and optionally resize the ring.  Tests and long-lived agents
+    rotating their trace file use this; plain processes just set
+    ``TPU_TRACE_FILE`` before the first span."""
+    global _sink, _sink_path, _ring
+    with _lock:
+        if _sink:
+            try:
+                _sink.close()
+            except OSError:
+                pass
+        _sink = None
+        _sink_path = path
+        if ring_capacity is not None:
+            _ring = deque(_ring, maxlen=ring_capacity)
+
+
+def reset() -> None:
+    """Drop buffered spans and forget the resolved sink (test
+    isolation; the next span re-reads TPU_TRACE_FILE)."""
+    global _sink, _sink_path
+    with _lock:
+        _ring.clear()
+        if _sink:
+            try:
+                _sink.close()
+            except OSError:
+                pass
+        _sink = None
+        _sink_path = None

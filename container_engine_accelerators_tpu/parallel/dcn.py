@@ -163,24 +163,41 @@ def exchange_shard(
     empty and the rx wait times out; callers retry the whole leg
     (restaging transparently is a ROADMAP open item).
     """
+    from container_engine_accelerators_tpu.obs import trace
     from container_engine_accelerators_tpu.parallel.dcn_client import (
         DcnXferError,
     )
 
     nbytes = len(data)
     try:
-        # Registration inside the try: if the SECOND register fails
-        # (max_flows, name collision) the finally still releases the
-        # first instead of leaking it into every retry of the leg.
-        client.register_flow(local_flow, peer=peer_host, bytes=nbytes)
-        client.register_flow(peer_flow, bytes=nbytes)
-        if barrier is not None:
-            barrier()
-        client.put(local_flow, data)
-        wait_flow_rx(client, local_flow, nbytes, timeout_s)
-        client.send(local_flow, peer_host, peer_port, nbytes)
-        wait_flow_rx(client, peer_flow, nbytes, timeout_s)
-        return client.read(peer_flow, nbytes)
+        # One span per leg, one child span per phase: a slow exchange
+        # decomposes into register / barrier / stage / send / land /
+        # read in the trace instead of a single opaque wall-clock.
+        with trace.span("dcn.exchange", histogram="dcn.exchange",
+                        local_flow=local_flow, peer_flow=peer_flow,
+                        bytes=nbytes, peer=peer_host):
+            # Registration inside the try: if the SECOND register fails
+            # (max_flows, name collision) the finally still releases the
+            # first instead of leaking it into every retry of the leg.
+            with trace.span("dcn.exchange.register"):
+                client.register_flow(local_flow, peer=peer_host,
+                                     bytes=nbytes)
+                client.register_flow(peer_flow, bytes=nbytes)
+            if barrier is not None:
+                with trace.span("dcn.exchange.barrier",
+                                histogram="dcn.exchange.barrier"):
+                    barrier()
+            with trace.span("dcn.exchange.stage",
+                            histogram="dcn.exchange.stage"):
+                client.put(local_flow, data)
+                wait_flow_rx(client, local_flow, nbytes, timeout_s)
+            with trace.span("dcn.exchange.send",
+                            histogram="dcn.exchange.send"):
+                client.send(local_flow, peer_host, peer_port, nbytes)
+            with trace.span("dcn.exchange.land",
+                            histogram="dcn.exchange.land"):
+                wait_flow_rx(client, peer_flow, nbytes, timeout_s)
+            return client.read(peer_flow, nbytes)
     finally:
         # Release both flows so repeated legs on a long-lived client
         # neither hit the daemon's duplicate-flow rejection nor leak

@@ -23,6 +23,7 @@ import struct
 from typing import Dict, Optional
 
 from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import flight, trace
 from container_engine_accelerators_tpu.utils import faults
 from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
@@ -48,14 +49,16 @@ class DcnXferClient:
     def _connect(self) -> None:
         """(Re)establish the control connection.  Fault site
         ``dcn.connect`` fires here, before the real connect."""
-        faults.check("dcn.connect")
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.settimeout(self._timeout_s)
-        try:
-            sock.connect(f"{self._uds_dir}/{SOCKET_NAME}")
-        except OSError:
-            sock.close()
-            raise
+        with trace.span("dcn.connect", histogram="dcn.connect",
+                        uds=self._uds_dir):
+            faults.check("dcn.connect")
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.settimeout(self._timeout_s)
+            try:
+                sock.connect(f"{self._uds_dir}/{SOCKET_NAME}")
+            except OSError:
+                sock.close()
+                raise
         self._sock = sock
         self._rfile = sock.makefile("r")
         self._broken = False
@@ -75,26 +78,29 @@ class DcnXferClient:
         self.close()
 
     def _call(self, **req) -> dict:
-        if self._broken:
-            raise DcnXferError(
-                "connection broken by earlier timeout; reconnect"
-            )
-        try:
-            faults.check("dcn.send")
-            self._sock.sendall((json.dumps(req) + "\n").encode())
-            line = self._rfile.readline()
-        except (socket.timeout, OSError) as e:
-            # After a timeout the buffered reader may hold a partial line;
-            # any retry would consume a stale response.  Poison the client.
-            self._broken = True
-            raise DcnXferError(f"daemon connection failed: {e}")
-        if not line:
-            self._broken = True
-            raise DcnXferError("daemon closed the connection")
-        resp = json.loads(line)
-        if not resp.get("ok"):
-            raise DcnXferError(resp.get("error", "unknown daemon error"))
-        return resp
+        with trace.span("dcn.send", histogram="dcn.send",
+                        op=req.get("op")):
+            if self._broken:
+                raise DcnXferError(
+                    "connection broken by earlier timeout; reconnect"
+                )
+            try:
+                faults.check("dcn.send")
+                self._sock.sendall((json.dumps(req) + "\n").encode())
+                line = self._rfile.readline()
+            except (socket.timeout, OSError) as e:
+                # After a timeout the buffered reader may hold a partial
+                # line; any retry would consume a stale response.  Poison
+                # the client.
+                self._broken = True
+                raise DcnXferError(f"daemon connection failed: {e}")
+            if not line:
+                self._broken = True
+                raise DcnXferError("daemon closed the connection")
+            resp = json.loads(line)
+            if not resp.get("ok"):
+                raise DcnXferError(resp.get("error", "unknown daemon error"))
+            return resp
 
     # ---- operations --------------------------------------------------------
 
@@ -145,25 +151,29 @@ class DcnXferClient:
         completed frame's length (``frame_bytes`` in each response), so
         a read past the staged payload returns short rather than stale
         buffer tail."""
-        out = bytearray()
-        while len(out) < nbytes:
-            chunk = min(nbytes - len(out), self.READ_CHUNK)
-            resp = self._call(op="read", flow=flow, bytes=chunk,
-                              offset=offset + len(out))
-            data = base64.b64decode(resp["data"])
-            if not data:
-                break
-            out.extend(data)
-            if len(data) < chunk:
-                break  # clamped at the staged frame's end
-            frame = int(resp.get("frame_bytes", 0))
-            if frame and offset + len(out) >= frame:
-                # Exactly at the frame boundary: the next chunk's offset
-                # would be rejected by the daemon, so stop here (a frame
-                # that is an exact multiple of READ_CHUNK otherwise
-                # turns a legitimate short read into an error).
-                break
-        return bytes(out)
+        with trace.span("dcn.read", histogram="dcn.read", flow=flow,
+                        bytes=nbytes) as s:
+            out = bytearray()
+            while len(out) < nbytes:
+                chunk = min(nbytes - len(out), self.READ_CHUNK)
+                resp = self._call(op="read", flow=flow, bytes=chunk,
+                                  offset=offset + len(out))
+                data = base64.b64decode(resp["data"])
+                if not data:
+                    break
+                out.extend(data)
+                if len(data) < chunk:
+                    break  # clamped at the staged frame's end
+                frame = int(resp.get("frame_bytes", 0))
+                if frame and offset + len(out) >= frame:
+                    # Exactly at the frame boundary: the next chunk's
+                    # offset would be rejected by the daemon, so stop here
+                    # (a frame that is an exact multiple of READ_CHUNK
+                    # otherwise turns a legitimate short read into an
+                    # error).
+                    break
+            s.annotate(read=len(out))
+            return bytes(out)
 
     def put(self, flow: str, data: bytes, host: str = "127.0.0.1",
             port: Optional[int] = None) -> None:
@@ -248,57 +258,73 @@ class ResilientDcnXferClient(DcnXferClient):
     # -- reconnect machinery -------------------------------------------------
 
     def _reconnect_and_replay(self) -> None:
-        try:
-            self.close()
-        except OSError:  # a half-dead socket may refuse even close()
-            pass
-        counters.inc("dcn.reconnect.attempts")
-        self._connect()  # OSError propagates to the retry loop
-        counters.inc("dcn.reconnect.success")
-        for flow, kw in list(self._flows.items()):
+        with trace.span("dcn.replay", histogram="dcn.replay",
+                        flows=len(self._flows)):
             try:
-                DcnXferClient._call(
-                    self, op="register_flow", flow=flow, **kw
-                )
-                counters.inc("dcn.replayed_flows")
-            except DcnXferError as e:
-                if self._broken:
-                    raise  # transport died again: retry loop handles it
-                if "exist" in str(e).lower():
-                    # An alive-but-slow daemon may not have processed the
-                    # old connection's EOF yet, so our own previous
-                    # registration still holds the name.  Mark broken and
-                    # surface as transport-level: the outer retry's
-                    # backoff gives the daemon time to release it.
-                    self._broken = True
-                    raise DcnXferError(
-                        f"flow replay raced old-connection cleanup: {e}"
+                self.close()
+            except OSError:  # a half-dead socket may refuse even close()
+                pass
+            counters.inc("dcn.reconnect.attempts")
+            self._connect()  # OSError propagates to the retry loop
+            counters.inc("dcn.reconnect.success")
+            for flow, kw in list(self._flows.items()):
+                try:
+                    DcnXferClient._call(
+                        self, op="register_flow", flow=flow, **kw
                     )
-                # Other daemon-level rejection (e.g. another client took
-                # the name): keep replaying the rest; ops on this flow
-                # will surface the daemon's own error.
-                log.error("replay of flow %r failed: %s", flow, e)
+                    counters.inc("dcn.replayed_flows")
+                except DcnXferError as e:
+                    if self._broken:
+                        raise  # transport died again: retry loop handles it
+                    if "exist" in str(e).lower():
+                        # An alive-but-slow daemon may not have processed
+                        # the old connection's EOF yet, so our own previous
+                        # registration still holds the name.  Mark broken
+                        # and surface as transport-level: the outer retry's
+                        # backoff gives the daemon time to release it.
+                        self._broken = True
+                        raise DcnXferError(
+                            f"flow replay raced old-connection cleanup: {e}"
+                        )
+                    # Other daemon-level rejection (e.g. another client
+                    # took the name): keep replaying the rest; ops on this
+                    # flow will surface the daemon's own error.
+                    log.error("replay of flow %r failed: %s", flow, e)
         log.warning(
             "dcn control connection re-established; %d flow(s) replayed",
             len(self._flows),
         )
 
-    def _with_budget(self, attempt, what: str, latch: bool):
+    def _with_budget(self, attempt, what: str, latch: bool,
+                     op: Optional[str] = None):
         """Run ``attempt`` under the retry budget; daemon-level errors
         (ok:false with an intact transport) fail fast, transport loss
         retries.  ``latch=True`` turns the client terminal on
         exhaustion; the data plane passes False so a data-port-only
-        outage cannot poison still-healthy control-plane ops."""
+        outage cannot poison still-healthy control-plane ops.
+
+        The whole budget runs inside ONE ``dcn.op`` span, so every
+        attempt's send/connect/replay span hangs off the same trace —
+        a recovered op reads as one story in the JSONL, not as
+        disconnected fragments."""
         if self._exhausted:
             raise DcnXferError(
                 "dcn retry budget exhausted; client is terminal "
                 "(daemon stayed unreachable through "
                 f"{self._retry.max_attempts} attempts)"
             )
+        with trace.span("dcn.op", target=what, op=op) as span:
+            return self._budget_loop(attempt, what, latch, span)
+
+    def _budget_loop(self, attempt, what: str, latch: bool, span):
         last: Optional[BaseException] = None
+        attempts = 0
         for _attempt in self._retry.attempts():
+            attempts = _attempt + 1
             try:
-                return attempt()
+                result = attempt()
+                span.annotate(attempts=attempts)
+                return result
             except DcnXferError as e:
                 if not self._broken or self._exhausted:
                     # Daemon-level error, or a nested control-plane call
@@ -309,9 +335,14 @@ class ResilientDcnXferClient(DcnXferClient):
                 last = e  # transport loss: reconnect on the next attempt
             except OSError as e:  # reconnect/data-plane connect failed
                 last = e
+        span.annotate(attempts=attempts)
         if latch:
             self._exhausted = True
         counters.inc("dcn.retry.exhausted")
+        if latch:
+            # The client just went terminal: capture the evidence while
+            # it still exists (the pod is usually deleted minutes later).
+            flight.on_terminal(f"dcn {what} client latched terminal")
         raise DcnXferError(
             f"dcn {what} unreachable after "
             f"{self._retry.max_attempts} attempts: {last}"
@@ -323,7 +354,8 @@ class ResilientDcnXferClient(DcnXferClient):
                 self._reconnect_and_replay()
             return DcnXferClient._call(self, **req)
 
-        return self._with_budget(attempt, "transfer daemon", latch=True)
+        return self._with_budget(attempt, "transfer daemon", latch=True,
+                                 op=req.get("op"))
 
     # -- flow-table bookkeeping ----------------------------------------------
 
@@ -356,5 +388,6 @@ class ResilientDcnXferClient(DcnXferClient):
                 state["port"] = None
                 raise
 
-        return self._with_budget(attempt, "data plane", latch=False)
+        return self._with_budget(attempt, "data plane", latch=False,
+                                 op="put")
 

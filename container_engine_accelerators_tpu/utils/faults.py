@@ -15,6 +15,7 @@ Sites wired today:
     health.stream     the health checker's event-wait loop
     kubelet.register  device-plugin Register RPC against the kubelet
     checkpoint.save   TrainCheckpointer.save
+    k8s.patch         maintenance watcher's node-taint patch
 
 Spec grammar (``;`` or ``,`` separated)::
 
@@ -26,9 +27,18 @@ Spec grammar (``;`` or ``,`` separated)::
 
 Modes: ``fail`` raises FaultInjectedError, ``drop`` raises
 InjectedConnectionDrop — both are OSError subclasses, so the existing
-socket/except paths treat them exactly like the real failure.  A
-malformed entry is logged and skipped; a bad spec must never take down
-a node agent (the whole point is surviving bad days).
+socket/except paths treat them exactly like the real failure.
+``conflict`` raises InjectedConflict, which carries ``status = 409``
+so call sites that retry on HTTP 409 Conflict (the maintenance
+watcher's read-modify-write taint patch) exercise their retry loop
+against the injected fault exactly as against a real stale
+``resourceVersion``.  A malformed entry is logged and skipped; a bad
+spec must never take down a node agent (the whole point is surviving
+bad days).
+
+When a site fires inside an active trace span the span is annotated
+``fault=<site>`` (obs/trace.py), so a chaos run's JSONL shows exactly
+which attempt the injection killed.
 """
 
 import contextlib
@@ -39,6 +49,7 @@ import threading
 from typing import Dict, List, Optional
 
 from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import trace
 
 log = logging.getLogger(__name__)
 
@@ -53,7 +64,18 @@ class InjectedConnectionDrop(FaultInjectedError):
     """An armed fault site fired emulating the peer dropping the link."""
 
 
-_MODES = {"fail": FaultInjectedError, "drop": InjectedConnectionDrop}
+class InjectedConflict(FaultInjectedError):
+    """An armed fault site fired emulating an HTTP 409 Conflict (the
+    ``status`` attribute is what 409-retry loops key on)."""
+
+    status = 409
+
+
+_MODES = {
+    "fail": FaultInjectedError,
+    "drop": InjectedConnectionDrop,
+    "conflict": InjectedConflict,
+}
 FOREVER = -1
 
 
@@ -143,6 +165,9 @@ class FaultInjector:
                 return
             self._fired[site] = self._fired.get(site, 0) + 1
         counters.inc(f"fault.fired.{site}")
+        # Stamp the active span (if the hit happened inside one): a
+        # chaos trace then shows which attempt the injection killed.
+        trace.annotate(fault=site, fault_mode=rule.mode)
         log.warning("fault injection: %s %s at hit %d", site, rule.mode, hit)
         raise _MODES[rule.mode](
             f"injected {rule.mode} at fault site {site!r} (hit {hit})"

@@ -26,6 +26,7 @@ import time
 from typing import Callable, Iterator, Optional, Tuple, Type
 
 from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import flight, trace
 
 log = logging.getLogger(__name__)
 
@@ -102,17 +103,23 @@ class RetryPolicy:
         **kwargs,
     ):
         """Run ``fn`` under this policy; re-raises the last error once
-        the budget is exhausted."""
+        the budget is exhausted.  Each attempt gets its own span
+        (``retry.attempt`` with fn/attempt attrs), so a trace of a slow
+        recovery shows every try and every failure, not one opaque
+        blob."""
+        name = getattr(fn, "__name__", str(fn))
         last: Optional[BaseException] = None
         for attempt in self.attempts(sleep=sleep):
             try:
-                return fn(*args, **kwargs)
+                with trace.span("retry.attempt", fn=name, attempt=attempt):
+                    return fn(*args, **kwargs)
             except retry_on as e:  # noqa: PERF203 — the loop IS the feature
                 last = e
                 if on_retry is not None:
                     on_retry(attempt, e)
                 log.warning("attempt %d/%d of %s failed: %s", attempt + 1,
-                            self.max_attempts, getattr(fn, "__name__", fn), e)
+                            self.max_attempts, name, e)
         counters.inc("retry.exhausted")
+        flight.on_terminal(f"retry budget exhausted: {name}")
         assert last is not None
         raise last

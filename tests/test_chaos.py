@@ -17,6 +17,7 @@ accounting therefore arm a private injector via ``faults.armed`` rather
 than reading the process env.
 """
 
+import json
 import os
 import queue
 import signal
@@ -27,6 +28,7 @@ import time
 import pytest
 
 from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import flight, histo, trace
 from container_engine_accelerators_tpu.parallel import dcn
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnXferClient,
@@ -722,6 +724,123 @@ class TestHealthRecoveryChaos:
                 assert (e.id, e.health) == ("accel0", UNHEALTHY)
             finally:
                 hc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Observability of chaos: traces, fault annotations, flight recorder
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestChaosObservability:
+    def test_daemon_kill_trace_covers_connect_fault_reconnect_replay(
+            self, xstub, tmp_path, monkeypatch):
+        """The ISSUE's acceptance bar: a daemon-kill chaos run with
+        TPU_TRACE_FILE set leaves a parseable JSONL whose spans tell
+        the whole story — connect, the injected fault, the reconnect,
+        the flow replay — and the replay hangs off the same trace as
+        the op that triggered it."""
+        path = str(tmp_path / "chaos-trace.jsonl")
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, path)
+        trace.reset()  # pick up the env, as a fresh agent process would
+        try:
+            with faults.armed("dcn.send:fail@3"):
+                with ResilientDcnXferClient(xstub.uds_dir,
+                                            retry=FAST_RETRY) as c:
+                    c.register_flow("f0", bytes=4096)
+                    # Injected fault on this op's send -> reconnect +
+                    # replay of f0 -> retried op lands.
+                    assert c.record_transfer("f0", 64) == 64
+                    # Then a REAL daemon kill/restart mid-flow.
+                    xstub.stop(crash=True)
+                    xstub.start()
+                    assert c.record_transfer("f0", 64) == 64
+        finally:
+            trace.reset()  # close the sink before reading it
+
+        spans = [json.loads(line) for line in open(path)]  # parseable
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        assert {"dcn.connect", "dcn.send", "dcn.replay"} <= set(by_name)
+        # The injected fault is stamped on the span it killed.
+        faulted = [s for s in spans
+                   if (s.get("attrs") or {}).get("fault") == "dcn.send"]
+        assert faulted and faulted[0]["status"] == "error"
+        # Replay is a child of the reconnect machinery on the SAME
+        # trace as the faulted op (one story, not three fragments), and
+        # wraps a fresh connect.
+        replays = by_name["dcn.replay"]
+        assert any(r["trace"] == faulted[0]["trace"] for r in replays)
+        replay_ids = {r["span"] for r in replays}
+        assert any(s["parent"] in replay_ids
+                   for s in by_name["dcn.connect"])
+        # Latency histograms populated for the hot path ops the
+        # MetricServer will export (export itself: test_metrics.py).
+        snap = histo.snapshot()
+        assert snap["dcn.send"]["count"] > 0
+        assert snap["dcn.replay"]["count"] > 0
+
+    def test_terminal_failure_emits_flight_record(self, xstub, tmp_path,
+                                                  monkeypatch):
+        """A resilient client latching terminal must leave the evidence
+        behind: one JSON blob with the last spans and the counter
+        snapshot (the ISSUE's flight-recorder bar)."""
+        path = str(tmp_path / "flight.jsonl")
+        monkeypatch.setenv(flight.FLIGHT_FILE_ENV, path)
+        tiny = RetryPolicy(max_attempts=2, initial_backoff_s=0.01,
+                           max_backoff_s=0.02)
+        c = ResilientDcnXferClient(xstub.uds_dir, retry=tiny)
+        c.register_flow("f0", bytes=4096)
+        xstub.stop(crash=True)
+        with pytest.raises(DcnXferError, match="unreachable"):
+            c.ping()
+        blobs = [json.loads(line) for line in open(path)]
+        terminal = [b for b in blobs if "latched terminal" in b["reason"]]
+        assert terminal, [b["reason"] for b in blobs]
+        blob = terminal[-1]
+        assert blob["spans"], "flight dump carried no spans"
+        assert "counters" in blob and "histograms" in blob
+        assert blob["counters"].get("dcn.retry.exhausted", 0) >= 1
+
+    def test_k8s_patch_conflict_chaos_rides_409_retry(self, tmp_path):
+        """Satellite: `k8s.patch:conflict@1` injects a 409 into the
+        maintenance watcher's taint patch; the read-modify-write loop
+        must re-read and converge, zero manual intervention."""
+        from container_engine_accelerators_tpu.health import (
+            maintenance as mw,
+        )
+        from tests.test_maintenance import FakeApi, fetcher
+
+        api = FakeApi()
+        with faults.armed("k8s.patch:conflict@1") as inj:
+            got = mw.reconcile(
+                api, "n0", fetcher("TERMINATE_ON_HOST_MAINTENANCE"),
+                events_dir=str(tmp_path / "events"),
+            )
+        assert got == "TERMINATE_ON_HOST_MAINTENANCE"
+        assert inj.fired("k8s.patch") == 1
+        (taints,) = api.patches  # the retry landed exactly one patch
+        assert taints[0]["value"] == "TERMINATE_ON_HOST_MAINTENANCE"
+        assert counters.get("fault.fired.k8s.patch") >= 1
+
+    def test_k8s_patch_hard_failure_still_propagates(self, tmp_path):
+        """A non-conflict injected failure must NOT be eaten by the 409
+        loop — run_forever's outer catch owns it, like any real API
+        outage."""
+        from container_engine_accelerators_tpu.health import (
+            maintenance as mw,
+        )
+        from tests.test_maintenance import FakeApi, fetcher
+
+        api = FakeApi()
+        with faults.armed("k8s.patch:fail@1"):
+            with pytest.raises(faults.FaultInjectedError):
+                mw.reconcile(
+                    api, "n0", fetcher("TERMINATE_ON_HOST_MAINTENANCE"),
+                    events_dir=str(tmp_path / "events"),
+                )
+        assert api.patches == []  # nothing half-applied
 
 
 # ---------------------------------------------------------------------------

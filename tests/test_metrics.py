@@ -7,15 +7,21 @@ assertions read Prometheus gauge values from the registry.
 
 import concurrent.futures
 import os
+import socket
+import threading
+import urllib.request
 
 import grpc
 import pytest
 from prometheus_client import CollectorRegistry
 
+from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.metrics import podresources_v1_pb2 as pb
 from container_engine_accelerators_tpu.metrics.devices import PodResourcesClient
 from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+from container_engine_accelerators_tpu.obs import histo
 from container_engine_accelerators_tpu.tpulib.types import HbmInfo
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
 GIB = 2**30
 
@@ -169,6 +175,156 @@ def test_collect_survives_pod_resources_outage(tmp_path):
     server.collect_once()  # must not raise; node gauges still exported
     node_labels = {"make": "google", "accelerator_id": "accel0", "model": "tpu-v5e"}
     assert registry.get_sample_value("duty_cycle_tpu_node", node_labels) == 50
+
+
+# ---------------------------------------------------------------------------
+# agent_latency export (obs/histo.py -> Prometheus)
+# ---------------------------------------------------------------------------
+
+
+def test_agent_latency_histograms_exported(tmp_path):
+    histo.reset()
+    registry = CollectorRegistry()
+    server = MetricServer(
+        collector=MockCollector({}),
+        registry=registry,
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+    )
+    histo.observe("dcn.send", 0.001)   # 1000us -> le 1024
+    histo.observe("dcn.send", 0.0005)  # 500us  -> le 512
+    histo.observe("dcn.send", 0.1)     # 100ms  -> le 131072
+    server.collect_once()
+
+    sample = lambda b: registry.get_sample_value(  # noqa: E731
+        "agent_latency", {"op": "dcn.send", "bucket": b}
+    )
+    # Buckets are cumulative, Prometheus-style.
+    assert sample("512") == 1
+    assert sample("1024") == 2
+    assert sample("131072") == 3
+    assert sample("+Inf") == 3
+    # Cumulative process state survives the periodic registry reset
+    # exactly like agent_events.
+    server._last_reset -= 2 * 60
+    server.collect_once()
+    assert sample("+Inf") == 3
+
+
+# ---------------------------------------------------------------------------
+# end-to-end scrape: counters -> MetricServer -> HTTP
+# ---------------------------------------------------------------------------
+
+FAST_BIND = RetryPolicy(max_attempts=8, initial_backoff_s=0.05,
+                        max_backoff_s=0.2, deadline_s=10.0)
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        return resp.read().decode()
+
+
+def test_agent_events_end_to_end_scrape(tmp_path):
+    """The satellite's bar: bump counters, scrape the real HTTP
+    endpoint, and prove the periodic `_reset` does not lose them."""
+    counters.inc("e2e.scrape.marker", 5)
+    server = MetricServer(
+        collector=MockCollector({}),
+        registry=CollectorRegistry(),
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+        port=0,  # any free port; server.port reflects the real one
+        collection_interval_s=3600,  # collect_once drives the test
+    )
+    server.start(retry=FAST_BIND)
+    try:
+        server.collect_once()
+        body = _scrape(server.port)
+        assert 'agent_events{event="e2e.scrape.marker"} 5.0' in body
+
+        counters.inc("e2e.scrape.marker", 2)
+        server._last_reset -= 2 * 60  # force the periodic registry reset
+        server.collect_once()
+        body = _scrape(server.port)
+        assert 'agent_events{event="e2e.scrape.marker"} 7.0' in body
+    finally:
+        server.stop()
+
+
+def test_port_conflict_at_boot_is_retried(tmp_path):
+    """ROADMAP satellite: a squatted port at boot must cost backoff
+    rounds, not the DaemonSet pod — the server comes up as soon as the
+    squatter lets go."""
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("0.0.0.0", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+
+    before = counters.get("metrics.bind.retried")
+    server = MetricServer(
+        collector=MockCollector({}),
+        registry=CollectorRegistry(),
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+        port=port,
+        collection_interval_s=3600,
+    )
+    release = threading.Timer(0.3, blocker.close)
+    release.start()
+    try:
+        server.start(retry=FAST_BIND)  # blocks through the conflict
+        assert server.port == port
+        assert counters.get("metrics.bind.retried") > before
+        server.collect_once()
+        assert "duty_cycle" in _scrape(port)
+    finally:
+        release.cancel()
+        server.stop()
+
+
+def test_port_conflict_exhausting_budget_raises(tmp_path):
+    blocker = socket.socket()
+    blocker.bind(("0.0.0.0", 0))
+    blocker.listen(1)
+    try:
+        server = MetricServer(
+            collector=MockCollector({}),
+            registry=CollectorRegistry(),
+            pod_resources_socket=str(tmp_path / "missing.sock"),
+            port=blocker.getsockname()[1],
+        )
+        tiny = RetryPolicy(max_attempts=2, initial_backoff_s=0.01,
+                           max_backoff_s=0.02)
+        with pytest.raises(OSError):
+            server.start(retry=tiny)
+    finally:
+        blocker.close()
+
+
+def test_rebind_moves_listener_without_losing_state(tmp_path):
+    counters.inc("rebind.marker", 3)
+    server = MetricServer(
+        collector=MockCollector({}),
+        registry=CollectorRegistry(),
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+        port=0,
+        collection_interval_s=3600,
+    )
+    server.start(retry=FAST_BIND)
+    try:
+        server.collect_once()
+        old_port = server.port
+        assert 'agent_events{event="rebind.marker"} 3.0' in _scrape(old_port)
+
+        rebinds = counters.get("metrics.rebind")
+        new_port = server.rebind(0, retry=FAST_BIND)
+        assert counters.get("metrics.rebind") == rebinds + 1
+        # Same registry, same cumulative state, new socket.
+        assert 'agent_events{event="rebind.marker"} 3.0' in _scrape(new_port)
+        with pytest.raises(OSError):
+            _scrape(old_port)
+    finally:
+        server.stop()
 
 
 def test_reset_clears_stale_series(stub):

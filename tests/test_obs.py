@@ -1,0 +1,425 @@
+"""Observability layer: spans, histograms, flight recorder, CLI, lint.
+
+Covers the obs/ contract the ISSUE pins:
+
+- spans carry trace/span ids, parent links, thread-local context,
+  attributes, and land in both the ring buffer and the TPU_TRACE_FILE
+  JSONL sink;
+- histograms bucket by log2 microseconds and serve percentiles;
+- the flight recorder dumps spans + counters + histograms on SIGUSR1
+  and on terminal failures;
+- cmd/agent_trace.py summarizes the JSONL;
+- obs/ stays importable (and functional) without prometheus_client or
+  grpc — enforced in a subprocess with those imports blocked;
+- every ``counters.inc(...)`` name in the package is documented in the
+  README metrics table (no undocumented counters), as is every gauge
+  family the MetricServer exports.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import flight, histo, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "container_engine_accelerators_tpu")
+
+
+@pytest.fixture(autouse=True)
+def clean_obs():
+    """Spans/histograms are process-global like counters; isolate each
+    test and leave nothing (an open sink) behind."""
+    trace.reset()
+    yield
+    trace.reset()
+
+
+# ---------------------------------------------------------------------------
+# trace
+# ---------------------------------------------------------------------------
+
+
+class TestTrace:
+    def test_nesting_links_and_ring_order(self):
+        with trace.span("outer", a=1) as outer:
+            with trace.span("inner") as inner:
+                assert trace.current() is inner
+            assert trace.current() is outer
+        assert trace.current() is None
+
+        inner_d, outer_d = trace.tail(2)
+        assert (inner_d["name"], outer_d["name"]) == ("inner", "outer")
+        assert inner_d["trace"] == outer_d["trace"]
+        assert inner_d["parent"] == outer_d["span"]
+        assert outer_d["parent"] is None
+        assert outer_d["attrs"] == {"a": 1}
+
+    def test_separate_roots_get_separate_traces(self):
+        with trace.span("first"):
+            pass
+        with trace.span("second"):
+            pass
+        first, second = trace.tail(2)
+        assert first["trace"] != second["trace"]
+
+    def test_error_status_and_propagation(self):
+        with pytest.raises(OSError, match="boom"):
+            with trace.span("failing"):
+                raise OSError("boom")
+        (d,) = trace.tail(1)
+        assert d["status"] == "error"
+        assert "boom" in d["attrs"]["error"]
+
+    def test_annotate_without_active_span_is_noop(self):
+        trace.annotate(orphan=True)  # must not raise
+        with trace.span("s"):
+            trace.annotate(k="v")
+        assert trace.tail(1)[0]["attrs"] == {"k": "v"}
+
+    def test_histogram_option_feeds_histo(self):
+        histo.reset()
+        with trace.span("timed", histogram="timed.op"):
+            pass
+        assert histo.snapshot()["timed.op"]["count"] == 1
+
+    def test_jsonl_sink_via_env(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "trace.jsonl")
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, path)
+        trace.reset()  # re-resolve the sink from env, like process start
+        with trace.span("a"):
+            pass
+        with trace.span("b", k=2):
+            pass
+        lines = [json.loads(x) for x in open(path)]
+        assert [x["name"] for x in lines] == ["a", "b"]
+        assert {"trace", "span", "parent", "ts", "dur_us", "status",
+                "thread", "attrs"} <= set(lines[0])
+
+    def test_unwritable_sink_never_breaks_spans(self, tmp_path):
+        trace.configure(str(tmp_path))  # a directory: open() fails
+        with trace.span("survives"):
+            pass
+        assert trace.tail(1)[0]["name"] == "survives"
+
+    def test_threads_are_isolated(self):
+        seen = {}
+
+        def worker():
+            with trace.span("worker-root") as s:
+                seen["worker"] = s.trace_id
+
+        with trace.span("main-root") as s:
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+            # The worker must NOT have inherited main's context.
+            assert seen["worker"] != s.trace_id
+
+    def test_malformed_ring_env_never_kills_import(self):
+        """TPU_TRACE_RING=garbage must degrade to the default, not
+        crash-loop every agent that transitively imports obs.trace."""
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from container_engine_accelerators_tpu.utils import retry; "
+             "print('IMPORT_OK')"],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+            env={**os.environ, trace.RING_CAPACITY_ENV: "oops"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "IMPORT_OK" in proc.stdout
+
+    def test_ring_is_bounded(self):
+        trace.configure(ring_capacity=8)
+        try:
+            for i in range(20):
+                with trace.span(f"s{i}"):
+                    pass
+            spans = trace.tail()
+            assert len(spans) == 8
+            assert spans[-1]["name"] == "s19"
+        finally:
+            trace.configure(ring_capacity=trace.DEFAULT_RING_CAPACITY)
+
+
+# ---------------------------------------------------------------------------
+# histo
+# ---------------------------------------------------------------------------
+
+
+class TestHisto:
+    def setup_method(self):
+        histo.reset()
+
+    def test_log2_bucketing(self):
+        assert histo.bucket_le_us(0.0) == 1
+        assert histo.bucket_le_us(1e-6) == 1
+        assert histo.bucket_le_us(3e-6) == 4
+        assert histo.bucket_le_us(1024e-6) == 1024
+        assert histo.bucket_le_us(1025e-6) == 2048
+
+    def test_observe_and_snapshot(self):
+        for us in (100, 200, 900, 5000):
+            histo.observe("op", us / 1e6)
+        snap = histo.snapshot()["op"]
+        assert snap["count"] == 4
+        assert snap["buckets"] == {"128": 1, "256": 1, "1024": 1, "8192": 1}
+        assert snap["sum_us"] == pytest.approx(6200, rel=1e-3)
+
+    def test_percentiles_are_upper_bounds(self):
+        for _ in range(99):
+            histo.observe("p", 100e-6)  # bucket le=128us
+        histo.observe("p", 1.0)  # one straggler: le=2^20us
+        assert histo.percentile("p", 0.5) == 128 / 1e6
+        assert histo.percentile("p", 0.99) == 128 / 1e6
+        assert histo.percentile("p", 1.0) == (1 << 20) / 1e6
+        assert histo.percentile("missing", 0.5) is None
+
+    def test_ops_are_independent(self):
+        histo.observe("a", 1e-3)
+        histo.observe("b", 1e-3)
+        snap = histo.snapshot()
+        assert snap["a"]["count"] == 1 and snap["b"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_contains_spans_counters_histograms(self, tmp_path,
+                                                     capsys):
+        histo.reset()
+        with trace.span("evidence", histogram="evidence.op"):
+            pass
+        counters.inc("test.flight.marker", 7)
+        path = str(tmp_path / "flight.jsonl")
+        blob = flight.dump("unit-test", file=path)
+        assert blob["reason"] == "unit-test"
+        assert blob["counters"]["test.flight.marker"] >= 7
+        assert blob["histograms"]["evidence.op"]["count"] >= 1
+        assert any(s["name"] == "evidence" for s in blob["spans"])
+        # File copy is one parseable JSON line with a schema tag.
+        (line,) = open(path).read().splitlines()
+        assert json.loads(line)["flight_recorder"] == 1
+        # stderr copy carries the grep-able marker.
+        assert flight.STDERR_MARKER in capsys.readouterr().err
+
+    def test_span_cap_respected(self, monkeypatch):
+        monkeypatch.setenv(flight.FLIGHT_SPANS_ENV, "3")
+        for i in range(10):
+            with trace.span(f"s{i}"):
+                pass
+        blob = flight.snapshot("cap")
+        assert [s["name"] for s in blob["spans"]] == ["s7", "s8", "s9"]
+
+    def test_malformed_span_cap_still_dumps(self, monkeypatch):
+        """A typo in TPU_FLIGHT_SPANS must cost the tuning knob, not
+        the evidence: the dump degrades to the default cap."""
+        monkeypatch.setenv(flight.FLIGHT_SPANS_ENV, "not-a-number")
+        with trace.span("still-here"):
+            pass
+        blob = flight.dump("bad-knob")
+        assert blob is not None
+        assert any(s["name"] == "still-here" for s in blob["spans"])
+
+    def test_sigusr1_dumps_async(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "sig.jsonl")
+        monkeypatch.setenv(flight.FLIGHT_FILE_ENV, path)
+        with trace.span("pre-signal"):
+            pass
+        assert flight.install()  # main thread here
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            deadline = time.monotonic() + 5
+            while not os.path.exists(path):
+                assert time.monotonic() < deadline, "no flight dump"
+                time.sleep(0.01)
+            # The handler thread may still be writing; poll for a full
+            # line.
+            blob = None
+            while time.monotonic() < deadline:
+                content = open(path).read()
+                if content.endswith("\n"):
+                    blob = json.loads(content.splitlines()[0])
+                    break
+                time.sleep(0.01)
+            assert blob and blob["reason"].startswith("signal")
+            assert any(s["name"] == "pre-signal" for s in blob["spans"])
+        finally:
+            signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+    def test_install_off_main_thread_degrades(self):
+        result = {}
+
+        def worker():
+            result["ok"] = flight.install()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert result["ok"] is False
+
+
+# ---------------------------------------------------------------------------
+# agent_trace CLI
+# ---------------------------------------------------------------------------
+
+
+def _load_cli():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "agent_trace", os.path.join(REPO, "cmd", "agent_trace.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestAgentTraceCli:
+    def _write_trace(self, tmp_path):
+        path = str(tmp_path / "agent.jsonl")
+        trace.configure(path)
+        with trace.span("dcn.send", op="ping"):
+            pass
+        with trace.span("dcn.replay", flows=2):
+            with trace.span("dcn.connect"):
+                trace.annotate(fault="dcn.connect")
+        try:
+            with trace.span("dcn.send"):
+                raise OSError("injected")
+        except OSError:
+            pass
+        trace.configure(None)  # flush/close before the CLI reads it
+        return path
+
+    def test_aggregation(self, tmp_path):
+        at = _load_cli()
+        spans, skipped = at.load_spans(self._write_trace(tmp_path))
+        assert len(spans) == 4 and skipped == 0
+        summary = at.aggregate(spans)
+        rows = {r["name"]: r for r in summary["rows"]}
+        assert rows["dcn.send"]["count"] == 2
+        assert rows["dcn.send"]["errors"] == 1
+        assert summary["fault_injections"] == {"dcn.connect": 1}
+        assert summary["traces"] == 3
+
+    def test_malformed_lines_skipped(self, tmp_path):
+        at = _load_cli()
+        path = self._write_trace(tmp_path)
+        with open(path, "a") as f:
+            f.write("not json\n{\"also\": \"not a span\"}\n")
+        spans, skipped = at.load_spans(path)
+        assert len(spans) == 4 and skipped == 2
+
+    def test_flight_dump_is_readable_too(self, tmp_path):
+        at = _load_cli()
+        with trace.span("from-flight"):
+            pass
+        path = str(tmp_path / "fl.jsonl")
+        flight.dump("cli-test", file=path)
+        spans, _ = at.load_spans(path)
+        assert any(s["name"] == "from-flight" for s in spans)
+
+    def test_main_end_to_end(self, tmp_path, capsys):
+        at = _load_cli()
+        summary = at.main([self._write_trace(tmp_path)])
+        assert summary["spans"] == 4
+        out = capsys.readouterr()
+        assert json.loads(out.out.strip().splitlines()[-1])["spans"] == 4
+        assert "dcn.replay" in out.err  # human table on stderr
+
+    def test_tree_view(self, tmp_path, capsys):
+        at = _load_cli()
+        path = self._write_trace(tmp_path)
+        spans, _ = at.load_spans(path)
+        replay = next(s for s in spans if s["name"] == "dcn.replay")
+        at.main([path, "--trace", replay["trace"]])
+        err = capsys.readouterr().err
+        assert "dcn.replay" in err and "  dcn.connect" in err
+
+
+# ---------------------------------------------------------------------------
+# dependency-freedom: obs works with prometheus_client/grpc blocked
+# ---------------------------------------------------------------------------
+
+
+def test_obs_importable_without_prometheus_or_grpc(tmp_path):
+    """The acceptance bar: obs/ (and the counters it dumps) must work
+    in a container that has neither prometheus_client nor grpc — the
+    exporter imports obs, never the other way around."""
+    code = """
+import sys
+sys.modules["prometheus_client"] = None  # import -> ImportError
+sys.modules["grpc"] = None
+from container_engine_accelerators_tpu.obs import flight, histo, trace
+from container_engine_accelerators_tpu.metrics import counters
+with trace.span("bare", histogram="bare.op"):
+    counters.inc("bare.counter")
+blob = flight.dump("no-deps")
+assert blob["histograms"]["bare.op"]["count"] == 1
+assert blob["counters"]["bare.counter"] == 1
+assert trace.tail(1)[0]["name"] == "bare"
+print("OK")
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# lint: every counter / gauge is documented in the README
+# ---------------------------------------------------------------------------
+
+
+def _package_sources():
+    for root, _dirs, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+
+
+def _counter_names():
+    """Every literal (or f-string) name passed to counters.inc in the
+    package; placeholders normalize to the README's <site> form."""
+    pat = re.compile(r"counters\.inc\(\s*f?\"([^\"]+)\"")
+    names = set()
+    for path in _package_sources():
+        with open(path) as fh:
+            for m in pat.finditer(fh.read()):
+                names.add(re.sub(r"\{[^}]*\}", "<site>", m.group(1)))
+    return names
+
+
+def test_readme_documents_every_counter_and_gauge():
+    readme = open(os.path.join(REPO, "README.md")).read()
+    counter_names = _counter_names()
+    assert counter_names, "lint regex found no counters at all?"
+    undocumented = {n for n in counter_names if f"`{n}`" not in readme}
+    assert not undocumented, (
+        f"counters missing from the README metrics table: "
+        f"{sorted(undocumented)} — every counters.inc() name must be "
+        f"documented (README.md, Observability section)"
+    )
+    # Gauge families straight from the exporter source: the g("name"
+    # helper in MetricServer.__init__.
+    metrics_src = open(os.path.join(PKG, "metrics", "metrics.py")).read()
+    gauges = set(re.findall(r"\bg\(\s*\n?\s*\"([a-z_]+)\"", metrics_src))
+    assert {"agent_events", "agent_latency", "duty_cycle"} <= gauges
+    missing = {n for n in gauges if f"`{n}`" not in readme}
+    assert not missing, f"gauge families missing from README: {missing}"
