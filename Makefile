@@ -76,6 +76,16 @@ obs:
 	$(PY) -m pytest tests/test_obs.py tests/test_metrics.py \
 	    tests/test_chaos.py -q -p no:randomly
 
+# Fleet gate: the multi-node simulation rig — link-level faults
+# (partition / asymmetric loss / latency), partition-heal
+# re-convergence, frame-seq dedup exactly-once, cross-process trace
+# merging — including the scenarios marked slow, then one CLI run of
+# the headline rack-partition scenario (the acceptance path).
+.PHONY: fleet
+fleet:
+	$(PY) -m pytest tests/test_fleet.py -q -p no:randomly
+	$(PY) cmd/fleet_sim.py --rounds 5 > /dev/null
+
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests
 	bash build/check_boilerplate.sh

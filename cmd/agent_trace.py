@@ -18,9 +18,16 @@ flapping node raises:
 Also accepts flight-recorder dumps (obs/flight.py): a line whose
 object carries ``flight_recorder`` contributes its ``spans`` list.
 
+Accepts MULTIPLE JSONL files and merges them before aggregating — the
+cross-process story: a fleet run leaves one file per node process, and
+a single DCN transfer's trace id spans both sides (the client stamps it
+on the control protocol, the daemon stamps it on data-plane frames, the
+coordinator exports it via TPU_TRACE_CONTEXT — obs/trace.py).  Merging
+then ``--trace <id>`` renders one cross-node tree.
+
 Usage:
-  python cmd/agent_trace.py <trace.jsonl> [--top 20] [--trace ID]
-                            [--slowest 5]
+  python cmd/agent_trace.py <trace.jsonl> [more.jsonl ...] [--top 20]
+                            [--trace ID] [--slowest 5]
 Prints one JSON line (machine-readable) after a human table, exactly
 like trace_summary.py.
 """
@@ -33,8 +40,10 @@ from collections import defaultdict
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("path", help="trace JSONL (TPU_TRACE_FILE output) or a "
-                                "flight-recorder dump")
+    p.add_argument("paths", nargs="+", metavar="path",
+                   help="trace JSONL files (TPU_TRACE_FILE output) or "
+                        "flight-recorder dumps; several files (one per "
+                        "process) are merged")
     p.add_argument("--top", type=int, default=20,
                    help="span names to show in the table")
     p.add_argument("--slowest", type=int, default=5,
@@ -45,26 +54,32 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def load_spans(path):
+def load_spans(paths):
     """Tolerant reader: skips malformed lines (a crash mid-write must
-    not make the evidence unreadable), unwraps flight-recorder blobs."""
+    not make the evidence unreadable), unwraps flight-recorder blobs,
+    merges any number of per-process files (a ``file`` attr-free span
+    keeps no origin marker — processes already self-identify via the
+    ``node``/``thread`` attrs)."""
+    if isinstance(paths, str):  # back-compat: single-path callers
+        paths = [paths]
     spans, skipped = [], 0
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                obj = json.loads(line)
-            except ValueError:
-                skipped += 1
-                continue
-            if obj.get("flight_recorder"):
-                spans.extend(obj.get("spans", []))
-            elif "span" in obj and "name" in obj:
-                spans.append(obj)
-            else:
-                skipped += 1
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    skipped += 1
+                    continue
+                if obj.get("flight_recorder"):
+                    spans.extend(obj.get("spans", []))
+                elif "span" in obj and "name" in obj:
+                    spans.append(obj)
+                else:
+                    skipped += 1
     return spans, skipped
 
 
@@ -159,9 +174,11 @@ def print_tree(spans, trace_id, file=sys.stderr):
 
 def main(argv=None):
     args = parse_args(argv)
-    spans, skipped = load_spans(args.path)
+    spans, skipped = load_spans(args.paths)
     if not spans:
-        raise SystemExit(f"no spans in {args.path} ({skipped} bad lines)")
+        raise SystemExit(
+            f"no spans in {', '.join(args.paths)} ({skipped} bad lines)"
+        )
     if args.trace:
         n = print_tree(spans, args.trace)
         print(json.dumps({"trace": args.trace, "spans": n}))

@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Run a fleet chaos scenario and print the per-node / per-link report.
+
+The multi-node companion of the single-node chaos gate: boots N
+emulated nodes (TpuManager + health checker + PyXferd daemon + the
+production resilient client each), wires every inter-node DCN frame
+through the fleet link table, drives the scenario's fault schedule
+(rack partitions, link loss/latency, chip faults, daemon kills), and
+runs a ring-transfer workload per round until the fleet re-converges —
+or doesn't, which is the exit code's job to say.
+
+Usage:
+  python cmd/fleet_sim.py                          # built-in scenario:
+                                                   # 4 nodes / 2 racks,
+                                                   # rack partition +
+                                                   # chip fault, heal,
+                                                   # re-converge
+  python cmd/fleet_sim.py --scenario fleet.yaml    # declarative spec
+  python cmd/fleet_sim.py --nodes 6 --racks 3 --rounds 8
+  python cmd/fleet_sim.py --trace-file /tmp/fleet.jsonl
+                                                   # + cmd/agent_trace.py
+
+Prints human-readable per-node and per-link tables to stderr and one
+JSON report line to stdout (the repo's CLI contract, like
+agent_trace.py).  Exits 0 iff the fleet converged: every surviving
+node's final-round legs completed and every surviving node is fully
+healthy again.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.fleet.controller import (  # noqa: E402
+    DEFAULT_SCENARIO,
+    load_scenario,
+    run_scenario,
+)
+from container_engine_accelerators_tpu.obs import trace  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", default=None,
+                   help="scenario file (JSON, or YAML with .yaml/.yml)")
+    p.add_argument("--nodes", type=int, default=None,
+                   help="override node count")
+    p.add_argument("--racks", type=int, default=None,
+                   help="override rack count")
+    p.add_argument("--rounds", type=int, default=None,
+                   help="override workload rounds")
+    p.add_argument("--payload-bytes", type=int, default=None,
+                   help="override per-leg payload size")
+    p.add_argument("--metrics", action="store_true",
+                   help="start a per-node MetricServer (ephemeral ports)")
+    p.add_argument("--trace-file", default=None,
+                   help="write the run's span JSONL here "
+                        "(summarize with cmd/agent_trace.py)")
+    return p.parse_args(argv)
+
+
+def _print_report(report, file=sys.stderr):
+    nodes = report["nodes"]
+    print(f"scenario: {report['scenario']}  converged: "
+          f"{report['converged']}", file=file)
+    width = max([len(n) for n in nodes] + [4])
+    print(f"{'node':<{width}} {'rack':>6} {'healthy':>8} {'gen':>4} "
+          f"{'legs_ok':>8} {'legs_failed':>12} {'down':>5}", file=file)
+    for name, n in sorted(nodes.items()):
+        print(f"{name:<{width}} {n['rack']:>6} "
+              f"{n['healthy']}/{n['total']:>4} "
+              f"{n['daemon_generation']:>4} {n['legs_ok']:>8} "
+              f"{n['legs_failed']:>12} {str(n['down']):>5}", file=file)
+    links = report["links"]
+    if links:
+        lw = max(len(k) for k in links)
+        print(f"\n{'link':<{lw}} {'tier':>11} {'up':>3} {'frames':>7} "
+              f"{'bytes':>9} {'drops':>6} {'dups':>5} {'blocked':>8}",
+              file=file)
+        for key, s in sorted(links.items()):
+            print(f"{key:<{lw}} {s['tier']:>11} "
+                  f"{'y' if s['up'] else 'N':>3} {s['frames']:>7} "
+                  f"{s['bytes']:>9} {s['drops']:>6} {s['dups']:>5} "
+                  f"{s['blocked']:>8}", file=file)
+    if report["agent_events_delta"]:
+        print(f"\nagent events (delta): "
+              f"{report['agent_events_delta']}", file=file)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    scenario = dict(
+        load_scenario(args.scenario) if args.scenario else DEFAULT_SCENARIO
+    )
+    for key, value in (("nodes", args.nodes), ("racks", args.racks),
+                       ("rounds", args.rounds),
+                       ("payload_bytes", args.payload_bytes)):
+        if value is not None:
+            scenario[key] = value
+    if args.metrics:
+        scenario["metrics"] = True
+    if args.trace_file:
+        trace.configure(args.trace_file)
+
+    report = run_scenario(scenario)
+
+    _print_report(report)
+    print(json.dumps(report))
+    if args.trace_file:
+        trace.configure(None)  # flush/close the sink
+    return 0 if report["converged"] else 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -213,9 +213,23 @@ class TpuManager:
                         ).health == HEALTHY
                         for c in self.subslice_manager.members(slice_id)
                     ):
+                        prev = self.subslice_manager.list_partition_devices(
+                        ).get(slice_id)
+                        # Capture the STRING before set_device_health
+                        # mutates the (shared) Device object in place.
+                        prev_health = None if prev is None else prev.health
                         self.subslice_manager.set_device_health(
                             slice_id, HEALTHY
                         )
+                        if prev_health is not None and prev_health != HEALTHY:
+                            # The slice the kubelet actually schedules
+                            # just came back — count it separately from
+                            # per-chip recoveries so a fleet dashboard
+                            # can tell "a chip healed" from "capacity
+                            # returned".
+                            counters.inc("health.slice_recovered")
+                            trace.event("health.slice_recover",
+                                        slice=slice_id, chip=name)
             elif self.subslice_manager is not None:
                 self.subslice_manager.set_device_health(name, health)
 

@@ -1,0 +1,64 @@
+"""Fleet simulation rig: N emulated nodes, link-level faults, fleet
+observability.
+
+Everything else in this stack is *single-node*: one ``TpuManager``, one
+health checker, one ``dcnxferd`` double.  The reference's whole reason
+to exist is multi-host accelerator infrastructure — topology-aware
+placement, per-node daemons, high-bandwidth collectives across racks —
+and collective behavior under *link-level* asymmetry (one rack
+partitioned, one direction lossy) is qualitatively different from the
+endpoint churn the chaos suite already covers (TACCL, PAPERS.md).  This
+package is the rig that makes those scenarios testable on a laptop:
+
+- ``fleet.topology``   fleet model: racks/hosts/slices as NodeSpecs,
+                       labeled with the SAME keys the scheduler sorts
+                       on (scheduler/topology.py), so link tiers fall
+                       out of the production distance function;
+- ``fleet.links``      the link table — per-(src,dst) state every
+                       inter-node DCN frame routes through, and the
+                       fault surface: partition / loss / latency,
+                       armed from a compact spec grammar;
+- ``fleet.xferd``      PyXferd, a protocol-faithful Python transfer
+                       daemon with a real data plane: per-flow frame
+                       sequencing, receiver-side dedup, trace-context
+                       propagation on both control ops and frames;
+- ``fleet.node``       EmulatedNode: TpuManager + health checker +
+                       PyXferd + resilient client (+ optional
+                       MetricServer), one per simulated host;
+- ``fleet.controller`` FleetController: declarative scenarios (nodes,
+                       topology, fault schedule, workload rounds) and
+                       the per-node / per-link report.
+
+Drive it with ``python cmd/fleet_sim.py`` or ``make fleet``; the
+scenario spec schema is documented in the README ("Fleet simulation").
+"""
+
+from container_engine_accelerators_tpu.fleet.controller import (
+    DEFAULT_SCENARIO,
+    FleetController,
+    load_scenario,
+)
+from container_engine_accelerators_tpu.fleet.links import (
+    FleetNet,
+    LinkPartitioned,
+    LinkTable,
+)
+from container_engine_accelerators_tpu.fleet.node import EmulatedNode
+from container_engine_accelerators_tpu.fleet.topology import (
+    FleetTopology,
+    NodeSpec,
+)
+from container_engine_accelerators_tpu.fleet.xferd import PyXferd
+
+__all__ = [
+    "DEFAULT_SCENARIO",
+    "EmulatedNode",
+    "FleetController",
+    "FleetNet",
+    "FleetTopology",
+    "LinkPartitioned",
+    "LinkTable",
+    "NodeSpec",
+    "PyXferd",
+    "load_scenario",
+]

@@ -1,0 +1,375 @@
+"""FleetController: declarative chaos scenarios over N emulated nodes.
+
+A scenario is a plain dict (or JSON/YAML file — ``load_scenario``)::
+
+    name: rack-partition
+    nodes: 4            # or an explicit list of node dicts:
+    racks: 2            #   {name, rack, chips, topology, partition_size}
+    chips: 4
+    topology: 2x2x1
+    rounds: 6           # workload rounds; the fault schedule is keyed
+    payload_bytes: 2048 # to rounds, so runs are reproducible
+    metrics: false      # per-node MetricServer on an ephemeral port
+    faults:
+      - {round: 2, link: "rack:r0<->rack:r1:partition", for: 2}
+      - {round: 1, action: chip_fault, node: n1, chip: accel0}
+      - {round: 3, action: chip_recover, node: n1}
+      - {round: 2, action: kill, node: n3, for: 1}
+
+Workload: each round runs a ring of one-way DCN transfers (node i
+stages a payload, streams it to node i+1's daemon through the link
+table, node i+1 lands + reads it back) — every leg retried under a
+bounded budget, so a leg that dies mid-partition re-converges after the
+heal the way a real collective caller would.  ``for: K`` on a fault
+schedules its inverse K rounds later (partition→heal, kill→restart).
+
+The run returns one report: per-node (device health, daemon
+generation, legs ok/failed), per-link (frames/bytes/drops/dups/blocked,
+tier-annotated by the production scheduler distance), the round log,
+and the fleet-wide ``agent_events`` / ``agent_latency`` deltas — the
+single pane the single-node MetricServer cannot give you.
+"""
+
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from container_engine_accelerators_tpu.fleet.links import (
+    FleetNet,
+    LinkFault,
+    LinkTable,
+    parse_link_fault,
+)
+from container_engine_accelerators_tpu.fleet.node import EmulatedNode
+from container_engine_accelerators_tpu.fleet.topology import (
+    FleetTopology,
+    NodeSpec,
+    build_specs,
+)
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import histo, trace
+from container_engine_accelerators_tpu.parallel import dcn
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    DcnXferError,
+)
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SCENARIO = {
+    "name": "rack-partition",
+    "nodes": 4,
+    "racks": 2,
+    "chips": 4,
+    "topology": "2x2x1",
+    "rounds": 6,
+    "payload_bytes": 2048,
+    "metrics": False,
+    "faults": [
+        {"round": 1, "action": "chip_fault", "node": "n1",
+         "chip": "accel0"},
+        {"round": 2, "link": "rack:r0<->rack:r1:partition", "for": 2},
+        {"round": 3, "action": "chip_recover", "node": "n1"},
+    ],
+}
+
+
+def load_scenario(path: str) -> dict:
+    """Read a scenario file: YAML when the extension says so (and
+    PyYAML is importable), JSON otherwise."""
+    with open(path) as f:
+        raw = f.read()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(raw)
+    return json.loads(raw)
+
+
+def _scenario_specs(scenario: dict) -> List[NodeSpec]:
+    nodes = scenario.get("nodes", 4)
+    if isinstance(nodes, int):
+        return build_specs(
+            nodes,
+            racks=int(scenario.get("racks", 1)),
+            chips=int(scenario.get("chips", 4)),
+            topology=scenario.get("topology", "2x2x1"),
+            partition_size=scenario.get("partition_size", ""),
+        )
+    return [
+        NodeSpec(
+            name=n["name"],
+            rack=n.get("rack", "r0"),
+            chips=int(n.get("chips", scenario.get("chips", 4))),
+            topology=n.get("topology", scenario.get("topology", "2x2x1")),
+            partition_size=n.get("partition_size", ""),
+        )
+        for n in nodes
+    ]
+
+
+class FleetController:
+    def __init__(self, scenario: Optional[dict] = None,
+                 workdir: Optional[str] = None):
+        self.scenario = dict(DEFAULT_SCENARIO if scenario is None
+                             else scenario)
+        self.workdir = workdir or tempfile.mkdtemp(prefix="fleet-sim-")
+        self.topology = FleetTopology(_scenario_specs(self.scenario))
+        self.links = LinkTable(self.topology)
+        self.net = FleetNet(self.links)
+        self.nodes: Dict[str, EmulatedNode] = {}
+        self.rounds = int(self.scenario.get("rounds", 6))
+        self.payload_bytes = int(self.scenario.get("payload_bytes", 2048))
+        self.leg_retry = RetryPolicy(
+            max_attempts=int(self.scenario.get("leg_attempts", 3)),
+            initial_backoff_s=float(
+                self.scenario.get("leg_backoff_ms", 30)) / 1e3,
+            max_backoff_s=0.2,
+            deadline_s=float(self.scenario.get("leg_deadline_s", 8.0)),
+        )
+        self.land_timeout_s = float(self.scenario.get("land_timeout_s", 2.0))
+        # round -> list of deferred inverse faults ("for: K" entries)
+        self._deferred: Dict[int, List[dict]] = {}
+        self._booted = False
+        self._counters0: Dict[str, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def boot(self) -> "FleetController":
+        if self._booted:
+            return self
+        for spec in self.topology.specs.values():
+            self.nodes[spec.name] = EmulatedNode(
+                spec,
+                os.path.join(self.workdir, spec.name),
+                net=self.net,
+                metrics=bool(self.scenario.get("metrics", False)),
+            )
+        self._counters0 = counters.snapshot()
+        self._booted = True
+        log.info("fleet booted: %d node(s) in %d rack(s)",
+                 len(self.nodes),
+                 len({s.rack for s in self.topology.specs.values()}))
+        return self
+
+    def close(self) -> None:
+        for node in self.nodes.values():
+            node.close()
+
+    # -- fault schedule ------------------------------------------------------
+
+    def _apply_fault(self, rnd: int, entry: dict) -> dict:
+        """Apply one schedule entry; returns a loggable record."""
+        record = dict(entry)
+        record["round"] = rnd
+        if "link" in entry:
+            fault = (entry["link"] if isinstance(entry["link"], LinkFault)
+                     else parse_link_fault(entry["link"]))
+            if fault is None:
+                record["applied"] = 0
+                return record
+            record["link"] = fault.spec()  # JSON-clean round log
+            record["applied"] = len(self.links.apply(fault))
+            lifetime = int(entry.get("for", 0))
+            inverse = fault.inverse()
+            if lifetime > 0 and inverse is not None:
+                self._deferred.setdefault(rnd + lifetime, []).append(
+                    {"link": inverse}
+                )
+            return record
+        action = entry.get("action", "")
+        node = self.nodes.get(entry.get("node", ""))
+        if node is None:
+            log.error("fault entry names unknown node: %r", entry)
+            record["applied"] = 0
+            return record
+        if action == "chip_fault":
+            node.inject_chip_fault(entry.get("chip", "accel0"),
+                                   int(entry.get("code", 48)))
+        elif action == "chip_recover":
+            record["recovered"] = node.force_recover()
+        elif action == "kill":
+            node.kill_daemon()
+            lifetime = int(entry.get("for", 0))
+            if lifetime > 0:
+                self._deferred.setdefault(rnd + lifetime, []).append(
+                    {"action": "restart", "node": node.name}
+                )
+        elif action == "restart":
+            node.restart_daemon()
+        else:
+            log.error("unknown fault action %r", action)
+        record["applied"] = 1
+        return record
+
+    # -- workload ------------------------------------------------------------
+
+    def _leg(self, rnd: int, src: EmulatedNode, dst: EmulatedNode) -> dict:
+        """One one-way transfer src → dst, retried under the leg
+        budget.  Flow names are unique per (round, pair) so retries
+        never collide with the daemons' duplicate-flow rejection."""
+        payload = bytes([(rnd * 31 + len(src.name)) % 256]) \
+            * self.payload_bytes
+        # ONE name, registered on both daemons: frames land into the
+        # flow of the same name on the receiver (the exchange_shard
+        # convention); unique per (round, pair) so retries never hit
+        # duplicate-flow rejection.
+        flow = f"r{rnd}.{src.name}.{dst.name}"
+        tx = rx = flow
+        result = {"src": src.name, "dst": dst.name, "ok": False,
+                  "attempts": 0}
+        with trace.span("fleet.leg", histogram="fleet.leg", round=rnd,
+                        src=src.name, dst=dst.name,
+                        bytes=self.payload_bytes) as span:
+            try:
+                dst.client.register_flow(rx, peer=src.name,
+                                         bytes=self.payload_bytes)
+                src.client.register_flow(tx, peer=dst.name,
+                                         bytes=self.payload_bytes)
+                src.client.put(tx, payload)
+                dcn.wait_flow_rx(src.client, tx, len(payload),
+                                 timeout_s=self.land_timeout_s)
+                last: Optional[BaseException] = None
+                for _attempt in self.leg_retry.attempts():
+                    result["attempts"] += 1
+                    try:
+                        src.client.send(tx, "127.0.0.1",
+                                        dst.daemon.data_port,
+                                        len(payload))
+                        dcn.wait_flow_rx(dst.client, rx, len(payload),
+                                         timeout_s=self.land_timeout_s)
+                        got = dst.client.read(rx, len(payload))
+                        if got != payload:
+                            raise DcnXferError(
+                                f"payload mismatch on {flow}"
+                            )
+                        result["ok"] = True
+                        return result
+                    except (DcnXferError, OSError, TimeoutError) as e:
+                        last = e
+                result["error"] = str(last)
+                span.annotate(error=str(last))
+                return result
+            except (DcnXferError, OSError, TimeoutError) as e:
+                result["error"] = str(e)
+                span.annotate(error=str(e))
+                return result
+            finally:
+                span.annotate(ok=result["ok"],
+                              attempts=result["attempts"])
+                for node, flow in ((src, tx), (dst, rx)):
+                    try:
+                        node.client.release_flow(flow)
+                    except (DcnXferError, OSError):
+                        pass
+
+    def _ring(self) -> List[tuple]:
+        names = list(self.nodes)
+        n = len(names)
+        return [(self.nodes[names[i]], self.nodes[names[(i + 1) % n]])
+                for i in range(n)] if n > 1 else []
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self) -> dict:
+        self.boot()
+        per_node_ok: Dict[str, int] = {n: 0 for n in self.nodes}
+        per_node_failed: Dict[str, int] = {n: 0 for n in self.nodes}
+        round_log = []
+        with trace.span("fleet.scenario",
+                        scenario=self.scenario.get("name", "fleet"),
+                        nodes=len(self.nodes), rounds=self.rounds):
+            scheduled = list(self.scenario.get("faults", []))
+            for rnd in range(self.rounds):
+                fired = []
+                for entry in self._deferred.pop(rnd, []):
+                    fired.append(self._apply_fault(rnd, entry))
+                for entry in scheduled:
+                    if int(entry.get("round", 0)) == rnd:
+                        fired.append(self._apply_fault(rnd, entry))
+                legs = []
+                with trace.span("fleet.round", round=rnd):
+                    for src, dst in self._ring():
+                        if src.down or dst.down:
+                            legs.append({"src": src.name,
+                                         "dst": dst.name,
+                                         "skipped": "node down"})
+                            continue
+                        leg = self._leg(rnd, src, dst)
+                        legs.append(leg)
+                        if leg["ok"]:
+                            per_node_ok[src.name] += 1
+                        else:
+                            per_node_failed[src.name] += 1
+                    for node in self.nodes.values():
+                        node.recover()
+                round_log.append(
+                    {"round": rnd, "faults": fired, "legs": legs}
+                )
+        return self._report(round_log, per_node_ok, per_node_failed)
+
+    def _report(self, round_log, per_node_ok, per_node_failed) -> dict:
+        final_legs = round_log[-1]["legs"] if round_log else []
+        survivors_converged = all(
+            leg.get("ok", False) for leg in final_legs
+            if "skipped" not in leg
+        ) and bool(final_legs)
+        nodes_report = {}
+        all_up_healthy = True
+        for name, node in self.nodes.items():
+            snap = node.snapshot()
+            snap["legs_ok"] = per_node_ok[name]
+            snap["legs_failed"] = per_node_failed[name]
+            nodes_report[name] = snap
+            if not node.down and not node.all_healthy():
+                all_up_healthy = False
+        # Fleet-wide observability snapshot: every node's self-healing
+        # counters and latency histograms aggregated (the simulator is
+        # one process, so the process registries ARE the fleet's).
+        delta = {}
+        now = counters.snapshot()
+        for k, v in now.items():
+            d = v - self._counters0.get(k, 0)
+            if d:
+                delta[k] = d
+        latency = {
+            op: {"count": h["count"],
+                 "p50_us": (histo.percentile(op, 0.5) or 0) * 1e6,
+                 "p99_us": (histo.percentile(op, 0.99) or 0) * 1e6}
+            for op, h in histo.snapshot().items()
+            if op.startswith(("fleet.", "xferd.", "dcn."))
+        }
+        return {
+            "scenario": self.scenario.get("name", "fleet"),
+            "nodes": nodes_report,
+            "links": self.links.report(),
+            "rounds": round_log,
+            "agent_events_delta": delta,
+            "agent_latency": latency,
+            "converged": survivors_converged and all_up_healthy,
+        }
+
+    # -- coordinator env -----------------------------------------------------
+
+    def child_env(self, base: Optional[dict] = None) -> dict:
+        """Env for a worker process this coordinator spawns: the active
+        trace context rides TPU_TRACE_CONTEXT so the child's spans join
+        the coordinator's trace (obs/trace.attach_from_env)."""
+        env = dict(os.environ if base is None else base)
+        ctx = trace.context_env()
+        if ctx:
+            env[trace.TRACE_CONTEXT_ENV] = ctx
+        return env
+
+
+def run_scenario(scenario: Optional[dict] = None,
+                 workdir: Optional[str] = None) -> dict:
+    """One-shot convenience: boot, run, close, return the report."""
+    ctl = FleetController(scenario, workdir=workdir)
+    try:
+        return ctl.run()
+    finally:
+        ctl.close()

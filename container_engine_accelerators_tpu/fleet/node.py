@@ -1,0 +1,185 @@
+"""EmulatedNode: one simulated host, full node-agent stack included.
+
+Each node the fleet boots is the real single-node machinery, not a
+mock: a :class:`TpuManager` discovering a fabricated sysfs/dev tree, a
+:class:`TpuHealthChecker` with the production quiescence/flap-backoff
+state machine, a :class:`PyXferd` transfer daemon with a live data
+plane, a :class:`ResilientDcnXferClient` with the production
+reconnect/replay/restage behavior, and (opt-in) a per-node
+:class:`MetricServer` on an ephemeral port.  Chaos at the fleet level
+therefore exercises exactly the code paths a real node would run —
+the same reason the chaos suite injects faults into production call
+sites instead of monkeypatching sockets.
+
+Health is pumped deterministically (the controller drains the manager's
+health queue between rounds, like ListAndWatch would) so scenarios are
+reproducible: no background thread races the fault schedule.
+"""
+
+import logging
+import os
+from typing import Dict, Optional
+
+from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
+from container_engine_accelerators_tpu.fleet.topology import NodeSpec
+from container_engine_accelerators_tpu.fleet.xferd import PyXferd
+from container_engine_accelerators_tpu.health import TpuHealthChecker
+from container_engine_accelerators_tpu.obs import trace
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    ResilientDcnXferClient,
+)
+from container_engine_accelerators_tpu.tpulib import SysfsTpuLib, write_fixture
+from container_engine_accelerators_tpu.tpulib.types import TpuErrorEvent
+from container_engine_accelerators_tpu.utils.config import TPUConfig
+from container_engine_accelerators_tpu.utils.device import HEALTHY
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+log = logging.getLogger(__name__)
+
+# Node-agent retry budget at simulation timescale: same shape as
+# production DEFAULT_DCN_RETRY, milliseconds instead of seconds.
+FLEET_CLIENT_RETRY = RetryPolicy(
+    max_attempts=8, initial_backoff_s=0.01, max_backoff_s=0.1,
+    deadline_s=15.0,
+)
+
+DEFAULT_RECOVERY_WINDOW_S = 0.05
+
+
+class EmulatedNode:
+    def __init__(
+        self,
+        spec: NodeSpec,
+        root: str,
+        net=None,
+        recovery_window_s: float = DEFAULT_RECOVERY_WINDOW_S,
+        metrics: bool = False,
+        client_retry: Optional[RetryPolicy] = None,
+    ):
+        self.spec = spec
+        self.name = spec.name
+        self.root = root
+        self.net = net
+        self.down = False  # daemon intentionally killed by the scenario
+
+        write_fixture(root, spec.chips, topology=spec.topology)
+        cfg_json = ({"tpuPartitionSize": spec.partition_size}
+                    if spec.partition_size else {})
+        cfg = TPUConfig.from_json(cfg_json)
+        cfg.add_defaults_and_validate()
+        self.lib = SysfsTpuLib(root)
+        self.manager = TpuManager(
+            os.path.join(root, "dev"), [], cfg, lib=self.lib
+        )
+        self.manager.start()
+        self.health = TpuHealthChecker(
+            self.manager, self.lib, recovery_window_s=recovery_window_s
+        )
+        self.daemon = PyXferd(
+            os.path.join(root, "tpu-dcn"), node=spec.name, net=net
+        ).start()
+        if net is not None:
+            net.register(spec.name, self.daemon)
+        self.client = ResilientDcnXferClient(
+            os.path.join(root, "tpu-dcn"),
+            retry=client_retry or FLEET_CLIENT_RETRY,
+        )
+        self.metrics = None
+        if metrics:
+            # Per-node exporter on an ephemeral port; the pod-resources
+            # socket does not exist in the sim and its absence is
+            # absorbed (the production contract).
+            from container_engine_accelerators_tpu.metrics.metrics import (
+                MetricServer,
+                TpuMetricsCollector,
+            )
+
+            self.metrics = MetricServer(
+                collector=TpuMetricsCollector(self.lib),
+                port=0,
+                pod_resources_socket=os.path.join(root, "noresources.sock"),
+            )
+            self.metrics.start()
+
+    # -- health --------------------------------------------------------------
+
+    def pump_health(self) -> int:
+        """Drain queued health transitions into device state, as the
+        kubelet-facing ListAndWatch announcement loop would."""
+        n = 0
+        while True:
+            try:
+                d = self.manager.health_events.get_nowait()
+            except Exception:  # queue.Empty
+                return n
+            self.manager.set_device_health(d.id, d.health)
+            n += 1
+
+    def inject_chip_fault(self, chip: str, code: int = 48) -> None:
+        trace.event("fleet.chip_fault", node=self.name, chip=chip,
+                    code=code)
+        self.health.catch_error(TpuErrorEvent(code=code, device=chip))
+        self.pump_health()
+
+    def recover(self, now: Optional[float] = None) -> int:
+        n = self.health.maybe_recover(now=now)
+        self.pump_health()
+        return n
+
+    def force_recover(self) -> int:
+        """Drive every pending quiescence window closed (a scenario's
+        explicit ``chip_recover`` action — deterministic, no sleeps)."""
+        import time as _time
+
+        return self.recover(now=_time.monotonic() + 1e6)
+
+    def device_health(self) -> Dict[str, str]:
+        return {d.id: d.health
+                for d in self.manager.list_devices().values()}
+
+    def all_healthy(self) -> bool:
+        health = self.device_health()
+        return bool(health) and all(h == HEALTHY for h in health.values())
+
+    # -- daemon churn --------------------------------------------------------
+
+    def kill_daemon(self) -> None:
+        trace.event("fleet.node_kill", node=self.name)
+        self.down = True
+        if self.net is not None:
+            self.net.unregister(self.name)
+        self.daemon.stop(crash=True)
+
+    def restart_daemon(self) -> None:
+        trace.event("fleet.node_restart", node=self.name)
+        self.daemon.start()
+        if self.net is not None:
+            self.net.register(self.name, self.daemon)
+        self.down = False
+
+    # -- reporting -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        health = self.device_health()
+        snap = {
+            "rack": self.spec.rack,
+            "devices": health,
+            "healthy": sum(1 for h in health.values() if h == HEALTHY),
+            "total": len(health),
+            "daemon_generation": self.daemon.generation,
+            "down": self.down,
+        }
+        if self.metrics is not None:
+            snap["metrics_port"] = self.metrics.port
+        return snap
+
+    def close(self) -> None:
+        for action in (
+            lambda: self.client.close(),
+            lambda: self.daemon.stop(),
+            lambda: self.metrics.stop() if self.metrics else None,
+        ):
+            try:
+                action()
+            except OSError:
+                pass

@@ -1,0 +1,125 @@
+"""Fleet topology model: hosts, racks, slices — scheduler labels reused.
+
+A simulated fleet node carries the SAME label set the node labeler
+stamps and the topology scheduler sorts on (scheduler/topology.py), so
+the simulator and the production placement logic agree about what is
+"near": two nodes in one rack are one DCN tier apart, two racks are
+two, and the classification below is computed with the production
+``node_topology_distance`` — not re-derived ad hoc.  That is the point
+of the rig: when the ROADMAP's topology-reasoning work lands, it can be
+validated against fleets whose distance structure is the scheduler's
+own.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from container_engine_accelerators_tpu.scheduler import topology as topo
+
+# Link tiers, from the production distance function's point of view.
+TIER_ICI = "ici"              # same slice: ICI mesh hops, no DCN
+TIER_INTRA_RACK = "intra-rack"  # same rack, different slice: one DCN tier
+TIER_CROSS_RACK = "cross-rack"  # different rack: the expensive links
+
+
+@dataclasses.dataclass
+class NodeSpec:
+    """One simulated host: identity, placement, and its chip complement."""
+
+    name: str
+    rack: str = "r0"
+    cluster: str = "c0"
+    placement_group: str = "pg0"
+    slice_id: Optional[str] = None  # defaults to the node name (1 host/slice)
+    chips: int = 4
+    topology: str = "2x2x1"
+    partition_size: str = ""  # e.g. "2x2" → sub-slice devices
+
+    def labels(self) -> Dict[str, str]:
+        """The label set label_nodes.py would stamp on this host."""
+        return {
+            topo.PLACEMENT_GROUP_LABEL: self.placement_group,
+            topo.CLUSTER_LABEL: self.cluster,
+            topo.RACK_LABEL: self.rack,
+            topo.HOST_LABEL: self.name,
+            topo.SLICE_LABEL: self.slice_id or self.name,
+            topo.COORDS_LABEL: "0,0,0",
+            topo.TPU_TOPOLOGY_LABEL: self.topology,
+        }
+
+    def node_info(self) -> dict:
+        """The shape scheduler.topology functions consume."""
+        return {"node_labels": self.labels()}
+
+
+class FleetTopology:
+    """The fleet's node set plus selector and distance queries."""
+
+    def __init__(self, specs: List[NodeSpec]):
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate node names in fleet: {names}")
+        self.specs: Dict[str, NodeSpec] = {s.name: s for s in specs}
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.specs
+
+    def names(self) -> List[str]:
+        return list(self.specs)
+
+    def select(self, selector: str) -> List[str]:
+        """Resolve a fleet selector to node names.
+
+        ``*`` = every node, ``node:<name>`` = that node,
+        ``rack:<name>`` = every node in the rack.  Unknown selectors
+        resolve empty (a scenario naming a missing rack should produce
+        an empty fault, not a crash mid-run).
+        """
+        if selector == "*":
+            return self.names()
+        kind, _, value = selector.partition(":")
+        if kind == "node":
+            return [value] if value in self.specs else []
+        if kind == "rack":
+            return [n for n, s in self.specs.items() if s.rack == value]
+        return []
+
+    def distance(self, a: str, b: str) -> float:
+        """Production scheduler distance between two fleet nodes."""
+        return topo.node_topology_distance(
+            self.specs[a].node_info(), self.specs[b].node_info()
+        )
+
+    def tier(self, a: str, b: str) -> str:
+        """Classify the (a, b) link by the production distance: below
+        the DCN floor is ICI; at/above it, same-rack labels are one
+        tier, cross-rack the other."""
+        if self.distance(a, b) < topo.DCN_MIN:
+            return TIER_ICI
+        if self.specs[a].rack == self.specs[b].rack:
+            return TIER_INTRA_RACK
+        return TIER_CROSS_RACK
+
+
+def build_specs(
+    num_nodes: int,
+    racks: int = 1,
+    chips: int = 4,
+    topology: str = "2x2x1",
+    partition_size: str = "",
+) -> List[NodeSpec]:
+    """Round-robin ``num_nodes`` hosts over ``racks`` racks — the quick
+    path for scenario specs that give counts instead of explicit node
+    lists."""
+    if num_nodes < 1 or racks < 1:
+        raise ValueError("need at least one node and one rack")
+    return [
+        NodeSpec(
+            name=f"n{i}",
+            rack=f"r{i % racks}",
+            chips=chips,
+            topology=topology,
+            partition_size=partition_size,
+        )
+        for i in range(num_nodes)
+    ]

@@ -1,0 +1,497 @@
+"""PyXferd — a protocol-faithful Python dcnxferd with a real data plane.
+
+tests/xferd_stub.py models only the control plane: enough to kill and
+restart "a daemon" under a single resilient client, useless for a
+fleet.  PyXferd is the fleet's per-node daemon double, faithful to the
+native daemon's whole contract (native/dcnxferd/dcnxferd.cc):
+
+- newline-JSON control ops over a UDS (register/record/release/stats/
+  ping/version/data_port/send/read), flows owned by their registering
+  connection (buffer lifetime == connection lifetime, like rxdm);
+- a real TCP data plane: ``put`` frames land over it byte-identical to
+  the native daemon's framing, and ``send`` streams a staged flow to a
+  peer daemon — directly over TCP when standalone (cross-process
+  rigs), or through the :class:`~…fleet.links.FleetNet` link table
+  when part of a fleet (per-link faults + accounting);
+
+plus the two protocol extensions this stack adds (ROADMAP "DCN
+data-plane idempotence", "trace context across processes"):
+
+- **frame sequencing + dedup**: ``send`` frames carry the client's
+  per-flow monotonic ``seq`` in a v2 frame header; the receiver keeps a
+  per-flow window of seqs that actually LANDED and drops replays, so a
+  retried send after a connection loss cannot double-land a frame —
+  while a retransmit of a frame that was genuinely lost (never landed)
+  passes.  Dups count as ``dcn.frames.deduped``.
+- **trace propagation**: control requests carry the client's active
+  (trace, span); data frames carry the sender's — every daemon-side
+  span joins the trace of the op that caused it, so one cross-node
+  transfer is ONE trace across every process it touched.
+
+Frame wire format (data plane):
+
+    v1 (native-compatible): "DXF1" | u32 LE name_len | u64 LE
+        payload_len | name | payload
+    v2 (seq + meta):        "DXF2" | u32 LE name_len | u64 LE
+        payload_len | u64 LE seq | u32 LE meta_len | name |
+        meta (JSON: trace/span/src) | payload
+
+Receivers accept both; v1 frames (the native daemon, local ``put``
+staging) have no seq and bypass dedup — exactly what a restage wants.
+"""
+
+import base64
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import trace
+
+log = logging.getLogger(__name__)
+
+VERSION = "pyxferd/2"
+SOCKET_NAME = "xferd.sock"
+READ_CAP = 512 << 10  # per-call read cap, like the native daemon
+DEDUP_WINDOW = 64  # landed-seq memory per flow
+
+_MAGIC_V1 = b"DXF1"
+_MAGIC_V2 = b"DXF2"
+
+
+class _Flow:
+    __slots__ = ("owner", "peer", "buffer_bytes", "transferred",
+                 "rx_bytes", "frame_bytes", "staged", "seen_seqs",
+                 "max_seq")
+
+    def __init__(self, owner: int, peer: str, buffer_bytes: int):
+        self.owner = owner
+        self.peer = peer
+        self.buffer_bytes = buffer_bytes
+        self.transferred = 0
+        self.rx_bytes = 0
+        self.frame_bytes = 0
+        self.staged = b""
+        self.seen_seqs = set()
+        self.max_seq = 0
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("data connection closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def encode_frame(flow: str, payload: bytes, seq: Optional[int] = None,
+                 meta: Optional[dict] = None) -> bytes:
+    """Build a wire frame: v1 when seq is None (native-compatible), v2
+    otherwise."""
+    name = flow.encode()
+    if seq is None:
+        return (_MAGIC_V1 + struct.pack("<I", len(name))
+                + struct.pack("<Q", len(payload)) + name + payload)
+    meta_b = json.dumps(meta or {}).encode()
+    return (_MAGIC_V2 + struct.pack("<I", len(name))
+            + struct.pack("<Q", len(payload)) + struct.pack("<Q", seq)
+            + struct.pack("<I", len(meta_b)) + name + meta_b + payload)
+
+
+class PyXferd:
+    """One emulated node's transfer daemon."""
+
+    def __init__(self, uds_dir: str, node: str = "", net=None,
+                 data_host: str = "127.0.0.1"):
+        self.uds_dir = uds_dir
+        self.node = node
+        self.net = net
+        self.data_host = data_host
+        self.sock_path = os.path.join(uds_dir, SOCKET_NAME)
+        self.data_port = 0
+        self.generation = 0
+        self._flows: Dict[str, _Flow] = {}
+        self._total_transferred = 0
+        self._unmatched = 0
+        self._lock = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._data_server: Optional[socket.socket] = None
+        self._conns = set()
+        self._stopping = threading.Event()
+        # Test hook: {op: n} — process the next n requests of `op`, then
+        # sever the connection BEFORE responding (a daemon that did the
+        # work but whose answer was lost: the replay-dedup scenario).
+        self._drop_response: Dict[str, int] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "PyXferd":
+        os.makedirs(self.uds_dir, exist_ok=True)
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)  # the real daemon unlinks-then-binds
+        self._stopping.clear()
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.sock_path)
+        srv.listen(16)
+        self._server = srv
+        dsrv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        dsrv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        dsrv.bind((self.data_host, 0))
+        dsrv.listen(16)
+        self._data_server = dsrv
+        self.data_port = dsrv.getsockname()[1]
+        self.generation += 1
+        for target, name in ((self._accept_loop, "pyxferd-ctl"),
+                             (self._data_accept_loop, "pyxferd-data")):
+            threading.Thread(target=target, name=f"{name}-{self.node}",
+                             daemon=True).start()
+        return self
+
+    def stop(self, *, crash: bool = False) -> None:
+        """``crash=True`` models SIGKILL: connections die, the socket
+        path lingers until the next start() unlinks it."""
+        self._stopping.set()
+        for attr in ("_server", "_data_server"):
+            srv = getattr(self, attr)
+            if srv is not None:
+                try:
+                    try:
+                        srv.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    srv.close()
+                finally:
+                    setattr(self, attr, None)
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+        if not crash and os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+        # Process death: all staging buffers, seqs windows, accounting
+        # die with it — exactly what the restart chaos scenarios need.
+        with self._lock:
+            self._flows.clear()
+            self._total_transferred = 0
+            self._unmatched = 0
+
+    # -- control plane -------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        srv = self._server
+        while not self._stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            if self._stopping.is_set():
+                conn.close()
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name=f"pyxferd-conn-{self.node}",
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        conn_id = id(conn)
+        with self._lock:
+            self._conns.add(conn)
+        rfile = conn.makefile("r")
+        try:
+            for line in rfile:
+                req = None
+                try:
+                    req = json.loads(line)
+                    resp = self._handle(conn_id, req)
+                except (ValueError, KeyError, TypeError) as e:
+                    resp = {"ok": False, "error": f"bad request: {e}"}
+                op = req.get("op") if isinstance(req, dict) else None
+                if op and self._drop_response.get(op, 0) > 0:
+                    # The work is DONE; the answer is lost.  Sever so
+                    # the client's retry exercises the dedup window.
+                    self._drop_response[op] -= 1
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    break
+                try:
+                    conn.sendall((json.dumps(resp) + "\n").encode())
+                except OSError:
+                    break
+        finally:
+            rfile.close()
+            conn.close()
+            with self._lock:
+                self._conns.discard(conn)
+            self._release_owned(conn_id)
+
+    def drop_response_once(self, op: str, times: int = 1) -> None:
+        """Arm the lost-response hook for the next ``times`` ``op``
+        requests (chaos tests)."""
+        self._drop_response[op] = self._drop_response.get(op, 0) + times
+
+    def _release_owned(self, conn_id: int) -> None:
+        with self._lock:
+            for name in [n for n, f in self._flows.items()
+                         if f.owner == conn_id]:
+                del self._flows[name]
+
+    def _handle(self, conn_id: int, req: dict) -> dict:
+        op = req.get("op")
+        # Join the client's trace: daemon-side work hangs off the
+        # control round trip that asked for it, across the process
+        # boundary.
+        with trace.attach(req.get("trace"), req.get("span")):
+            with trace.span("xferd.op", op=op, node=self.node):
+                return self._dispatch(conn_id, op, req)
+
+    def _dispatch(self, conn_id: int, op: str, req: dict) -> dict:
+        if op == "version":
+            return {"ok": True, "version": VERSION, "frame_version": 2}
+        if op == "ping":
+            return {"ok": True}
+        if op == "data_port":
+            return {"ok": True, "port": self.data_port}
+        if op == "register_flow":
+            flow = req["flow"]
+            with self._lock:
+                if flow in self._flows:
+                    return {"ok": False,
+                            "error": f"flow already exists: {flow}"}
+                nbytes = int(req.get("bytes") or 4096)
+                self._flows[flow] = _Flow(conn_id, req.get("peer", ""),
+                                          nbytes)
+            return {"ok": True, "flow": flow, "buffer_bytes": nbytes}
+        if op == "record_transfer":
+            nbytes = req.get("bytes")
+            if not isinstance(nbytes, int) or nbytes < 0:
+                return {"ok": False, "error": "invalid 'bytes'"}
+            with self._lock:
+                f = self._flows.get(req["flow"])
+                if f is None:
+                    return {"ok": False, "error": "unknown flow"}
+                if f.owner != conn_id:
+                    return {"ok": False,
+                            "error": "flow owned by another client"}
+                f.transferred += nbytes
+                self._total_transferred += nbytes
+                return {"ok": True, "flow_bytes": f.transferred}
+        if op == "release_flow":
+            with self._lock:
+                f = self._flows.get(req["flow"])
+                if f is None:
+                    return {"ok": False, "error": "unknown flow"}
+                if f.owner != conn_id:
+                    return {"ok": False,
+                            "error": "flow owned by another client"}
+                del self._flows[req["flow"]]
+            return {"ok": True}
+        if op == "read":
+            return self._read(req)
+        if op == "send":
+            return self._send(req)
+        if op == "stats":
+            return self._stats()
+        return {"ok": False, "error": f"unknown op: {op}"}
+
+    def _read(self, req: dict) -> dict:
+        nbytes = int(req.get("bytes") or 0)
+        offset = int(req.get("offset") or 0)
+        with self._lock:
+            f = self._flows.get(req["flow"])
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            staged = f.staged
+            frame_bytes = f.frame_bytes
+        if offset > len(staged):
+            return {"ok": False,
+                    "error": f"'offset' beyond staged data "
+                             f"(frame_bytes={frame_bytes})"}
+        chunk = staged[offset:offset + min(nbytes, READ_CAP)]
+        return {"ok": True, "data": base64.b64encode(chunk).decode(),
+                "frame_bytes": frame_bytes}
+
+    def _send(self, req: dict) -> dict:
+        flow = req["flow"]
+        host = req.get("host", "127.0.0.1")
+        port = int(req["port"])
+        seq = req.get("seq")
+        seq = int(seq) if seq is not None else None
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            payload = f.staged
+        if not payload:
+            return {"ok": False,
+                    "error": f"nothing staged for flow {flow!r}"}
+        nbytes = int(req.get("bytes") or len(payload))
+        payload = payload[:nbytes]
+        t0 = time.monotonic()
+        with trace.span("xferd.send", histogram="xferd.send", flow=flow,
+                        node=self.node, dst=f"{host}:{port}", seq=seq,
+                        bytes=len(payload)) as span:
+            meta = {"src": self.node}
+            ctx = trace.context()
+            if ctx is not None:
+                meta.update(ctx)
+            try:
+                if self.net is not None:
+                    # Fleet mode: EVERY frame goes through the link
+                    # table — a port the fabric doesn't know (stale
+                    # after a peer restart, node down) is a dead link,
+                    # never a raw TCP dial around the fault surface.
+                    verdict = self.net.deliver(self.node, host, port,
+                                               flow, payload, seq, meta)
+                    span.annotate(verdict=verdict)
+                else:
+                    self._tcp_send(host, port, flow, payload, seq, meta)
+            except OSError as e:
+                return {"ok": False, "error": f"send failed: {e}"}
+        micros = max(1.0, (time.monotonic() - t0) * 1e6)
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is not None:
+                f.transferred += len(payload)
+                self._total_transferred += len(payload)
+        return {"ok": True, "bytes": len(payload),
+                "micros": round(micros, 1),
+                "gbps": round(len(payload) * 8 / micros / 1e3, 3)}
+
+    def _tcp_send(self, host: str, port: int, flow: str, payload: bytes,
+                  seq: Optional[int], meta: dict) -> None:
+        frame = encode_frame(flow, payload, seq, meta)
+        with socket.create_connection((host, port), timeout=30) as s:
+            s.sendall(frame)
+
+    def _stats(self) -> dict:
+        with self._lock:
+            return {
+                "ok": True,
+                "active_flows": len(self._flows),
+                "total_transferred": self._total_transferred,
+                "unmatched_frames": self._unmatched,
+                "generation": self.generation,
+                "node": self.node,
+                "flows": [
+                    {"flow": name, "peer": f.peer,
+                     "transferred": f.transferred,
+                     "rx_bytes": f.rx_bytes,
+                     "frame_bytes": f.frame_bytes,
+                     "max_seq": f.max_seq}
+                    for name, f in self._flows.items()
+                ],
+            }
+
+    # -- data plane ----------------------------------------------------------
+
+    def _data_accept_loop(self) -> None:
+        srv = self._data_server
+        while not self._stopping.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            if self._stopping.is_set():
+                conn.close()
+                return
+            threading.Thread(target=self._serve_data_conn, args=(conn,),
+                             name=f"pyxferd-dconn-{self.node}",
+                             daemon=True).start()
+
+    def _serve_data_conn(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(conn)
+        try:
+            while not self._stopping.is_set():
+                try:
+                    magic = _recv_exact(conn, 4)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    flow, payload, seq, meta = self._read_frame(conn, magic)
+                except (ConnectionError, OSError, ValueError) as e:
+                    log.error("bad data-plane frame: %s", e)
+                    return
+                self.land_frame(flow, payload, seq, meta)
+        finally:
+            conn.close()
+            with self._lock:
+                self._conns.discard(conn)
+
+    def _read_frame(self, conn: socket.socket, magic: bytes
+                    ) -> Tuple[str, bytes, Optional[int], dict]:
+        if magic == _MAGIC_V1:
+            name_len = struct.unpack("<I", _recv_exact(conn, 4))[0]
+            payload_len = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+            seq, meta_len = None, 0
+        elif magic == _MAGIC_V2:
+            name_len = struct.unpack("<I", _recv_exact(conn, 4))[0]
+            payload_len = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+            seq = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+            meta_len = struct.unpack("<I", _recv_exact(conn, 4))[0]
+        else:
+            raise ValueError(f"unknown frame magic {magic!r}")
+        if name_len > 4096 or payload_len > (1 << 31) or meta_len > 65536:
+            raise ValueError("frame header out of bounds")
+        flow = _recv_exact(conn, name_len).decode()
+        meta = {}
+        if meta_len:
+            try:
+                meta = json.loads(_recv_exact(conn, meta_len))
+            except ValueError:
+                meta = {}
+        payload = _recv_exact(conn, payload_len)
+        return flow, payload, seq, meta
+
+    def land_frame(self, flow: str, payload: bytes,
+                   seq: Optional[int] = None, meta: Optional[dict] = None,
+                   link: Optional[Tuple[str, str]] = None) -> str:
+        """Land one frame into a flow's staging buffer.
+
+        Returns "landed", "dup" (seq already landed — dropped without
+        touching accounting, the exactly-once half of frame
+        sequencing), or "unmatched" (no such flow registered here).
+        Landing joins the SENDER's trace via the frame meta.
+        """
+        meta = meta or {}
+        with trace.attach(meta.get("trace"), meta.get("span")):
+            with trace.span("xferd.land", histogram="xferd.land",
+                            flow=flow, node=self.node, seq=seq,
+                            bytes=len(payload),
+                            src=meta.get("src", "")) as span:
+                with self._lock:
+                    f = self._flows.get(flow)
+                    if f is None:
+                        self._unmatched += 1
+                        span.annotate(verdict="unmatched")
+                        return "unmatched"
+                    if seq is not None:
+                        if (seq in f.seen_seqs
+                                or (f.max_seq - seq) >= DEDUP_WINDOW):
+                            span.annotate(verdict="dup")
+                            counters.inc("dcn.frames.deduped")
+                            return "dup"
+                        f.seen_seqs.add(seq)
+                        f.max_seq = max(f.max_seq, seq)
+                        # Bound the window: forget seqs that fell out.
+                        if len(f.seen_seqs) > 2 * DEDUP_WINDOW:
+                            floor = f.max_seq - DEDUP_WINDOW
+                            f.seen_seqs = {s for s in f.seen_seqs
+                                           if s >= floor}
+                    f.staged = bytes(payload)
+                    f.frame_bytes = len(payload)
+                    f.rx_bytes += len(payload)
+                span.annotate(verdict="landed")
+                return "landed"

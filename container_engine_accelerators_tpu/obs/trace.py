@@ -26,6 +26,22 @@ JSONL schema (one line per span)::
 ``ts`` is wall-clock (correlation with logs/Prometheus scrapes);
 ``dur_us`` comes from the monotonic clock (immune to NTP steps).
 
+Cross-process context: a trace is not bounded by one process.  A parent
+process (the fleet coordinator, a test rig) exports
+``TPU_TRACE_CONTEXT="<trace>:<span>"``; children call
+:func:`attach_from_env` so their root spans join the parent's trace.
+The DCN control protocol and the fleet data-plane frames carry the same
+pair, so one cross-node transfer reads as ONE trace across every
+process it touched (merge the JSONLs with ``cmd/agent_trace.py a.jsonl
+b.jsonl --trace ID``).
+
+Head sampling: ``TPU_TRACE_SAMPLE=<rate>`` (0.0–1.0) samples whole
+traces into the JSONL sink by a deterministic hash of the trace id, so
+every span of one trace — in every process, because the id travels —
+shares a fate.  The in-memory ring is NOT sampled (the flight recorder
+must always have the tail).  A malformed rate degrades to
+sample-everything: a config typo must never blind a node agent.
+
 Kept stdlib-only, like metrics/counters.py, so utils/ and parallel/
 import it without dragging in prometheus_client or grpc.  A sink write
 failure is logged once and disables the sink — tracing must never take
@@ -45,6 +61,8 @@ log = logging.getLogger(__name__)
 
 TRACE_FILE_ENV = "TPU_TRACE_FILE"
 RING_CAPACITY_ENV = "TPU_TRACE_RING"
+TRACE_SAMPLE_ENV = "TPU_TRACE_SAMPLE"
+TRACE_CONTEXT_ENV = "TPU_TRACE_CONTEXT"
 DEFAULT_RING_CAPACITY = 512
 
 
@@ -110,10 +128,55 @@ _ring: "deque[Dict[str, Any]]" = deque(
 # resolved-off, file object = resolved-on.
 _sink = None
 _sink_path: Optional[str] = None
+# Sample rate: None = unresolved (consult env on next span).
+_sample_rate: Optional[float] = None
 
 
 def _new_id(nbytes: int) -> str:
     return os.urandom(nbytes).hex()
+
+
+def _resolve_sample_rate() -> float:
+    """Parse TPU_TRACE_SAMPLE once.  Anything that is not a float in
+    [0, 1] degrades to 1.0 (sample everything) — the TPU_FAULT_SPEC
+    rule: a config typo must never blind a node agent."""
+    global _sample_rate
+    if _sample_rate is None:
+        raw = os.environ.get(TRACE_SAMPLE_ENV)
+        if raw is None:
+            _sample_rate = 1.0
+        else:
+            try:
+                rate = float(raw)
+                if not 0.0 <= rate <= 1.0:
+                    raise ValueError("rate outside [0, 1]")
+                _sample_rate = rate
+            except ValueError as e:
+                log.error("ignoring malformed %s=%r (%s); sampling "
+                          "everything", TRACE_SAMPLE_ENV, raw, e)
+                _sample_rate = 1.0
+    return _sample_rate
+
+
+# Hash denominator for the head-sampling decision: the first 8 hex chars
+# of the trace id interpreted as an integer, uniform over 32 bits.
+_SAMPLE_MOD = 1 << 32
+
+
+def sampled(trace_id: str) -> bool:
+    """Head-sampling decision for a whole trace, deterministic by trace
+    id — every span of the trace, in every process the id travels to,
+    shares one fate.  Non-hex (foreign) ids sample in: losing evidence
+    is worse than an oversized JSONL."""
+    rate = _resolve_sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    try:
+        return int(trace_id[:8], 16) < rate * _SAMPLE_MOD
+    except (ValueError, TypeError):
+        return True
 
 
 def _stack() -> List[Span]:
@@ -160,7 +223,11 @@ def _record(span: Span) -> None:
     d = span.to_dict()
     global _sink
     with _lock:
+        # The ring is never sampled: the flight recorder's tail must
+        # exist even at aggressive sink sampling rates.
         _ring.append(d)
+        if not sampled(span.trace_id):
+            return
         sink = _resolve_sink()
         if sink:
             try:
@@ -212,6 +279,62 @@ def event(name: str, **attrs: Any) -> None:
         pass
 
 
+@contextlib.contextmanager
+def attach(trace_id: Optional[str], parent_span_id: Optional[str] = None):
+    """Join a trace started elsewhere (another process, the far side of
+    a DCN transfer): spans opened inside the block carry ``trace_id``
+    and hang off ``parent_span_id``.  The placeholder itself is never
+    recorded — the remote side already owns that span.  A falsy
+    ``trace_id`` makes this a no-op, so protocol handlers can pass
+    whatever the wire carried without checking."""
+    if not trace_id:
+        yield None
+        return
+    s = Span("remote", trace_id=str(trace_id),
+             span_id=str(parent_span_id) if parent_span_id else _new_id(4),
+             parent_id=None, attrs={})
+    stack = _stack()
+    stack.append(s)
+    try:
+        yield s
+    finally:
+        stack.pop()
+
+
+def context() -> Optional[Dict[str, str]]:
+    """The active (trace, span) pair as wire/env fields, or None.  What
+    the DCN client stamps on control requests and the fleet daemon
+    stamps on data-plane frames."""
+    cur = current()
+    if cur is None:
+        return None
+    return {"trace": cur.trace_id, "span": cur.span_id}
+
+
+def context_env() -> Optional[str]:
+    """The active context in TPU_TRACE_CONTEXT form ("<trace>:<span>"),
+    for a coordinator exporting it to child processes."""
+    cur = current()
+    if cur is None:
+        return None
+    return f"{cur.trace_id}:{cur.span_id}"
+
+
+def attach_from_env(env=None):
+    """Context manager joining the trace named by TPU_TRACE_CONTEXT
+    ("<trace>:<span>", set by the process that spawned us).  Unset or
+    malformed values yield a no-op attach — a worker must boot with or
+    without a coordinator."""
+    env = env if env is not None else os.environ
+    raw = env.get(TRACE_CONTEXT_ENV, "")
+    trace_id, _, span_id = raw.partition(":")
+    if raw and (not trace_id or not span_id or ":" in span_id):
+        log.error("ignoring malformed %s=%r (want '<trace>:<span>')",
+                  TRACE_CONTEXT_ENV, raw)
+        trace_id = span_id = ""
+    return attach(trace_id or None, span_id or None)
+
+
 def tail(n: Optional[int] = None) -> List[Dict[str, Any]]:
     """The last ``n`` completed spans (all buffered ones when None),
     oldest first — what the flight recorder dumps."""
@@ -226,7 +349,7 @@ def configure(path: Optional[str] = None,
     span) and optionally resize the ring.  Tests and long-lived agents
     rotating their trace file use this; plain processes just set
     ``TPU_TRACE_FILE`` before the first span."""
-    global _sink, _sink_path, _ring
+    global _sink, _sink_path, _ring, _sample_rate
     with _lock:
         if _sink:
             try:
@@ -235,14 +358,16 @@ def configure(path: Optional[str] = None,
                 pass
         _sink = None
         _sink_path = path
+        _sample_rate = None  # re-resolve TPU_TRACE_SAMPLE too
         if ring_capacity is not None:
             _ring = deque(_ring, maxlen=ring_capacity)
 
 
 def reset() -> None:
-    """Drop buffered spans and forget the resolved sink (test
-    isolation; the next span re-reads TPU_TRACE_FILE)."""
-    global _sink, _sink_path
+    """Drop buffered spans and forget the resolved sink and sample rate
+    (test isolation; the next span re-reads TPU_TRACE_FILE /
+    TPU_TRACE_SAMPLE)."""
+    global _sink, _sink_path, _sample_rate
     with _lock:
         _ring.clear()
         if _sink:
@@ -252,3 +377,4 @@ def reset() -> None:
                 pass
         _sink = None
         _sink_path = None
+        _sample_rate = None

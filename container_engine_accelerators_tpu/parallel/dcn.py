@@ -156,12 +156,17 @@ def exchange_shard(
     landing flow or the payload counts as unmatched and is dropped
     (``multihost_utils.sync_global_devices`` in real workers).
 
-    With a resilient client the leg survives a daemon restart up to and
-    during ``put`` (flows are replayed on reconnect; ``put``'s retry
-    budget restages the payload).  A restart in the window *after* a
-    completed put loses the staged bytes — the replayed flow comes back
-    empty and the rx wait times out; callers retry the whole leg
-    (restaging transparently is a ROADMAP open item).
+    With a resilient client the leg survives a daemon restart at any
+    point on the LOCAL side: flows are replayed on reconnect, ``put``'s
+    retry budget restages during staging, and a restart after a
+    completed put is healed by the client itself — a ``send`` that hits
+    the restarted daemon's blank staging restages the cached payload
+    and re-sends under the same frame seq, and ``read`` does the
+    equivalent for read-back (``dcn.send.restaged`` /
+    ``dcn.read.restaged``).  What no client can heal alone is the
+    PEER's staged shard dying with the peer daemon after it landed —
+    the rx wait times out and callers retry the whole leg, which asks
+    the peer to re-send.
     """
     from container_engine_accelerators_tpu.obs import trace
     from container_engine_accelerators_tpu.parallel.dcn_client import (

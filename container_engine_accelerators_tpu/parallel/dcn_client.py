@@ -44,6 +44,9 @@ class DcnXferClient:
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._broken = False
+        # Per-flow monotonic frame sequence for `send` (client-owned:
+        # it must survive daemon restarts, which reset daemon state).
+        self._send_seq: Dict[str, int] = {}
         self._connect()
 
     def _connect(self) -> None:
@@ -86,6 +89,15 @@ class DcnXferClient:
                 )
             try:
                 faults.check("dcn.send")
+                # Stamp the active trace on the request: daemons that
+                # understand it (fleet/xferd.py) join their spans to
+                # this trace, so one cross-node transfer reads as ONE
+                # story across processes.  The native daemon ignores
+                # unknown fields.
+                ctx = trace.context()
+                if ctx is not None:
+                    req.setdefault("trace", ctx["trace"])
+                    req.setdefault("span", ctx["span"])
                 self._sock.sendall((json.dumps(req) + "\n").encode())
                 line = self._rfile.readline()
             except (socket.timeout, OSError) as e:
@@ -123,6 +135,9 @@ class DcnXferClient:
 
     def release_flow(self, flow: str) -> None:
         self._call(op="release_flow", flow=flow)
+        # A re-registered flow is a fresh incarnation on both ends:
+        # its frame numbering restarts with it.
+        self._send_seq.pop(flow, None)
 
     def data_port(self) -> int:
         """TCP port of the daemon's data-plane listener."""
@@ -135,8 +150,18 @@ class DcnXferClient:
         Returns {bytes, micros, gbps}.  This is the DCN data path the
         reference drives through its NCCL plugin; here the daemon itself
         moves the bytes and reports achieved throughput.
+
+        Each call stamps the frame with a per-flow monotonic ``seq`` —
+        assigned ONCE per send() invocation, so a transport-level replay
+        of the same op (the resilient client retrying after a connection
+        loss) re-sends the SAME seq and a dedup-aware receiver
+        (fleet/xferd.py) lands the frame exactly once.  A caller-level
+        retry of a whole leg is a new send() and a new frame.
         """
-        req = {"op": "send", "flow": flow, "host": host, "port": str(port)}
+        seq = self._send_seq.get(flow, 0) + 1
+        self._send_seq[flow] = seq
+        req = {"op": "send", "flow": flow, "host": host, "port": str(port),
+               "seq": seq}
         if nbytes is not None:
             req["bytes"] = nbytes
         return self._call(**req)
@@ -248,6 +273,11 @@ class ResilientDcnXferClient(DcnXferClient):
     ):
         self._retry = retry or DEFAULT_DCN_RETRY
         self._flows: Dict[str, dict] = {}
+        # Last payload this client staged per flow (via put): the daemon
+        # loses its staging buffers on restart, so a post-restart read
+        # transparently restages from here instead of surfacing an empty
+        # frame to the caller.  Dropped on release_flow.
+        self._staged: Dict[str, bytes] = {}
         self._exhausted = False
         # The initial connect rides the same budget: the client may come
         # up before its node sidecar does.
@@ -371,6 +401,7 @@ class ResilientDcnXferClient(DcnXferClient):
     def release_flow(self, flow: str) -> None:
         super().release_flow(flow)
         self._flows.pop(flow, None)
+        self._staged.pop(flow, None)
 
     def put(self, flow: str, data: bytes, host: str = "127.0.0.1",
             port: Optional[int] = None) -> None:
@@ -388,6 +419,90 @@ class ResilientDcnXferClient(DcnXferClient):
                 state["port"] = None
                 raise
 
-        return self._with_budget(attempt, "data plane", latch=False,
-                                 op="put")
+        result = self._with_budget(attempt, "data plane", latch=False,
+                                   op="put")
+        self._staged[flow] = bytes(data)
+        return result
+
+    # How long a restage waits for its own payload to finish landing
+    # through the local data plane before re-reading/re-sending.
+    RESTAGE_RX_TIMEOUT_S = 30.0
+
+    def send(self, flow: str, host: str, port: int,
+             nbytes: Optional[int] = None) -> dict:
+        """`send` that survives the daemon losing the staged payload.
+
+        A send issued (or retried) after a connection loss lands on a
+        daemon whose flow table was replayed but whose staging buffers
+        are gone (a restarted daemon, or the old one releasing the flow
+        with the dead connection).  The native daemon would silently
+        stream the blank buffer — zero-filled bytes to the peer — so
+        when this client staged the payload itself it FIRST checks the
+        flow's ``frame_bytes`` and restages on blank; a daemon that
+        instead answers "nothing staged" (fleet/xferd.py) is healed
+        reactively the same way.  The re-send reuses the frame seq the
+        failed attempt burned: if that attempt actually delivered
+        before its response was lost, the receiver's dedup window drops
+        the replay — exactly-once either way."""
+        data = self._staged.get(flow)
+        if data is not None:
+            st = next((f for f in self.stats()["flows"]
+                       if f["flow"] == flow), None)
+            if st is not None and not st.get("frame_bytes", len(data)):
+                self._restage(flow, data)
+        try:
+            return super().send(flow, host, port, nbytes)
+        except DcnXferError as e:
+            if "nothing staged" not in str(e) or data is None:
+                raise
+            self._restage(flow, data)
+            # Re-issue under the seq the failed attempt burned.
+            self._send_seq[flow] -= 1
+            return super().send(flow, host, port, nbytes)
+
+    def _restage(self, flow: str, data: bytes) -> None:
+        counters.inc("dcn.send.restaged")
+        with trace.span("dcn.restage", histogram="dcn.restage",
+                        flow=flow, bytes=len(data), op="send"):
+            self.put(flow, data)
+            self._wait_rx(flow, len(data), self.RESTAGE_RX_TIMEOUT_S)
+
+    def read(self, flow: str, nbytes: int, offset: int = 0) -> bytes:
+        """`read` that survives a daemon restart eating the staged
+        frame: an EMPTY read of a flow this client itself staged means
+        the daemon came back with fresh (blank) buffers — replaying the
+        flow table restored the registration but not the bytes.  The
+        client restages the cached payload through the data plane, waits
+        for it to land, and reads again, so callers never see the
+        daemon's "nothing staged" for payloads they already handed us.
+        (Reads of peer-landed flows have no local cache and still
+        surface the blank — only the peer can re-send those bytes.)"""
+        data = self._staged.get(flow)
+        try:
+            out = super().read(flow, nbytes, offset)
+            if out or nbytes <= 0 or data is None:
+                return out
+        except DcnXferError as e:
+            # The native daemon answers a blank flow with an explicit
+            # "no completed frame" error; PyXferd and the stub with an
+            # empty read.  Both mean the same thing: the staging went
+            # with the old process.
+            if data is None or "no completed frame" not in str(e):
+                raise
+        counters.inc("dcn.read.restaged")
+        with trace.span("dcn.restage", histogram="dcn.restage",
+                        flow=flow, bytes=len(data)):
+            self.put(flow, data)
+            self._wait_rx(flow, len(data), self.RESTAGE_RX_TIMEOUT_S)
+        return super().read(flow, nbytes, offset)
+
+    def _wait_rx(self, flow: str, nbytes: int, timeout_s: float) -> None:
+        """parallel.dcn.wait_flow_rx under this client's error contract
+        (lazy import mirrors dcn.py's own lazy import of this module)."""
+        from container_engine_accelerators_tpu.parallel import dcn
+
+        try:
+            dcn.wait_flow_rx(self, flow, nbytes, timeout_s=timeout_s)
+        except TimeoutError as e:
+            raise DcnXferError(f"restage failed: {e}")
 

@@ -1,0 +1,84 @@
+"""Worker for the cross-process trace test (tests/test_fleet.py).
+
+Two of these run as separate processes — one per "node" — each with
+its OWN TPU_TRACE_FILE and its own PyXferd daemon, doing one real DCN
+transfer over TCP between them.  The launching test exports
+TPU_TRACE_CONTEXT, so both workers' root spans join the coordinator's
+trace; the data-plane frame carries the sender's context, so the
+receiver daemon's landing span joins it too.  The test then proves the
+ISSUE's bar: one trace id on both sides' JSONL, merged by
+cmd/agent_trace.py.
+
+Env contract (set by the test):
+  FLEET_ROLE        "send" | "recv"
+  FLEET_WORKDIR     shared scratch dir (port handshake file lives here)
+  FLEET_PAYLOAD     payload size in bytes
+  TPU_TRACE_FILE    this worker's span JSONL
+  TPU_TRACE_CONTEXT coordinator trace context ("<trace>:<span>")
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.fleet.xferd import PyXferd  # noqa: E402
+from container_engine_accelerators_tpu.obs import trace  # noqa: E402
+from container_engine_accelerators_tpu.parallel import dcn  # noqa: E402
+from container_engine_accelerators_tpu.parallel.dcn_client import (  # noqa: E402
+    ResilientDcnXferClient,
+)
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy  # noqa: E402
+
+FLOW = "xproc"
+RETRY = RetryPolicy(max_attempts=8, initial_backoff_s=0.02,
+                    max_backoff_s=0.2, deadline_s=20.0)
+
+
+def main() -> None:
+    role = os.environ["FLEET_ROLE"]
+    workdir = os.environ["FLEET_WORKDIR"]
+    nbytes = int(os.environ.get("FLEET_PAYLOAD", "4096"))
+    payload = bytes(range(256)) * (nbytes // 256)
+    port_file = os.path.join(workdir, "recv.port")
+
+    daemon = PyXferd(os.path.join(workdir, f"{role}-dcn"),
+                     node=role).start()
+    try:
+        with trace.attach_from_env():
+            with trace.span(f"fleet.worker.{role}", node=role):
+                client = ResilientDcnXferClient(daemon.uds_dir,
+                                                retry=RETRY)
+                with client as c:
+                    c.register_flow(FLOW, bytes=len(payload))
+                    if role == "recv":
+                        # Announce readiness AFTER registering: the
+                        # sender must not fire into an unmatched flow.
+                        tmp = port_file + ".tmp"
+                        with open(tmp, "w") as f:
+                            f.write(str(daemon.data_port))
+                        os.rename(tmp, port_file)
+                        dcn.wait_flow_rx(c, FLOW, len(payload),
+                                         timeout_s=60)
+                        got = c.read(FLOW, len(payload))
+                        assert got == payload, "payload corrupted"
+                    else:
+                        deadline = time.monotonic() + 60
+                        while not os.path.exists(port_file):
+                            assert time.monotonic() < deadline, \
+                                "receiver never announced its port"
+                            time.sleep(0.02)
+                        port = int(open(port_file).read())
+                        c.put(FLOW, payload)
+                        dcn.wait_flow_rx(c, FLOW, len(payload),
+                                         timeout_s=60)
+                        c.send(FLOW, "127.0.0.1", port, len(payload))
+    finally:
+        daemon.stop()
+        trace.reset()  # close the JSONL sink cleanly
+    print(f"{role} OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -389,12 +389,15 @@ class TestRealDaemonChaos:
                 proc.wait(timeout=10)
                 proc = self._spawn(uds)
 
-                # Same client, zero manual intervention: put re-resolves
-                # the (new) data port through the reconnected control
-                # plane, the replayed flow lands the restaged payload.
-                c.put("stage", payload)
-                dcn.wait_flow_rx(c, "stage", len(payload))
+                # Same client, zero manual intervention — and no
+                # caller-side put-again workaround: read itself notices
+                # the restarted daemon's blank staging, restages the
+                # cached payload through the data plane (re-resolving
+                # the NEW data port via the reconnected control plane),
+                # waits for it to land, and returns the bytes.
+                restaged0 = counters.get("dcn.read.restaged")
                 assert c.read("stage", len(payload)) == payload
+                assert counters.get("dcn.read.restaged") == restaged0 + 1
         finally:
             if proc.poll() is None:
                 proc.send_signal(signal.SIGTERM)
@@ -688,6 +691,7 @@ class TestHealthRecoveryChaos:
         m.start()
         (slice_id,) = m.list_physical_devices().keys()
 
+        recovered0 = counters.get("health.slice_recovered")
         m.set_device_health("accel0", UNHEALTHY)
         m.set_device_health("accel1", UNHEALTHY)
         assert m.list_physical_devices()[slice_id].health == UNHEALTHY
@@ -695,8 +699,15 @@ class TestHealthRecoveryChaos:
         # One chip back is not enough — the slice needs all four.
         m.set_device_health("accel0", HEALTHY)
         assert m.list_physical_devices()[slice_id].health == UNHEALTHY
+        assert counters.get("health.slice_recovered") == recovered0
         m.set_device_health("accel1", HEALTHY)
         assert m.list_physical_devices()[slice_id].health == HEALTHY
+        # Capacity-returned is its own signal (one per slice heal, not
+        # one per chip): a re-announce of an already-Healthy chip must
+        # not double-count.
+        assert counters.get("health.slice_recovered") == recovered0 + 1
+        m.set_device_health("accel0", HEALTHY)
+        assert counters.get("health.slice_recovered") == recovered0 + 1
 
     def test_event_stream_fault_does_not_kill_monitoring(self, tmp_path):
         """`health.stream:drop@1`: the listener thread absorbs the

@@ -1,0 +1,595 @@
+"""Fleet simulation rig: multi-node chaos, link faults, fleet traces.
+
+The single-node chaos suite (tests/test_chaos.py) proves each agent
+self-heals; this file proves the *fleet* does: N emulated nodes wired
+through a link table, rack partitions and asymmetric loss injected at
+the LINK level (not the endpoint), survivors re-converging once the
+fault clears, frame sequencing delivering exactly once under replay,
+and one trace id spanning every process a transfer touches.
+
+Long scenarios are marked ``slow`` (the tier-1 budget rule); the fast
+units and the headline partition/reconverge + dedup tests stay in the
+default tier.  ``make fleet`` runs the whole file.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import pytest
+
+from container_engine_accelerators_tpu.fleet import (
+    DEFAULT_SCENARIO,
+    EmulatedNode,
+    FleetController,
+    FleetNet,
+    LinkTable,
+    NodeSpec,
+    PyXferd,
+)
+from container_engine_accelerators_tpu.fleet.controller import run_scenario
+from container_engine_accelerators_tpu.fleet.links import parse_link_fault
+from container_engine_accelerators_tpu.fleet.topology import (
+    TIER_CROSS_RACK,
+    TIER_ICI,
+    TIER_INTRA_RACK,
+    FleetTopology,
+    build_specs,
+)
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import trace
+from container_engine_accelerators_tpu.parallel import dcn
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    DcnXferError,
+    ResilientDcnXferClient,
+)
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+from tests.mp_runner import run_procs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_RETRY = RetryPolicy(
+    max_attempts=8, initial_backoff_s=0.01, max_backoff_s=0.1,
+    deadline_s=15.0,
+)
+
+
+def _flow_stat(client, flow):
+    return next(f for f in client.stats()["flows"] if f["flow"] == flow)
+
+
+def _wait_stable_rx(client, flow, expect, settle_s=0.25):
+    """Wait until rx hits ``expect`` and PROVE it stays there — the
+    exactly-once assertions need 'no double-landing', which a plain
+    wait cannot show."""
+    dcn.wait_flow_rx(client, flow, expect, timeout_s=10)
+    deadline = time.monotonic() + settle_s
+    while time.monotonic() < deadline:
+        assert _flow_stat(client, flow)["rx_bytes"] == expect
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# Link-fault spec grammar + topology model
+# ---------------------------------------------------------------------------
+
+
+class TestLinkFaultSpec:
+    def test_bidirectional_partition(self):
+        f = parse_link_fault("rack:r0<->rack:r1:partition")
+        assert (f.sel_a, f.sel_b) == ("rack:r0", "rack:r1")
+        assert f.bidirectional and f.action == "partition"
+
+    def test_directional_latency_ms(self):
+        f = parse_link_fault("node:n0->node:n2:latency:5")
+        assert not f.bidirectional
+        assert f.action == "latency" and f.param == pytest.approx(0.005)
+
+    def test_wildcard_drop(self):
+        f = parse_link_fault("*->rack:r1:drop:3")
+        assert f.sel_a == "*" and f.action == "drop" and f.param == 3
+
+    def test_inverse(self):
+        part = parse_link_fault("rack:r0<->rack:r1:partition")
+        assert part.inverse().action == "heal"
+        lat = parse_link_fault("node:a->node:b:latency:7")
+        assert lat.inverse().param == 0.0
+        assert parse_link_fault("*<->*:drop:2").inverse() is None
+
+    def test_spec_roundtrip_is_json_clean(self):
+        for s in ("rack:r0<->rack:r1:partition", "node:a->node:b:latency:5",
+                  "*->rack:r1:drop:3"):
+            f = parse_link_fault(s)
+            assert parse_link_fault(f.spec()) == f
+
+    @pytest.mark.parametrize("bad", [
+        "garbage", "rack:r0:partition", "rack:r0<->rack:r1:frobnicate",
+        "rack:r0<->rack:r1:latency:-1", "rack:r0<->rack:r1:drop:0",
+        "<->:partition", "node:n0->node:n1:partition:5",
+    ])
+    def test_malformed_specs_never_raise(self, bad):
+        assert parse_link_fault(bad) is None
+
+
+class TestFleetTopology:
+    def _fleet(self):
+        return FleetTopology(build_specs(4, racks=2))
+
+    def test_round_robin_racks(self):
+        topo = self._fleet()
+        assert topo.specs["n0"].rack == "r0"
+        assert topo.specs["n1"].rack == "r1"
+        assert topo.specs["n2"].rack == "r0"
+
+    def test_selectors(self):
+        topo = self._fleet()
+        assert topo.select("*") == ["n0", "n1", "n2", "n3"]
+        assert topo.select("node:n2") == ["n2"]
+        assert topo.select("rack:r1") == ["n1", "n3"]
+        assert topo.select("rack:nope") == []
+        assert topo.select("zone:z1") == []
+
+    def test_tiers_use_production_distance(self):
+        specs = build_specs(4, racks=2)
+        # Two hosts in one slice: ICI territory for the scheduler.
+        specs[2].slice_id = specs[0].slice_id = "sliceX"
+        topo = FleetTopology(specs)
+        assert topo.tier("n0", "n2") == TIER_ICI
+        specs[2].slice_id = None
+        topo = FleetTopology(specs)
+        assert topo.tier("n0", "n2") == TIER_INTRA_RACK  # both r0
+        assert topo.tier("n0", "n1") == TIER_CROSS_RACK
+
+
+class TestLinkTable:
+    def _table(self):
+        return LinkTable(FleetTopology(build_specs(4, racks=2)))
+
+    def test_partition_is_bidirectional_and_heals(self):
+        t = self._table()
+        pairs = t.apply("rack:r0<->rack:r1:partition")
+        assert ("n0", "n1") in pairs and ("n1", "n0") in pairs
+        assert not t.state("n0", "n1").up
+        assert not t.state("n3", "n2").up
+        assert t.state("n0", "n2").up  # intra-rack untouched
+        t.apply("rack:r0<->rack:r1:heal")
+        assert t.state("n0", "n1").up
+
+    def test_directional_fault_leaves_reverse_up(self):
+        t = self._table()
+        t.apply("node:n0->node:n1:partition")
+        assert not t.state("n0", "n1").up
+        assert t.state("n1", "n0").up
+
+    def test_drop_budget_accumulates_and_heal_clears(self):
+        t = self._table()
+        t.apply("node:n0->node:n1:drop:2")
+        t.apply("node:n0->node:n1:drop:1")
+        assert t.state("n0", "n1").drop_next == 3
+        t.apply("node:n0<->node:n1:heal")
+        assert t.state("n0", "n1").drop_next == 0
+
+    def test_report_is_tier_annotated(self):
+        t = self._table()
+        t.apply("node:n0->node:n1:latency:2")
+        rep = t.report()
+        assert rep["n0->n1"]["tier"] == TIER_CROSS_RACK
+        assert rep["n0->n1"]["up"] is True
+
+    def test_malformed_spec_applies_nothing(self):
+        t = self._table()
+        assert t.apply("not a spec") == []
+
+
+# ---------------------------------------------------------------------------
+# PyXferd: protocol fidelity + the data plane
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def xferd_pair(tmp_path):
+    a = PyXferd(str(tmp_path / "a"), node="na").start()
+    b = PyXferd(str(tmp_path / "b"), node="nb").start()
+    ca = ResilientDcnXferClient(str(tmp_path / "a"), retry=FAST_RETRY)
+    cb = ResilientDcnXferClient(str(tmp_path / "b"), retry=FAST_RETRY)
+    yield a, b, ca, cb
+    for c in (ca, cb):
+        try:
+            c.close()
+        except OSError:
+            pass
+    a.stop()
+    b.stop()
+
+
+PAYLOAD = bytes(range(256)) * 16  # 4 KiB
+N = len(PAYLOAD)
+
+
+def _transfer(ca, cb, b, flow=None, payload=PAYLOAD):
+    """One one-way leg na → nb; returns the landed bytes."""
+    flow = flow or f"f-{uuid.uuid4().hex[:8]}"
+    cb.register_flow(flow, bytes=len(payload))
+    ca.register_flow(flow, bytes=len(payload))
+    ca.put(flow, payload)
+    dcn.wait_flow_rx(ca, flow, len(payload), timeout_s=10)
+    ca.send(flow, "127.0.0.1", b.data_port, len(payload))
+    dcn.wait_flow_rx(cb, flow, len(payload), timeout_s=10)
+    return flow, cb.read(flow, len(payload))
+
+
+class TestPyXferdProtocol:
+    def test_version_advertises_v2_frames(self, xferd_pair):
+        _a, _b, ca, _cb = xferd_pair
+        assert ca.version().startswith("pyxferd/")
+
+    def test_control_plane_contract(self, xferd_pair):
+        _a, _b, ca, _cb = xferd_pair
+        ca.ping()
+        ca.register_flow("g0", peer="peer", bytes=8192)
+        with pytest.raises(DcnXferError, match="already exists"):
+            ca.register_flow("g0")
+        assert ca.record_transfer("g0", 100) == 100
+        assert ca.record_transfer("g0", 100) == 200
+        stats = ca.stats()
+        assert stats["generation"] == 1
+        assert {f["flow"] for f in stats["flows"]} == {"g0"}
+        ca.release_flow("g0")
+        assert ca.stats()["active_flows"] == 0
+
+    def test_data_plane_roundtrip(self, xferd_pair):
+        a, b, ca, cb = xferd_pair
+        _flow, got = _transfer(ca, cb, b)
+        assert got == PAYLOAD
+
+    def test_send_without_staging_is_a_daemon_error(self, xferd_pair):
+        _a, b, ca, _cb = xferd_pair
+        ca.register_flow("empty", bytes=64)
+        with pytest.raises(DcnXferError, match="nothing staged"):
+            # Bypass the resilient restage (there is no cached payload
+            # for a flow never put) — the error must surface verbatim.
+            ca.send("empty", "127.0.0.1", b.data_port, 64)
+
+
+@pytest.mark.chaos
+class TestFrameDedup:
+    """ROADMAP 'DCN data-plane idempotence': per-flow frame seq +
+    receiver dedup window == exactly-once delivery under every replay
+    shape."""
+
+    def test_lost_response_replay_lands_exactly_once(self, xferd_pair):
+        """THE kill-mid-send scenario: the sender's daemon processed
+        the send (frame delivered) but died before answering.  The
+        client reconnects, replays its flows, restages, and re-sends
+        the SAME seq — the receiver's dedup window drops it."""
+        a, b, ca, cb = xferd_pair
+        flow, _ = _transfer(ca, cb, b, flow="f")
+        d0 = counters.get("dcn.frames.deduped")
+        r0 = counters.get("dcn.send.restaged")
+
+        a.drop_response_once("send")
+        resp = ca.send(flow, "127.0.0.1", b.data_port, N)
+        assert resp["ok"]
+        _wait_stable_rx(cb, flow, 2 * N)  # seq2 once — not 3*N
+        assert counters.get("dcn.frames.deduped") == d0 + 1
+        assert counters.get("dcn.send.restaged") == r0 + 1
+        assert cb.read(flow, N) == PAYLOAD
+
+    def test_receiver_kill9_mid_transfer_replay_exactly_once(
+            self, xferd_pair):
+        """Kill -9 the RECEIVING daemon mid-transfer; after it
+        restarts (fresh dedup window, fresh accounting) the replay
+        lands exactly once into the fresh state."""
+        a, b, ca, cb = xferd_pair
+        flow, _ = _transfer(ca, cb, b, flow="f")
+
+        b.stop(crash=True)
+        b.start()
+        cb.ping()  # reconnect + flow-table replay re-registers `f`
+        ca.send(flow, "127.0.0.1", b.data_port, N)
+        _wait_stable_rx(cb, flow, N)  # exactly once — not 2*N
+        assert cb.read(flow, N) == PAYLOAD
+        assert cb.stats()["generation"] == 2
+
+    def test_sender_kill9_restages_and_resends(self, xferd_pair):
+        """Kill -9 the SENDING daemon: the staged payload is gone; the
+        client's send path restages from its cache and the transfer
+        still completes."""
+        a, b, ca, cb = xferd_pair
+        flow, _ = _transfer(ca, cb, b, flow="f")
+
+        a.stop(crash=True)
+        a.start()
+        resp = ca.send(flow, "127.0.0.1", b.data_port, N)
+        assert resp["ok"]
+        _wait_stable_rx(cb, flow, 2 * N)
+
+    def test_lost_frame_retransmit_lands(self, tmp_path):
+        """Loss ≠ replay: a frame eaten in flight never landed, so the
+        retransmit (a NEW send) must pass the dedup window."""
+        topo = FleetTopology(build_specs(2, racks=2))
+        table = LinkTable(topo)
+        net = FleetNet(table)
+        a = PyXferd(str(tmp_path / "a"), node="n0", net=net).start()
+        b = PyXferd(str(tmp_path / "b"), node="n1", net=net).start()
+        net.register("n0", a)
+        net.register("n1", b)
+        ca = ResilientDcnXferClient(str(tmp_path / "a"), retry=FAST_RETRY)
+        cb = ResilientDcnXferClient(str(tmp_path / "b"), retry=FAST_RETRY)
+        try:
+            cb.register_flow("f", bytes=N)
+            ca.register_flow("f", bytes=N)
+            ca.put("f", PAYLOAD)
+            dcn.wait_flow_rx(ca, "f", N, timeout_s=10)
+
+            table.apply("node:n0->node:n1:drop:1")
+            resp = ca.send("f", "127.0.0.1", b.data_port, N)
+            assert resp["ok"]  # the sender cannot tell — that's loss
+            time.sleep(0.1)
+            assert _flow_stat(cb, "f")["rx_bytes"] == 0
+
+            ca.send("f", "127.0.0.1", b.data_port, N)  # retransmit
+            _wait_stable_rx(cb, "f", N)
+            link = table.report()["n0->n1"]
+            assert link["drops"] == 1 and link["frames"] == 1
+            assert cb.read("f", N) == PAYLOAD
+        finally:
+            ca.close()
+            cb.close()
+            a.stop()
+            b.stop()
+
+
+@pytest.mark.chaos
+class TestReadRestaging:
+    def test_read_after_daemon_restart_restages_transparently(
+            self, xferd_pair):
+        """ROADMAP 'resilient read restaging': the caller-side
+        put-again workaround moves into the client."""
+        a, _b, ca, _cb = xferd_pair
+        ca.register_flow("stage", bytes=N)
+        ca.put("stage", PAYLOAD)
+        dcn.wait_flow_rx(ca, "stage", N, timeout_s=10)
+        assert ca.read("stage", N) == PAYLOAD
+
+        r0 = counters.get("dcn.read.restaged")
+        a.stop(crash=True)
+        a.start()
+        # Zero manual intervention: reconnect + replay + restage + read.
+        assert ca.read("stage", N) == PAYLOAD
+        assert counters.get("dcn.read.restaged") == r0 + 1
+
+    def test_peer_landed_flow_has_no_cache_and_stays_empty(
+            self, xferd_pair):
+        """Restaging only applies to payloads THIS client staged; a
+        peer-landed flow lost to a restart still reads empty (only the
+        peer can re-send it)."""
+        a, b, ca, cb = xferd_pair
+        flow, _ = _transfer(ca, cb, b, flow="f")
+        b.stop(crash=True)
+        b.start()
+        cb.ping()
+        assert cb.read(flow, N) == b""
+
+
+# ---------------------------------------------------------------------------
+# Trace context across nodes and processes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestCrossNodeTrace:
+    def test_in_process_transfer_is_one_trace(self, xferd_pair):
+        """Client op, sender-daemon send, receiver-daemon land: one
+        trace id end to end (control protocol + frame meta carry it)."""
+        a, b, ca, cb = xferd_pair
+        trace.reset()
+        cb.register_flow("t", bytes=N)
+        ca.register_flow("t", bytes=N)
+        ca.put("t", PAYLOAD)
+        dcn.wait_flow_rx(ca, "t", N, timeout_s=10)
+        with trace.span("test.transfer") as root:
+            ca.send("t", "127.0.0.1", b.data_port, N)
+        dcn.wait_flow_rx(cb, "t", N, timeout_s=10)
+        time.sleep(0.05)  # let the land span finish recording
+        spans = trace.tail()
+        mine = [s for s in spans if s["trace"] == root.trace_id]
+        names = {s["name"] for s in mine}
+        assert {"test.transfer", "dcn.send", "xferd.op",
+                "xferd.send", "xferd.land"} <= names
+        land = next(s for s in mine if s["name"] == "xferd.land")
+        assert land["attrs"]["node"] == "nb"
+        assert land["attrs"]["src"] == "na"
+
+    def test_cross_process_transfer_merges_to_one_trace(self, tmp_path):
+        """The ISSUE acceptance bar: one cross-node transfer, two
+        processes, two JSONLs, ONE trace id — merged by
+        cmd/agent_trace.py."""
+        workdir = str(tmp_path)
+        trace_id, root_span = os.urandom(8).hex(), os.urandom(4).hex()
+        files = {}
+        envs, cmds = [], []
+        for role in ("recv", "send"):
+            env = dict(os.environ)
+            env.pop("TPU_FAULT_SPEC", None)  # determinism under make chaos
+            files[role] = os.path.join(workdir, f"{role}.jsonl")
+            env.update({
+                "FLEET_ROLE": role,
+                "FLEET_WORKDIR": workdir,
+                "FLEET_PAYLOAD": str(N),
+                "TPU_TRACE_FILE": files[role],
+                "TPU_TRACE_CONTEXT": f"{trace_id}:{root_span}",
+            })
+            envs.append(env)
+            cmds.append([sys.executable,
+                         os.path.join(REPO, "tests",
+                                      "fleet_trace_worker.py")])
+        run_procs(cmds, envs, cwd=REPO, timeout=120)
+
+        per_side = {}
+        for role, path in files.items():
+            spans = [json.loads(line) for line in open(path)]
+            per_side[role] = [s for s in spans if s["trace"] == trace_id]
+            assert per_side[role], f"{role} JSONL carries no trace spans"
+        # The receiver's LANDING span rode the frame meta, not just the
+        # env: it must hang off the sender's xferd.send context.
+        recv_names = {s["name"] for s in per_side["recv"]}
+        send_names = {s["name"] for s in per_side["send"]}
+        assert "xferd.land" in recv_names
+        assert "xferd.send" in send_names
+
+        # And cmd/agent_trace.py merges the two files into one story.
+        spec = importlib.util.spec_from_file_location(
+            "agent_trace", os.path.join(REPO, "cmd", "agent_trace.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        spans, _skipped = mod.load_spans(list(files.values()))
+        merged = [s for s in spans if s["trace"] == trace_id]
+        assert len(merged) == sum(len(v) for v in per_side.values())
+        shown = mod.print_tree(spans, trace_id,
+                               file=open(os.devnull, "w"))
+        assert shown == len(merged)
+
+
+# ---------------------------------------------------------------------------
+# Fleet scenarios
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestFleetScenarios:
+    def test_rack_partition_fleet_reconverges(self):
+        """The headline scenario (ISSUE acceptance): ≥4 nodes, a rack
+        partitioned mid-workload plus a chip fault, then the partition
+        heals and every surviving node re-converges — devices
+        re-announced Healthy, DCN legs completing again."""
+        h0 = counters.get("health.recovered")
+        report = run_scenario(dict(DEFAULT_SCENARIO, rounds=6))
+        assert report["converged"], report["rounds"][-1]
+
+        # The partition was real: cross-rack sends were blocked...
+        blocked = sum(l["blocked"] for l in report["links"].values())
+        assert blocked > 0
+        assert report["agent_events_delta"].get("fleet.link.blocked",
+                                                0) == blocked
+        mid = [r for r in report["rounds"]
+               if any("link" in f for f in r["faults"])][0]
+        assert all(not leg["ok"] for leg in mid["legs"]
+                   if "skipped" not in leg)
+        # ...and every node finished healthy with its final legs ok.
+        for name, node in report["nodes"].items():
+            assert node["healthy"] == node["total"], (name, node)
+        assert all(leg["ok"] for leg in report["rounds"][-1]["legs"])
+        # The chip fault recovered through the production health path.
+        assert counters.get("health.recovered") == h0 + 1
+
+    def test_fleet_sim_cli_runs_partition_scenario(self):
+        """cmd/fleet_sim.py: ≥4-node scheduled-rack-partition run exits
+        0 and emits the per-node/per-link JSON report."""
+        env = dict(os.environ)
+        env.pop("TPU_FAULT_SPEC", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "cmd", "fleet_sim.py"),
+             "--rounds", "5"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["converged"]
+        assert len(report["nodes"]) >= 4
+        assert any(l["blocked"] for l in report["links"].values())
+        assert "converged: True" in proc.stderr
+
+    @pytest.mark.slow
+    def test_node_kill_survivors_reconverge(self):
+        """A node's daemon dies for two rounds: its legs are skipped,
+        the N-1 survivors keep exchanging, and after the restart the
+        fleet re-converges with the daemon on generation 2."""
+        scenario = {
+            "name": "node-churn",
+            "nodes": 4, "racks": 2, "rounds": 6,
+            "payload_bytes": 1024,
+            "faults": [
+                {"round": 1, "action": "kill", "node": "n2", "for": 2},
+            ],
+        }
+        report = run_scenario(scenario)
+        assert report["converged"], report["rounds"][-1]
+        down_round = report["rounds"][1]
+        skipped = [leg for leg in down_round["legs"] if "skipped" in leg]
+        survivors = [leg for leg in down_round["legs"]
+                     if "skipped" not in leg]
+        assert len(skipped) == 2  # n1->n2 and n2->n3
+        assert survivors and all(leg["ok"] for leg in survivors)
+        assert report["nodes"]["n2"]["daemon_generation"] == 2
+        assert not report["nodes"]["n2"]["down"]
+
+    @pytest.mark.slow
+    def test_asymmetric_loss_and_latency(self):
+        """Link-level ≠ endpoint-level: one direction drops a frame
+        (the leg retries through), the reverse stays clean, and
+        injected latency shows up in the per-link accounting."""
+        scenario = {
+            "name": "lossy-link",
+            "nodes": 2, "racks": 2, "rounds": 3,
+            "payload_bytes": 1024,
+            "land_timeout_s": 0.5,
+            "faults": [
+                {"round": 1, "link": "node:n0->node:n1:drop:1"},
+                {"round": 1, "link": "node:n1->node:n0:latency:2"},
+            ],
+        }
+        report = run_scenario(scenario)
+        assert report["converged"], report["rounds"]
+        fwd = report["links"]["n0->n1"]
+        rev = report["links"]["n1->n0"]
+        assert fwd["drops"] == 1 and rev["drops"] == 0
+        assert rev["latency_injected_ms"] > 0
+        lossy = report["rounds"][1]["legs"][0]
+        assert lossy["ok"] and lossy["attempts"] > 1
+
+    @pytest.mark.slow
+    def test_per_node_metric_servers(self):
+        """`metrics: true` boots one MetricServer per node on an
+        ephemeral port, scrapeable while the scenario runs."""
+        import urllib.request
+
+        ctl = FleetController({
+            "name": "metrics", "nodes": 2, "racks": 1, "rounds": 1,
+            "payload_bytes": 512, "metrics": True, "faults": [],
+        })
+        try:
+            report = ctl.run()
+            assert report["converged"]
+            for name, node in ctl.nodes.items():
+                port = report["nodes"][name]["metrics_port"]
+                node.metrics.collect_once()
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                ).read().decode()
+                assert "duty_cycle_tpu_node" in body
+        finally:
+            ctl.close()
+
+    def test_partitioned_node_slice_reheals_with_counter(self, tmp_path):
+        """Fleet node with sub-slice partitioning: a chip fault takes
+        the slice down; recovery re-heals it once every member chip is
+        healthy, counted as health.slice_recovered."""
+        spec = NodeSpec(name="pn", chips=4, topology="2x2x1",
+                        partition_size="2x2")
+        node = EmulatedNode(spec, str(tmp_path / "pn"))
+        try:
+            s0 = counters.get("health.slice_recovered")
+            node.inject_chip_fault("accel1")
+            assert node.device_health() == {"slice0": "Unhealthy"}
+            assert node.force_recover() == 1
+            assert node.device_health() == {"slice0": "Healthy"}
+            assert counters.get("health.slice_recovered") == s0 + 1
+        finally:
+            node.close()

@@ -150,6 +150,116 @@ class TestTrace:
             trace.configure(ring_capacity=trace.DEFAULT_RING_CAPACITY)
 
 
+class TestTraceContext:
+    """Cross-process context: attach() joins a foreign trace; the
+    TPU_TRACE_CONTEXT env hands it between coordinator and workers."""
+
+    def test_attach_joins_remote_trace(self):
+        with trace.attach("cafe0123cafe0123", "ab12cd34"):
+            with trace.span("child") as s:
+                assert s.trace_id == "cafe0123cafe0123"
+                assert s.parent_id == "ab12cd34"
+        # The placeholder itself is never recorded.
+        assert all(sp["name"] != "remote" for sp in trace.tail())
+
+    def test_attach_none_is_noop(self):
+        with trace.attach(None):
+            with trace.span("orphan") as s:
+                assert s.parent_id is None
+
+    def test_context_env_roundtrip(self):
+        with trace.span("root") as root:
+            env = {trace.TRACE_CONTEXT_ENV: trace.context_env()}
+        with trace.attach_from_env(env):
+            with trace.span("worker") as s:
+                assert s.trace_id == root.trace_id
+                assert s.parent_id == root.span_id
+
+    def test_malformed_env_context_degrades_to_fresh_trace(self):
+        with trace.attach_from_env({trace.TRACE_CONTEXT_ENV: "garbage"}):
+            with trace.span("worker") as s:
+                assert s.trace_id != "garbage"
+
+
+class TestTraceSampling:
+    """TPU_TRACE_SAMPLE head sampling: whole traces share a fate by a
+    deterministic trace-id hash; the ring is never sampled; malformed
+    rates degrade to sample-everything."""
+
+    def _spans_in(self, path):
+        if not os.path.exists(path):
+            return []
+        return [json.loads(line) for line in open(path)]
+
+    def test_rate_zero_silences_sink_but_not_ring(
+            self, tmp_path, monkeypatch):
+        path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, path)
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.0")
+        trace.reset()
+        for _ in range(5):
+            with trace.span("s"):
+                pass
+        trace.reset()
+        assert self._spans_in(path) == []
+        # ...but the flight recorder's ring is untouched by sampling:
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.0")
+        with trace.span("ringed"):
+            pass
+        assert any(s["name"] == "ringed" for s in trace.tail())
+
+    def test_decision_is_deterministic_by_trace_id(self, monkeypatch):
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.5")
+        trace.reset()
+        # The first 8 hex chars drive the hash: all-zeros is always in,
+        # all-fs always out at any rate < 1.
+        assert trace.sampled("0000000012345678")
+        assert not trace.sampled("ffffffff12345678")
+        # Same id, same fate — what makes HEAD sampling coherent across
+        # processes sharing the id.
+        assert trace.sampled("0000000012345678")
+
+    def test_whole_trace_shares_one_fate(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, path)
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.5")
+        trace.reset()
+        for _ in range(40):
+            with trace.span("root"):
+                with trace.span("child"):
+                    pass
+        trace.reset()
+        spans = self._spans_in(path)
+        by_trace = {}
+        for s in spans:
+            by_trace.setdefault(s["trace"], []).append(s["name"])
+        # Every sampled-in trace arrived COMPLETE (root + child).
+        for names in by_trace.values():
+            assert sorted(names) == ["child", "root"]
+
+    def test_malformed_rate_samples_everything(self, tmp_path,
+                                               monkeypatch):
+        path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv(trace.TRACE_FILE_ENV, path)
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "not-a-rate")
+        trace.reset()
+        with trace.span("s"):
+            pass
+        trace.reset()
+        assert len(self._spans_in(path)) == 1
+
+    @pytest.mark.parametrize("bad", ["-0.5", "1.5", "nan"])
+    def test_out_of_range_rates_sample_everything(self, bad, monkeypatch):
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, bad)
+        trace.reset()
+        assert trace.sampled("ffffffffffffffff")
+
+    def test_foreign_trace_ids_sample_in(self, monkeypatch):
+        monkeypatch.setenv(trace.TRACE_SAMPLE_ENV, "0.5")
+        trace.reset()
+        assert trace.sampled("not-hex-at-all")
+
+
 # ---------------------------------------------------------------------------
 # histo
 # ---------------------------------------------------------------------------
