@@ -80,11 +80,23 @@ obs:
 # (partition / asymmetric loss / latency), partition-heal
 # re-convergence, frame-seq dedup exactly-once, cross-process trace
 # merging — including the scenarios marked slow, then one CLI run of
-# the headline rack-partition scenario (the acceptance path).
+# the headline rack-partition scenario (the acceptance path) and one
+# with the chunked/striped pipelined data plane under the same faults.
 .PHONY: fleet
 fleet:
 	$(PY) -m pytest tests/test_fleet.py -q -p no:randomly
 	$(PY) cmd/fleet_sim.py --rounds 5 > /dev/null
+	$(PY) cmd/fleet_sim.py --rounds 5 --pipelined \
+	    --payload-bytes 262144 --chunk-bytes 65536 > /dev/null
+
+# DCN pipelining gate: the serial-vs-pipelined microbench on the
+# loopback rig.  --compare exits non-zero if the pipelined path falls
+# below the serial path at the largest swept message size (a pipeline
+# regression must fail CI, not just dent a table in the README).
+.PHONY: dcnbench
+dcnbench:
+	$(PY) cmd/dcn_bench.py --compare \
+	    --sizes 65536,1048576,4194304 --iters 3
 
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests

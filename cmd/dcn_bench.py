@@ -1,0 +1,209 @@
+#!/usr/bin/env python3
+"""DCN transfer microbench: serial vs pipelined, message-size sweep.
+
+Boots two PyXferd daemons on loopback (the protocol-faithful rig the
+fleet simulator uses) and drives one-way transfers through both data
+planes:
+
+- ``serial``: the classic exchange leg — whole-payload ``put``, rx
+  wait, whole-payload ``send``, land wait, base64 control-socket read;
+- ``pipelined``: the chunked/striped path — overlapped stage+send via
+  ``parallel.dcn_pipeline.send_pipelined`` and raw DXR1 read-back.
+
+One JSONL record per (mode, size) goes to stdout (or ``--out``), in
+the BENCH_TPU_LOG style: flat keys, one measurement per line, with
+enough config to reproduce.  The human table goes to stderr.
+
+Usage:
+  python cmd/dcn_bench.py                          # default sweep
+  python cmd/dcn_bench.py --sizes 65536,4194304 --iters 5
+  python cmd/dcn_bench.py --compare                # exit non-zero if
+                                                   # pipelined < serial
+                                                   # at the largest size
+  python cmd/dcn_bench.py --chunk-bytes 262144 --stripes 4
+
+Timing note: wall-clock per leg, best-of-N (min) as the headline and
+the median alongside — the loopback rig is scheduling-noise-bound, so
+min is the honest "cost of the code path" number.  Measure idle.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.fleet.xferd import (  # noqa: E402
+    PyXferd,
+)
+from container_engine_accelerators_tpu.parallel import (  # noqa: E402
+    dcn,
+    dcn_pipeline,
+)
+from container_engine_accelerators_tpu.parallel.dcn_client import (  # noqa: E402
+    ResilientDcnXferClient,
+)
+
+DEFAULT_SIZES = "65536,262144,1048576,4194304"
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--sizes", default=DEFAULT_SIZES,
+                   help="comma-separated payload sizes in bytes")
+    p.add_argument("--iters", type=int, default=5,
+                   help="iterations per (mode, size); min is reported")
+    p.add_argument("--chunk-bytes", type=int, default=None,
+                   help="pipelined chunk size (default "
+                        "TPU_DCN_CHUNK_BYTES or 1 MiB)")
+    p.add_argument("--stripes", type=int, default=None,
+                   help="pipelined stripe count (default "
+                        "TPU_DCN_STRIPES or 2)")
+    p.add_argument("--out", default=None,
+                   help="append JSONL here instead of stdout")
+    p.add_argument("--compare", action="store_true",
+                   help="exit 1 if pipelined throughput falls below "
+                        "--min-ratio x serial at the largest size")
+    p.add_argument("--min-ratio", type=float, default=1.0,
+                   help="the --compare gate (default 1.0: pipelined "
+                        "must not regress below serial)")
+    return p.parse_args(argv)
+
+
+class BenchRig:
+    """Two daemons + two resilient clients on loopback."""
+
+    def __init__(self):
+        self.workdir = tempfile.mkdtemp(prefix="dcn-bench-")
+        self.a = PyXferd(os.path.join(self.workdir, "a"),
+                         node="bench-a").start()
+        self.b = PyXferd(os.path.join(self.workdir, "b"),
+                         node="bench-b").start()
+        self.ca = ResilientDcnXferClient(os.path.join(self.workdir, "a"))
+        self.cb = ResilientDcnXferClient(os.path.join(self.workdir, "b"))
+        self._n = 0
+
+    def close(self):
+        for c in (self.ca, self.cb):
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.a.stop()
+        self.b.stop()
+        shutil.rmtree(self.workdir, ignore_errors=True)
+
+    def one_way(self, mode: str, payload: bytes,
+                cfg: dcn_pipeline.PipelineConfig) -> float:
+        """One timed transfer a->b; returns seconds.  Verifies the
+        landed bytes — a bench that measures corrupt transfers fast
+        would be worse than no bench."""
+        self._n += 1
+        flow = f"bench-{mode}-{self._n}"
+        n = len(payload)
+        self.cb.register_flow(flow, peer="bench-a", bytes=n)
+        self.ca.register_flow(flow, peer="bench-b", bytes=n)
+        try:
+            t0 = time.perf_counter()
+            if mode == "serial":
+                self.ca.put(flow, payload)
+                dcn.wait_flow_rx(self.ca, flow, n, timeout_s=30)
+                self.ca.send(flow, "127.0.0.1", self.b.data_port, n)
+                dcn.wait_flow_rx(self.cb, flow, n, timeout_s=30)
+                got = self.cb.read(flow, n)
+            else:
+                dcn_pipeline.send_pipelined(
+                    self.ca, flow, payload, "127.0.0.1",
+                    self.b.data_port, cfg, timeout_s=30)
+                got = dcn_pipeline.read_pipelined(
+                    self.cb, flow, n, cfg, timeout_s=30)
+            elapsed = time.perf_counter() - t0
+            if got != payload:
+                raise RuntimeError(
+                    f"payload mismatch on {flow} ({mode}, {n} bytes)"
+                )
+            return elapsed
+        finally:
+            for client in (self.ca, self.cb):
+                try:
+                    client.release_flow(flow)
+                except Exception:
+                    pass
+
+
+def run_sweep(sizes, iters, cfg, sink, table=sys.stderr):
+    """Returns {(mode, size): best_mbps} after writing one JSONL
+    record per cell to ``sink``."""
+    rig = BenchRig()
+    results = {}
+    try:
+        print(f"{'bytes':>9} {'mode':>10} {'best_ms':>9} {'med_ms':>9} "
+              f"{'best_MB/s':>10}", file=table)
+        for size in sizes:
+            payload = bytes(range(256)) * (size // 256) \
+                + b"\x7f" * (size % 256)
+            for mode in ("serial", "pipelined"):
+                times = [rig.one_way(mode, payload, cfg)
+                         for _ in range(iters)]
+                best = min(times)
+                med = statistics.median(times)
+                mbps = size / best / 1e6
+                results[(mode, size)] = mbps
+                record = {
+                    "bench": "dcn_xfer",
+                    "mode": mode,
+                    "bytes": size,
+                    "iters": iters,
+                    "best_s": round(best, 6),
+                    "median_s": round(med, 6),
+                    "mbps": round(mbps, 2),
+                    "chunk_bytes": cfg.chunk_bytes,
+                    "stripes": cfg.stripes,
+                    "ts": round(time.time(), 3),
+                }
+                sink.write(json.dumps(record) + "\n")
+                sink.flush()
+                print(f"{size:>9} {mode:>10} {best * 1e3:>9.1f} "
+                      f"{med * 1e3:>9.1f} {mbps:>10.1f}", file=table)
+    finally:
+        rig.close()
+    return results
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    sizes = sorted({int(s) for s in args.sizes.split(",") if s})
+    if not sizes:
+        print("no sizes to sweep", file=sys.stderr)
+        return 2
+    cfg = dcn_pipeline.PipelineConfig(chunk_bytes=args.chunk_bytes,
+                                      stripes=args.stripes)
+    out = open(args.out, "a") if args.out else sys.stdout
+    try:
+        results = run_sweep(sizes, max(1, args.iters), cfg, out)
+    finally:
+        if args.out:
+            out.close()
+    largest = sizes[-1]
+    serial = results[("serial", largest)]
+    pipelined = results[("pipelined", largest)]
+    ratio = pipelined / serial if serial else float("inf")
+    print(f"largest size {largest}: pipelined/serial = {ratio:.2f}x",
+          file=sys.stderr)
+    if args.compare and ratio < args.min_ratio:
+        print(f"FAIL: pipelined fell below {args.min_ratio:.2f}x "
+              f"serial at {largest} bytes", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,6 +54,15 @@ def parse_args(argv=None):
                    help="override workload rounds")
     p.add_argument("--payload-bytes", type=int, default=None,
                    help="override per-leg payload size")
+    p.add_argument("--pipelined", action="store_true",
+                   help="run the ring workload over the chunked/striped "
+                        "pipelined DCN path (see --chunk-bytes/--stripes)")
+    p.add_argument("--chunk-bytes", type=int, default=None,
+                   help="pipelined chunk size (default "
+                        "TPU_DCN_CHUNK_BYTES or 1 MiB)")
+    p.add_argument("--stripes", type=int, default=None,
+                   help="pipelined stripe count (default TPU_DCN_STRIPES "
+                        "or 2)")
     p.add_argument("--metrics", action="store_true",
                    help="start a per-node MetricServer (ephemeral ports)")
     p.add_argument("--trace-file", default=None,
@@ -97,9 +106,13 @@ def main(argv=None):
     )
     for key, value in (("nodes", args.nodes), ("racks", args.racks),
                        ("rounds", args.rounds),
-                       ("payload_bytes", args.payload_bytes)):
+                       ("payload_bytes", args.payload_bytes),
+                       ("chunk_bytes", args.chunk_bytes),
+                       ("stripes", args.stripes)):
         if value is not None:
             scenario[key] = value
+    if args.pipelined:
+        scenario["pipelined"] = True
     if args.metrics:
         scenario["metrics"] = True
     if args.trace_file:

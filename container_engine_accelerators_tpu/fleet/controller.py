@@ -51,7 +51,7 @@ from container_engine_accelerators_tpu.fleet.topology import (
 )
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import histo, trace
-from container_engine_accelerators_tpu.parallel import dcn
+from container_engine_accelerators_tpu.parallel import dcn, dcn_pipeline
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnXferError,
 )
@@ -123,6 +123,14 @@ class FleetController:
         self.nodes: Dict[str, EmulatedNode] = {}
         self.rounds = int(self.scenario.get("rounds", 6))
         self.payload_bytes = int(self.scenario.get("payload_bytes", 2048))
+        # Pipelined ring legs: chunked/striped transfers through the
+        # same link-table fault surface.  Chunk/stripe knobs come from
+        # the scenario first, the TPU_DCN_* env second.
+        self.pipelined = bool(self.scenario.get("pipelined", False))
+        self.pipe_cfg = dcn_pipeline.PipelineConfig(
+            chunk_bytes=self.scenario.get("chunk_bytes"),
+            stripes=self.scenario.get("stripes"),
+        )
         self.leg_retry = RetryPolicy(
             max_attempts=int(self.scenario.get("leg_attempts", 3)),
             initial_backoff_s=float(
@@ -223,25 +231,42 @@ class FleetController:
                   "attempts": 0}
         with trace.span("fleet.leg", histogram="fleet.leg", round=rnd,
                         src=src.name, dst=dst.name,
-                        bytes=self.payload_bytes) as span:
+                        bytes=self.payload_bytes,
+                        pipelined=self.pipelined) as span:
             try:
                 dst.client.register_flow(rx, peer=src.name,
                                          bytes=self.payload_bytes)
                 src.client.register_flow(tx, peer=dst.name,
                                          bytes=self.payload_bytes)
-                src.client.put(tx, payload)
-                dcn.wait_flow_rx(src.client, tx, len(payload),
-                                 timeout_s=self.land_timeout_s)
+                if not self.pipelined:
+                    # Serial leg: whole-payload staging up front.  The
+                    # pipelined leg stages chunk-by-chunk inside each
+                    # send attempt instead (a retry after a daemon kill
+                    # must restage anyway).
+                    src.client.put(tx, payload)
+                    dcn.wait_flow_rx(src.client, tx, len(payload),
+                                     timeout_s=self.land_timeout_s)
                 last: Optional[BaseException] = None
                 for _attempt in self.leg_retry.attempts():
                     result["attempts"] += 1
                     try:
-                        src.client.send(tx, "127.0.0.1",
-                                        dst.daemon.data_port,
-                                        len(payload))
-                        dcn.wait_flow_rx(dst.client, rx, len(payload),
-                                         timeout_s=self.land_timeout_s)
-                        got = dst.client.read(rx, len(payload))
+                        if self.pipelined:
+                            dcn_pipeline.send_pipelined(
+                                src.client, tx, payload, "127.0.0.1",
+                                dst.daemon.data_port, self.pipe_cfg,
+                                timeout_s=self.land_timeout_s)
+                            got = dcn_pipeline.read_pipelined(
+                                dst.client, rx, len(payload),
+                                self.pipe_cfg,
+                                timeout_s=self.land_timeout_s)
+                        else:
+                            src.client.send(tx, "127.0.0.1",
+                                            dst.daemon.data_port,
+                                            len(payload))
+                            dcn.wait_flow_rx(dst.client, rx,
+                                             len(payload),
+                                             timeout_s=self.land_timeout_s)
+                            got = dst.client.read(rx, len(payload))
                         if got != payload:
                             raise DcnXferError(
                                 f"payload mismatch on {flow}"

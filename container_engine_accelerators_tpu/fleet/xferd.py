@@ -28,16 +28,44 @@ data-plane idempotence", "trace context across processes"):
   span joins the trace of the op that caused it, so one cross-node
   transfer is ONE trace across every process it touched.
 
+Plus the pipelined data-plane extensions (the chunked/striped DCN hot
+path, ISSUE 4):
+
+- **chunk assembly**: a v2 frame whose meta carries ``off``/``tot``
+  (and a transfer id ``xid``) lands at its offset into a per-flow
+  assembly buffer instead of replacing the staging buffer wholesale;
+  the flow's completed frame becomes visible (``frame_bytes``) only
+  once every byte of ``tot`` has landed.  Each chunk carries its own
+  seq, so the dedup window gives exactly-once PER CHUNK.
+- **offset send**: the ``send`` control op takes ``offset``/``bytes``
+  and streams just that chunk to the peer (waiting briefly for the
+  chunk to finish landing locally — this is what lets a client stage
+  chunk *k+1* while chunk *k* is in flight).
+- **wait op**: a blocking control op (``op:wait``) parks the
+  connection thread on a condition variable until a flow's
+  ``rx_bytes`` (mode ``rx``) or ``frame_bytes`` (mode ``frame``)
+  reaches a target — no more 20 ms poll quantum on the land path.
+- **stats flow filter**: ``stats`` with a ``flow`` key returns only
+  that flow's entry (O(1) per poll instead of O(flows)).
+- **binary read-back**: a ``DXR1`` request on the data plane streams
+  staged bytes back raw — the striped reader's escape from base64 on
+  the control socket.
+
 Frame wire format (data plane):
 
     v1 (native-compatible): "DXF1" | u32 LE name_len | u64 LE
         payload_len | name | payload
     v2 (seq + meta):        "DXF2" | u32 LE name_len | u64 LE
         payload_len | u64 LE seq | u32 LE meta_len | name |
-        meta (JSON: trace/span/src) | payload
+        meta (JSON: trace/span/src[/off/tot/xid]) | payload
+    read request:           "DXR1" | u32 LE name_len | u64 LE offset |
+        u64 LE nbytes | name  →  u64 LE avail | bytes
 
-Receivers accept both; v1 frames (the native daemon, local ``put``
-staging) have no seq and bypass dedup — exactly what a restage wants.
+Receivers accept all three; v1 frames (the native daemon, local
+``put`` staging) have no seq and bypass dedup — exactly what a restage
+wants.  A v2 frame with seq 0 (the striped writer staging chunks into
+its OWN daemon) also bypasses dedup: local staging is idempotent by
+construction, and a restage must be able to overwrite.
 """
 
 import base64
@@ -55,19 +83,33 @@ from container_engine_accelerators_tpu.obs import trace
 
 log = logging.getLogger(__name__)
 
-VERSION = "pyxferd/2"
+VERSION = "pyxferd/3"
 SOCKET_NAME = "xferd.sock"
 READ_CAP = 512 << 10  # per-call read cap, like the native daemon
-DEDUP_WINDOW = 64  # landed-seq memory per flow
+# Landed-seq memory per flow.  Sized so one full chunked transfer's
+# worth of seqs (a replay re-sends ALL of them under the same numbers)
+# fits with 2x headroom: the striped writer caps itself at
+# MAX_CHUNKS_PER_TRANSFER = 128 chunks (parallel/dcn_pipeline.py, with
+# a cross-test pinning 2 * cap <= window).
+DEDUP_WINDOW = 256
+# How long an offset-send waits for its chunk to finish landing through
+# the local data plane (the stage->send pipeline race is normally
+# microseconds; the bound only matters when staging genuinely died).
+CHUNK_STAGE_WAIT_S = 5.0
+# Per-call cap on the blocking wait op: the client re-issues slices, so
+# a daemon thread is never parked longer than this on one request.
+MAX_WAIT_SLICE_S = 30.0
 
 _MAGIC_V1 = b"DXF1"
 _MAGIC_V2 = b"DXF2"
+_MAGIC_READ = b"DXR1"
 
 
 class _Flow:
     __slots__ = ("owner", "peer", "buffer_bytes", "transferred",
                  "rx_bytes", "frame_bytes", "staged", "seen_seqs",
-                 "max_seq")
+                 "max_seq", "asm_xid", "asm_total", "asm_buf",
+                 "asm_chunks", "asm_seqs")
 
     def __init__(self, owner: int, peer: str, buffer_bytes: int):
         self.owner = owner
@@ -79,30 +121,144 @@ class _Flow:
         self.staged = b""
         self.seen_seqs = set()
         self.max_seq = 0
+        # Chunk-assembly state (pipelined transfers): one in-progress
+        # logical payload, keyed by the sender's transfer id.
+        self.asm_xid = None
+        self.asm_total = 0
+        self.asm_buf = None  # bytearray(asm_total) while assembling
+        self.asm_chunks: Dict[int, int] = {}  # landed off -> len
+        self.asm_seqs = set()  # seqs whose bytes live in THIS assembly
+
+    def discard_assembly(self) -> None:
+        """Drop the in-progress assembly AND un-see its seqs: a seq is
+        only exactly-once while its bytes are retained — keeping seqs
+        of discarded chunks would dedup-drop their retransmits and
+        wedge the transfer."""
+        self.seen_seqs -= self.asm_seqs
+        self.asm_seqs = set()
+        self.asm_xid = None
+        self.asm_buf = None
+        self.asm_chunks = {}
+
+    def range_staged(self, offset: int, nbytes: int,
+                     xid: Optional[str] = None) -> bool:
+        """True when bytes [offset, offset+nbytes) are readable — from
+        the completed frame, or covered by landed assembly chunks.
+
+        With ``xid`` set (a chunked send), only bytes belonging to
+        THAT transfer count: a stale completed frame from a previous
+        transfer on a reused flow must make the send WAIT for the new
+        staging, not silently re-send last transfer's bytes."""
+        if (self.frame_bytes and offset + nbytes <= len(self.staged)
+                and (xid is None or self.asm_xid == xid)):
+            return True
+        if self.asm_buf is None or (xid is not None
+                                    and self.asm_xid != xid):
+            return False
+        pos = offset
+        for off in sorted(self.asm_chunks):
+            if pos >= offset + nbytes:
+                break
+            if off <= pos < off + self.asm_chunks[off]:
+                pos = off + self.asm_chunks[off]
+        return pos >= offset + nbytes
+
+    def read_range(self, offset: int, nbytes: int,
+                   xid: Optional[str] = None) -> bytes:
+        if (self.frame_bytes and offset + nbytes <= len(self.staged)
+                and (xid is None or self.asm_xid == xid)):
+            return self.staged[offset:offset + nbytes]
+        return bytes(self.asm_buf[offset:offset + nbytes])
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = conn.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = conn.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError("data connection closed mid-frame")
-        buf.extend(chunk)
+        got += r
     return bytes(buf)
+
+
+def _set_nodelay(sock: socket.socket) -> None:
+    """Chunked frames are header+payload pairs and DXR1 replies are
+    header+data pairs: Nagle coalescing against delayed ACKs costs
+    milliseconds per chunk, which is the whole pipelining budget."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass  # not a TCP socket (UDS in tests)
 
 
 def encode_frame(flow: str, payload: bytes, seq: Optional[int] = None,
                  meta: Optional[dict] = None) -> bytes:
-    """Build a wire frame: v1 when seq is None (native-compatible), v2
-    otherwise."""
-    name = flow.encode()
-    if seq is None:
+    """Build a wire frame: v1 when there is neither seq nor meta
+    (native-compatible), v2 otherwise.  A v2 frame with meta but no seq
+    carries seq 0 on the wire — "no dedup", the staging-chunk case."""
+    if seq is None and meta is None:
+        name = flow.encode()
         return (_MAGIC_V1 + struct.pack("<I", len(name))
                 + struct.pack("<Q", len(payload)) + name + payload)
+    return encode_frame_header(flow, len(payload), seq, meta) + payload
+
+
+def encode_frame_header(flow: str, payload_len: int,
+                        seq: Optional[int] = None,
+                        meta: Optional[dict] = None) -> bytes:
+    """The v2 frame minus its payload — senders pass the payload as a
+    separate ``sendmsg`` buffer and skip one full-chunk copy."""
+    name = flow.encode()
     meta_b = json.dumps(meta or {}).encode()
     return (_MAGIC_V2 + struct.pack("<I", len(name))
-            + struct.pack("<Q", len(payload)) + struct.pack("<Q", seq)
-            + struct.pack("<I", len(meta_b)) + name + meta_b + payload)
+            + struct.pack("<Q", payload_len)
+            + struct.pack("<Q", seq or 0)
+            + struct.pack("<I", len(meta_b)) + name + meta_b)
+
+
+def encode_read_request(flow: str, offset: int, nbytes: int) -> bytes:
+    """Build a DXR1 data-plane read request (the striped reader's
+    binary read-back; the daemon answers u64 LE length + raw bytes)."""
+    name = flow.encode()
+    return (_MAGIC_READ + struct.pack("<I", len(name))
+            + struct.pack("<Q", offset) + struct.pack("<Q", nbytes)
+            + name)
+
+
+class _PeerConn:
+    """One cached outbound data-plane stream.  Sends hold the lock for
+    the whole frame so concurrent users can never interleave bytes."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.sock: Optional[socket.socket] = None
+
+    def send_frame(self, host: str, port: int, parts) -> None:
+        with self.lock:
+            if self.sock is None:
+                s = socket.create_connection((host, port), timeout=30)
+                _set_nodelay(s)
+                self.sock = s
+            try:
+                for part in parts:
+                    self.sock.sendall(part)
+            except OSError:
+                self.close_locked()
+                raise
+
+    def close_locked(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def close(self) -> None:
+        with self.lock:
+            self.close_locked()
 
 
 class PyXferd:
@@ -121,9 +277,18 @@ class PyXferd:
         self._total_transferred = 0
         self._unmatched = 0
         self._lock = threading.Lock()
+        # Landing notifications: wait ops and offset-sends park here
+        # until land_frame advances the flow they watch.
+        self._landed = threading.Condition(self._lock)
         self._server: Optional[socket.socket] = None
         self._data_server: Optional[socket.socket] = None
         self._conns = set()
+        # Persistent outbound data-plane connections, keyed by
+        # (control conn, host, port): chunked sends reuse one TCP
+        # stream per stripe instead of dialing per chunk, and distinct
+        # stripes (distinct control connections) get distinct streams
+        # — the FlexLink point of striping one logical transfer.
+        self._peer_conns: Dict[tuple, "_PeerConn"] = {}
         self._stopping = threading.Event()
         # Test hook: {op: n} — process the next n requests of `op`, then
         # sever the connection BEFORE responding (a daemon that did the
@@ -185,6 +350,11 @@ class PyXferd:
             self._flows.clear()
             self._total_transferred = 0
             self._unmatched = 0
+            self._landed.notify_all()  # unpark any blocked wait op
+            peer_conns = list(self._peer_conns.values())
+            self._peer_conns.clear()
+        for pc in peer_conns:
+            pc.close()
 
     # -- control plane -------------------------------------------------------
 
@@ -246,6 +416,11 @@ class PyXferd:
             for name in [n for n, f in self._flows.items()
                          if f.owner == conn_id]:
                 del self._flows[name]
+            self._landed.notify_all()  # waiters re-check released flows
+            stale = [k for k in self._peer_conns if k[0] == conn_id]
+            conns = [self._peer_conns.pop(k) for k in stale]
+        for pc in conns:
+            pc.close()
 
     def _handle(self, conn_id: int, req: dict) -> dict:
         op = req.get("op")
@@ -258,7 +433,8 @@ class PyXferd:
 
     def _dispatch(self, conn_id: int, op: str, req: dict) -> dict:
         if op == "version":
-            return {"ok": True, "version": VERSION, "frame_version": 2}
+            return {"ok": True, "version": VERSION, "frame_version": 2,
+                    "pipeline": 1}
         if op == "ping":
             return {"ok": True}
         if op == "data_port":
@@ -300,10 +476,44 @@ class PyXferd:
         if op == "read":
             return self._read(req)
         if op == "send":
-            return self._send(req)
+            return self._send(conn_id, req)
+        if op == "wait":
+            return self._wait(req)
         if op == "stats":
-            return self._stats()
+            return self._stats(req.get("flow"))
         return {"ok": False, "error": f"unknown op: {op}"}
+
+    def _wait(self, req: dict) -> dict:
+        """Blocking wait: park this connection's thread until the flow
+        reaches ``bytes`` of rx (mode ``rx``) or a completed frame of
+        at least ``bytes`` (mode ``frame``), or the slice times out.
+        The client loops slices against its own deadline, so a daemon
+        thread is never held hostage by a dead client's deadline."""
+        flow = req["flow"]
+        nbytes = int(req.get("bytes") or 0)
+        mode = req.get("mode", "rx")
+        if mode not in ("rx", "frame"):
+            return {"ok": False, "error": f"unknown wait mode: {mode}"}
+        timeout_ms = req.get("timeout_ms")
+        if timeout_ms is None:
+            timeout_ms = 1000
+        timeout_s = min(max(float(timeout_ms), 0.0) / 1e3,
+                        MAX_WAIT_SLICE_S)
+
+        def done() -> bool:
+            f = self._flows.get(flow)
+            if f is None:
+                return True  # released/never registered: report, don't hang
+            have = f.frame_bytes if mode == "frame" else f.rx_bytes
+            return have >= nbytes
+
+        with self._landed:
+            reached = self._landed.wait_for(done, timeout=timeout_s)
+            f = self._flows.get(flow)
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            return {"ok": True, "done": bool(reached),
+                    "rx_bytes": f.rx_bytes, "frame_bytes": f.frame_bytes}
 
     def _read(self, req: dict) -> dict:
         nbytes = int(req.get("bytes") or 0)
@@ -322,30 +532,73 @@ class PyXferd:
         return {"ok": True, "data": base64.b64encode(chunk).decode(),
                 "frame_bytes": frame_bytes}
 
-    def _send(self, req: dict) -> dict:
+    def _send(self, conn_id: int, req: dict) -> dict:
         flow = req["flow"]
         host = req.get("host", "127.0.0.1")
         port = int(req["port"])
         seq = req.get("seq")
         seq = int(seq) if seq is not None else None
-        with self._lock:
-            f = self._flows.get(flow)
-            if f is None:
-                return {"ok": False, "error": "unknown flow"}
-            payload = f.staged
-        if not payload:
-            return {"ok": False,
-                    "error": f"nothing staged for flow {flow!r}"}
-        nbytes = int(req.get("bytes") or len(payload))
-        payload = payload[:nbytes]
+        offset = req.get("offset")
+        if offset is None:
+            with self._lock:
+                f = self._flows.get(flow)
+                if f is None:
+                    return {"ok": False, "error": "unknown flow"}
+                payload = f.staged
+            if not payload:
+                return {"ok": False,
+                        "error": f"nothing staged for flow {flow!r}"}
+            nbytes = int(req.get("bytes") or len(payload))
+            payload = payload[:nbytes]
+            meta_extra = {}
+        else:
+            # Chunked send: stream staged[offset:offset+bytes] as one
+            # chunk frame.  The chunk may still be in flight on the
+            # local data plane (the stage->send pipeline), so wait
+            # briefly for it to land rather than racing it.
+            offset = int(offset)
+            nbytes = int(req.get("bytes") or 0)
+            if offset < 0 or nbytes <= 0:
+                return {"ok": False,
+                        "error": "chunked send needs offset >= 0 and "
+                                 "bytes > 0"}
+            stage_wait_s = min(
+                float(req.get("stage_wait_ms")
+                      or CHUNK_STAGE_WAIT_S * 1e3) / 1e3,
+                CHUNK_STAGE_WAIT_S,
+            )
+            xid = req.get("xid") or ""
+            with self._landed:
+                staged = self._landed.wait_for(
+                    lambda: (self._flows.get(flow) is None
+                             or self._flows[flow].range_staged(
+                                 offset, nbytes, xid)),
+                    timeout=stage_wait_s,
+                )
+                f = self._flows.get(flow)
+                if f is None:
+                    return {"ok": False, "error": "unknown flow"}
+                if not staged:
+                    return {"ok": False,
+                            "error": f"chunk not staged for flow "
+                                     f"{flow!r} [{offset}:"
+                                     f"{offset + nbytes}]"}
+                payload = f.read_range(offset, nbytes, xid)
+            meta_extra = {
+                "off": offset,
+                "tot": int(req.get("total") or 0),
+                "xid": xid,
+            }
         t0 = time.monotonic()
         with trace.span("xferd.send", histogram="xferd.send", flow=flow,
                         node=self.node, dst=f"{host}:{port}", seq=seq,
                         bytes=len(payload)) as span:
             meta = {"src": self.node}
+            meta.update(meta_extra)
             ctx = trace.context()
             if ctx is not None:
                 meta.update(ctx)
+            verdict = None
             try:
                 if self.net is not None:
                     # Fleet mode: EVERY frame goes through the link
@@ -355,8 +608,22 @@ class PyXferd:
                     verdict = self.net.deliver(self.node, host, port,
                                                flow, payload, seq, meta)
                     span.annotate(verdict=verdict)
-                else:
+                elif offset is None:
+                    # Whole-payload send: a fresh dial per send, so a
+                    # dead peer surfaces as an immediate error (the
+                    # serial path's error contract).
                     self._tcp_send(host, port, flow, payload, seq, meta)
+                else:
+                    # Chunked send: a persistent stream per (control
+                    # connection, peer) — dialing per chunk costs more
+                    # than the chunk.  A frame lost in a stale stream's
+                    # buffer when the peer dies is re-sent by the
+                    # striped writer's retry round (same seq, dedup).
+                    self._peer_conn(conn_id, host, port).send_frame(
+                        host, port,
+                        [encode_frame_header(flow, len(payload), seq,
+                                             meta), payload],
+                    )
             except OSError as e:
                 return {"ok": False, "error": f"send failed: {e}"}
         micros = max(1.0, (time.monotonic() - t0) * 1e6)
@@ -365,18 +632,40 @@ class PyXferd:
             if f is not None:
                 f.transferred += len(payload)
                 self._total_transferred += len(payload)
-        return {"ok": True, "bytes": len(payload),
+        resp = {"ok": True, "bytes": len(payload),
                 "micros": round(micros, 1),
                 "gbps": round(len(payload) * 8 / micros / 1e3, 3)}
+        if verdict is not None:
+            # The striped sender uses this to retransmit chunks the
+            # link ate without waiting for a timeout.
+            resp["verdict"] = verdict
+        return resp
 
     def _tcp_send(self, host: str, port: int, flow: str, payload: bytes,
                   seq: Optional[int], meta: dict) -> None:
-        frame = encode_frame(flow, payload, seq, meta)
         with socket.create_connection((host, port), timeout=30) as s:
-            s.sendall(frame)
+            _set_nodelay(s)
+            s.sendall(encode_frame_header(flow, len(payload), seq, meta))
+            s.sendall(payload)
 
-    def _stats(self) -> dict:
+    def _peer_conn(self, conn_id: int, host: str, port: int) -> _PeerConn:
+        key = (conn_id, host, port)
         with self._lock:
+            pc = self._peer_conns.get(key)
+            if pc is None:
+                pc = self._peer_conns[key] = _PeerConn()
+            return pc
+
+    def _stats(self, flow: Optional[str] = None) -> dict:
+        """Daemon stats.  With ``flow`` set, the flows list holds just
+        that flow's entry (one dict lookup) — the rx-wait poll path
+        stops paying O(flows) per poll."""
+        with self._lock:
+            if flow is not None:
+                f = self._flows.get(flow)
+                items = [(flow, f)] if f is not None else []
+            else:
+                items = list(self._flows.items())
             return {
                 "ok": True,
                 "active_flows": len(self._flows),
@@ -390,7 +679,7 @@ class PyXferd:
                      "rx_bytes": f.rx_bytes,
                      "frame_bytes": f.frame_bytes,
                      "max_seq": f.max_seq}
-                    for name, f in self._flows.items()
+                    for name, f in items
                 ],
             }
 
@@ -411,6 +700,7 @@ class PyXferd:
                              daemon=True).start()
 
     def _serve_data_conn(self, conn: socket.socket) -> None:
+        _set_nodelay(conn)
         with self._lock:
             self._conns.add(conn)
         try:
@@ -419,6 +709,10 @@ class PyXferd:
                     magic = _recv_exact(conn, 4)
                 except (ConnectionError, OSError):
                     return
+                if magic == _MAGIC_READ:
+                    if not self._serve_data_read(conn):
+                        return
+                    continue
                 try:
                     flow, payload, seq, meta = self._read_frame(conn, magic)
                 except (ConnectionError, OSError, ValueError) as e:
@@ -430,17 +724,46 @@ class PyXferd:
             with self._lock:
                 self._conns.discard(conn)
 
+    def _serve_data_read(self, conn: socket.socket) -> bool:
+        """Answer one DXR1 read request: u64 LE length + raw staged
+        bytes (bounded by the last COMPLETED frame — an assembling flow
+        reads empty, exactly like the control-plane read's contract).
+        Raw TCP instead of base64-over-JSON is what makes the striped
+        reader's read-back leg cheap.  Returns False on a dead conn."""
+        try:
+            name_len = struct.unpack("<I", _recv_exact(conn, 4))[0]
+            offset = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+            nbytes = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+            if name_len > 4096 or nbytes > (1 << 31):
+                raise ValueError("read request out of bounds")
+            flow = _recv_exact(conn, name_len).decode()
+        except (ConnectionError, OSError, ValueError) as e:
+            log.error("bad data-plane read request: %s", e)
+            return False
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is None or not f.frame_bytes:
+                data = b""
+            else:
+                end = min(offset + nbytes, f.frame_bytes,
+                          len(f.staged))
+                data = f.staged[offset:end] if offset < end else b""
+        try:
+            conn.sendall(struct.pack("<Q", len(data)))
+            conn.sendall(data)
+        except OSError:
+            return False
+        return True
+
     def _read_frame(self, conn: socket.socket, magic: bytes
                     ) -> Tuple[str, bytes, Optional[int], dict]:
         if magic == _MAGIC_V1:
-            name_len = struct.unpack("<I", _recv_exact(conn, 4))[0]
-            payload_len = struct.unpack("<Q", _recv_exact(conn, 8))[0]
+            name_len, payload_len = struct.unpack(
+                "<IQ", _recv_exact(conn, 12))
             seq, meta_len = None, 0
         elif magic == _MAGIC_V2:
-            name_len = struct.unpack("<I", _recv_exact(conn, 4))[0]
-            payload_len = struct.unpack("<Q", _recv_exact(conn, 8))[0]
-            seq = struct.unpack("<Q", _recv_exact(conn, 8))[0]
-            meta_len = struct.unpack("<I", _recv_exact(conn, 4))[0]
+            name_len, payload_len, seq, meta_len = struct.unpack(
+                "<IQQI", _recv_exact(conn, 24))
         else:
             raise ValueError(f"unknown frame magic {magic!r}")
         if name_len > 4096 or payload_len > (1 << 31) or meta_len > 65536:
@@ -462,8 +785,15 @@ class PyXferd:
 
         Returns "landed", "dup" (seq already landed — dropped without
         touching accounting, the exactly-once half of frame
-        sequencing), or "unmatched" (no such flow registered here).
-        Landing joins the SENDER's trace via the frame meta.
+        sequencing), "rejected" (malformed chunk geometry), or
+        "unmatched" (no such flow registered here).  A frame whose meta
+        carries ``off``/``tot`` is a CHUNK: it lands at its offset into
+        the flow's assembly buffer, and the completed frame becomes
+        visible only once every byte of ``tot`` has landed — a reader
+        can never observe a half-assembled payload.  Seq 0 (or a v1
+        frame) bypasses dedup: that is local staging, idempotent by
+        construction.  Landing joins the SENDER's trace via the frame
+        meta.
         """
         meta = meta or {}
         with trace.attach(meta.get("trace"), meta.get("span")):
@@ -477,7 +807,7 @@ class PyXferd:
                         self._unmatched += 1
                         span.annotate(verdict="unmatched")
                         return "unmatched"
-                    if seq is not None:
+                    if seq:  # seq 0 == staging chunk, dedup-exempt
                         if (seq in f.seen_seqs
                                 or (f.max_seq - seq) >= DEDUP_WINDOW):
                             span.annotate(verdict="dup")
@@ -490,8 +820,68 @@ class PyXferd:
                             floor = f.max_seq - DEDUP_WINDOW
                             f.seen_seqs = {s for s in f.seen_seqs
                                            if s >= floor}
-                    f.staged = bytes(payload)
-                    f.frame_bytes = len(payload)
-                    f.rx_bytes += len(payload)
-                span.annotate(verdict="landed")
-                return "landed"
+                    verdict = self._land_locked(flow, f, payload,
+                                                meta, seq)
+                    self._landed.notify_all()
+                span.annotate(verdict=verdict)
+                return verdict
+
+    def _land_locked(self, flow: str, f: _Flow, payload: bytes,
+                     meta: dict, seq) -> str:
+        """Write one (deduped) frame into flow state; caller holds the
+        lock."""
+        off = meta.get("off")
+        if off is None:
+            # Whole-payload frame: replaces staging wholesale and
+            # cancels any in-progress assembly (the serial fallback
+            # after a pipelined attempt must win outright).
+            f.staged = bytes(payload)
+            f.frame_bytes = len(payload)
+            f.rx_bytes += len(payload)
+            f.discard_assembly()
+            return "landed"
+        off = int(off)
+        tot = int(meta.get("tot") or 0)
+        xid = meta.get("xid") or ""
+        if tot <= 0 or off < 0 or off + len(payload) > tot:
+            counters.inc("dcn.chunks.rejected")
+            log.error("rejecting chunk with bad geometry: flow=%s "
+                      "off=%d len=%d tot=%d", flow, off,
+                      len(payload), tot)
+            return "rejected"
+        if f.asm_xid != xid or f.asm_total != tot or f.asm_buf is None:
+            # First chunk of a new logical transfer (or a retry under a
+            # fresh xid): discard the old assembly — un-seeing its seqs
+            # so that retransmits of the discarded bytes can land again
+            # (a stale straggler frame must not be able to wedge the
+            # live transfer) — and start clean.  The completed frame is
+            # invalidated too: on a reused flow, a reader waiting for
+            # THIS transfer must block until it assembles, never be
+            # satisfied by last transfer's bytes.
+            f.discard_assembly()
+            f.staged = b""
+            f.frame_bytes = 0
+            f.asm_xid = xid
+            f.asm_total = tot
+            f.asm_buf = bytearray(tot)
+        f.asm_buf[off:off + len(payload)] = payload
+        f.asm_chunks[off] = len(payload)
+        if seq:
+            f.asm_seqs.add(seq)
+        f.rx_bytes += len(payload)
+        counters.inc("dcn.chunks.landed")
+        if (f.range_staged(0, tot, xid)
+                and f.staged is not f.asm_buf):
+            # Completion = every byte of [0, tot) covered by landed
+            # chunks (interval walk, not a length sum: overlapping
+            # chunks from an off-grid sender must not mark a gapped
+            # buffer complete).  Adopt the assembly buffer as the
+            # completed frame without a copy; a same-xid restage keeps
+            # writing into it (same bytes), a new xid starts a fresh
+            # buffer.  The identity check makes completion fire once
+            # per assembly, not once per straggler/replayed chunk
+            # after completion.
+            f.staged = f.asm_buf
+            f.frame_bytes = tot
+            counters.inc("dcn.chunks.assembled")
+        return "landed"

@@ -119,18 +119,53 @@ def make_xfer_client(
     return cls(uds, **kwargs)
 
 
+# Adaptive poll bounds for daemons without the blocking wait op: start
+# fine-grained so small transfers stop paying a poll-quantum tax, back
+# off to the old 20 ms ceiling so a long wait stays cheap.
+POLL_MIN_S = 0.0005
+POLL_MAX_S = 0.02
+
+
 def wait_flow_rx(client, flow: str, nbytes: int,
                  timeout_s: float = 60.0) -> None:
     """Block until ``flow`` has landed ``nbytes`` (RX accounting is
-    asynchronous on the daemon side)."""
+    asynchronous on the daemon side).
+
+    Fast path: the daemon's blocking ``wait`` control op (PyXferd) —
+    a condition-variable wakeup instead of polling.  Fallback for
+    wait-less daemons (the native daemon, the test stub): adaptive
+    poll, doubling from :data:`POLL_MIN_S` to :data:`POLL_MAX_S`, each
+    poll asking ``stats`` for just this flow (filter-aware daemons
+    answer in O(1); older ones return everything and the client-side
+    ``next`` does the filtering as before).
+    """
+    from container_engine_accelerators_tpu.parallel.dcn_client import (
+        DcnWaitUnsupported,
+    )
+
+    wait_rx = getattr(client, "wait_rx", None)
+    if wait_rx is not None:
+        try:
+            wait_rx(flow, nbytes, timeout_s=timeout_s)
+            return
+        except DcnWaitUnsupported:
+            pass  # wait-less daemon: poll below
+        except TimeoutError:
+            raise TimeoutError(
+                f"flow {flow!r} never received {nbytes} bytes"
+            )
     deadline = time.monotonic() + timeout_s
+    interval = POLL_MIN_S
     while time.monotonic() < deadline:
         f = next(
-            (x for x in client.stats()["flows"] if x["flow"] == flow), None
+            (x for x in client.stats(flow=flow)["flows"]
+             if x["flow"] == flow),
+            None,
         )
         if f is not None and f["rx_bytes"] >= nbytes:
             return
-        time.sleep(0.02)
+        time.sleep(interval)
+        interval = min(interval * 2, POLL_MAX_S)
     raise TimeoutError(f"flow {flow!r} never received {nbytes} bytes")
 
 
@@ -144,6 +179,7 @@ def exchange_shard(
     peer_port: int,
     barrier: Optional[Callable[[], object]] = None,
     timeout_s: float = 60.0,
+    pipelined: Optional[bool] = None,
 ) -> bytes:
     """One cross-pod leg of a DCN collective, staged through dcnxferd.
 
@@ -167,8 +203,20 @@ def exchange_shard(
     PEER's staged shard dying with the peer daemon after it landed —
     the rx wait times out and callers retry the whole leg, which asks
     the peer to re-send.
+
+    Payloads above the pipeline threshold (``TPU_DCN_CHUNK_BYTES``,
+    daemon permitting) take the chunked/striped pipelined path: stage
+    and send overlap per chunk and read-back is raw DXR1 instead of
+    base64 — pass ``pipelined=False``/``True`` to force either leg.
+    A pipelined failure falls back to the serial path once
+    (``dcn.pipeline.fallback``) before surfacing, so a daemon that
+    lost its pipeline capability mid-leg degrades instead of failing.
+    Empty shards short-circuit after the barrier: registration keeps
+    the rendezvous honest, but no bytes are staged, sent, or read.
     """
+    from container_engine_accelerators_tpu.metrics import counters
     from container_engine_accelerators_tpu.obs import trace
+    from container_engine_accelerators_tpu.parallel import dcn_pipeline
     from container_engine_accelerators_tpu.parallel.dcn_client import (
         DcnXferError,
     )
@@ -192,6 +240,30 @@ def exchange_shard(
                 with trace.span("dcn.exchange.barrier",
                                 histogram="dcn.exchange.barrier"):
                     barrier()
+            if nbytes == 0:
+                # Nothing to move: the barrier already proved both
+                # sides showed up, and the peer's empty shard has
+                # nothing to land here either.
+                counters.inc("dcn.exchange.empty")
+                return b""
+            cfg = dcn_pipeline.PipelineConfig()
+            use_pipe = (pipelined if pipelined is not None
+                        else dcn_pipeline.should_pipeline(client, nbytes,
+                                                          cfg))
+            if use_pipe:
+                try:
+                    return _exchange_pipelined(
+                        client, local_flow, peer_flow, data, peer_host,
+                        peer_port, cfg, timeout_s)
+                except (DcnXferError, OSError) as e:
+                    if pipelined:  # explicitly forced: surface it
+                        raise
+                    counters.inc("dcn.pipeline.fallback")
+                    log.warning(
+                        "pipelined exchange of %r failed (%s); "
+                        "falling back to the serial leg",
+                        local_flow, e,
+                    )
             with trace.span("dcn.exchange.stage",
                             histogram="dcn.exchange.stage"):
                 client.put(local_flow, data)
@@ -202,7 +274,18 @@ def exchange_shard(
             with trace.span("dcn.exchange.land",
                             histogram="dcn.exchange.land"):
                 wait_flow_rx(client, peer_flow, nbytes, timeout_s)
-            return client.read(peer_flow, nbytes)
+            got = client.read(peer_flow, nbytes)
+            if len(got) != nbytes:
+                # With chunked peers, rx accounting can reach nbytes
+                # while the landed frame is still assembling (or spans
+                # two attempts); a short read here is "not landed yet",
+                # never data — surface it so the caller's leg retry
+                # asks the peer to re-send.
+                raise DcnXferError(
+                    f"peer shard {peer_flow!r} read short: "
+                    f"{len(got)} != {nbytes}"
+                )
+            return got
     finally:
         # Release both flows so repeated legs on a long-lived client
         # neither hit the daemon's duplicate-flow rejection nor leak
@@ -215,3 +298,25 @@ def exchange_shard(
                 client.release_flow(flow)
             except (DcnXferError, OSError):
                 pass  # cleanup: a restarted daemon already forgot it
+
+
+def _exchange_pipelined(client, local_flow, peer_flow, data, peer_host,
+                        peer_port, cfg, timeout_s) -> bytes:
+    """The pipelined leg body: overlapped chunked stage+send of the
+    local shard, then land-wait and DXR1 read-back of the peer's.
+    Flows are already registered; the caller owns release."""
+    from container_engine_accelerators_tpu.obs import trace
+    from container_engine_accelerators_tpu.parallel import dcn_pipeline
+
+    nbytes = len(data)
+    with trace.span("dcn.exchange.pipeline",
+                    histogram="dcn.exchange.pipeline",
+                    local_flow=local_flow, bytes=nbytes):
+        dcn_pipeline.send_pipelined(client, local_flow, data,
+                                    peer_host, peer_port, cfg,
+                                    timeout_s=timeout_s)
+        with trace.span("dcn.exchange.land",
+                        histogram="dcn.exchange.land"):
+            wait_flow_rx(client, peer_flow, nbytes, timeout_s)
+        return dcn_pipeline.read_pipelined(client, peer_flow, nbytes,
+                                           cfg, timeout_s=timeout_s)

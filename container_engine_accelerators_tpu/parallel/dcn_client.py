@@ -20,6 +20,7 @@ import json
 import logging
 import socket
 import struct
+import time
 from typing import Dict, Optional
 
 from container_engine_accelerators_tpu.metrics import counters
@@ -37,6 +38,11 @@ class DcnXferError(Exception):
     pass
 
 
+class DcnWaitUnsupported(DcnXferError):
+    """The daemon has no blocking ``wait`` op (the native daemon, the
+    test stub) — callers fall back to adaptive polling."""
+
+
 class DcnXferClient:
     def __init__(self, uds_dir: str = DEFAULT_UDS_DIR, timeout_s: float = 10.0):
         self._uds_dir = uds_dir.rstrip("/")
@@ -47,6 +53,10 @@ class DcnXferClient:
         # Per-flow monotonic frame sequence for `send` (client-owned:
         # it must survive daemon restarts, which reset daemon state).
         self._send_seq: Dict[str, int] = {}
+        # Daemon capability cache (version-op response); tri-state for
+        # the wait op so the unsupported path is probed exactly once.
+        self._caps: Optional[dict] = None
+        self._wait_supported: Optional[bool] = None
         self._connect()
 
     def _connect(self) -> None:
@@ -118,6 +128,20 @@ class DcnXferClient:
 
     def version(self) -> str:
         return self._call(op="version")["version"]
+
+    def capabilities(self) -> dict:
+        """The version-op response, cached: daemons advertise protocol
+        extensions here (``frame_version``, ``pipeline``); absent keys
+        mean the native DXF1-only daemon."""
+        if self._caps is None:
+            self._caps = self._call(op="version")
+        return self._caps
+
+    def frame_version(self) -> int:
+        return int(self.capabilities().get("frame_version", 1))
+
+    def supports_pipeline(self) -> bool:
+        return bool(self.capabilities().get("pipeline", 0))
 
     def ping(self) -> None:
         self._call(op="ping")
@@ -217,8 +241,67 @@ class DcnXferClient:
         with socket.create_connection((host, port), timeout=30) as s:
             s.sendall(hdr + name + data)
 
-    def stats(self) -> dict:
-        return self._call(op="stats")
+    def stats(self, flow: Optional[str] = None) -> dict:
+        """Daemon stats.  ``flow`` asks a filter-aware daemon
+        (PyXferd) for just that flow's entry; daemons that predate the
+        filter ignore the key and return everything — callers must
+        still select their flow from the list."""
+        req = {"op": "stats"}
+        if flow is not None:
+            req["flow"] = flow
+        return self._call(**req)
+
+    # Wait-op slice: short enough that a daemon thread is never parked
+    # long on a dead client, long enough that slicing costs nothing.
+    WAIT_SLICE_S = 1.0
+
+    def wait_rx(self, flow: str, nbytes: int, timeout_s: float = 60.0,
+                mode: str = "rx") -> dict:
+        """Block INSIDE the daemon until ``flow`` has ``nbytes`` of rx
+        accounting (mode ``rx``) or a completed frame of at least
+        ``nbytes`` (mode ``frame``).
+
+        This replaces the 20 ms client-side poll quantum with a
+        condition-variable wakeup: small transfers stop paying up to a
+        full quantum of idle tax per phase.  The wait is sliced so the
+        daemon never holds a thread past :data:`WAIT_SLICE_S` per
+        round trip.  Raises :class:`DcnWaitUnsupported` (once probed,
+        instantly) for daemons without the op, and ``TimeoutError``
+        past the deadline — the same contract as the polling fallback
+        in ``parallel.dcn.wait_flow_rx``.
+        """
+        if self._wait_supported is False:
+            raise DcnWaitUnsupported("daemon has no wait op")
+        deadline = time.monotonic() + timeout_s
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"flow {flow!r} never reached {nbytes} bytes "
+                    f"({mode})"
+                )
+            try:
+                resp = self._call(
+                    op="wait", flow=flow, bytes=nbytes, mode=mode,
+                    timeout_ms=int(min(remaining, self.WAIT_SLICE_S)
+                                   * 1e3),
+                )
+            except DcnXferError as e:
+                if "unknown op" in str(e):
+                    self._wait_supported = False
+                    raise DcnWaitUnsupported(str(e))
+                if "unknown flow" in str(e):
+                    # Same contract as the polling fallback: a flow
+                    # that is not registered YET (mid-restart replay on
+                    # the other side of a race) is "zero bytes so far",
+                    # not an error — keep waiting until the deadline.
+                    self._wait_supported = True
+                    time.sleep(0.005)
+                    continue
+                raise
+            self._wait_supported = True
+            if resp.get("done"):
+                return resp
 
 
 # Reconnect budget tuned to ride out a daemon restart (the DaemonSet's
@@ -446,7 +529,7 @@ class ResilientDcnXferClient(DcnXferClient):
         the replay — exactly-once either way."""
         data = self._staged.get(flow)
         if data is not None:
-            st = next((f for f in self.stats()["flows"]
+            st = next((f for f in self.stats(flow=flow)["flows"]
                        if f["flow"] == flow), None)
             if st is not None and not st.get("frame_bytes", len(data)):
                 self._restage(flow, data)

@@ -228,6 +228,24 @@ class TestDcnFaultSites:
                 c.ping()
             assert inj.fired("dcn.connect") == 2
 
+    def test_empty_exchange_shard_never_touches_the_data_plane(
+            self, xstub):
+        """The empty-shard short-circuit, proved the hard way: the
+        stub daemon has NO data plane (no data_port/put/send), so an
+        exchange of zero bytes only completes if the leg really is
+        register + barrier + release and nothing else."""
+        from container_engine_accelerators_tpu.parallel import dcn
+
+        hit = []
+        with ResilientDcnXferClient(xstub.uds_dir,
+                                    retry=FAST_RETRY) as c:
+            got = dcn.exchange_shard(
+                c, local_flow="empty.tx", peer_flow="empty.rx",
+                data=b"", peer_host="127.0.0.1", peer_port=1,
+                barrier=lambda: hit.append(1), timeout_s=5)
+            assert got == b"" and hit == [1]
+            assert c.stats()["active_flows"] == 0  # released on exit
+
     def test_daemon_level_errors_still_fail_fast(self, xstub):
         """Only transport loss retries; an ok:false reply must surface
         immediately (retrying a rejected request is wrong).  Private

@@ -42,7 +42,7 @@ from container_engine_accelerators_tpu.fleet.topology import (
 )
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import trace
-from container_engine_accelerators_tpu.parallel import dcn
+from container_engine_accelerators_tpu.parallel import dcn, dcn_pipeline
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnXferError,
     ResilientDcnXferClient,
@@ -342,6 +342,139 @@ class TestFrameDedup:
             cb.close()
             a.stop()
             b.stop()
+
+
+# Small grid so the chaos scenarios exercise real multi-chunk
+# transfers in milliseconds: 16 KiB payload = 4 chunks.
+PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2)
+PIPE_PAYLOAD = bytes(range(256)) * 64  # 16 KiB
+PIPE_N = len(PIPE_PAYLOAD)
+
+
+@pytest.mark.chaos
+class TestPipelinedChunkChaos:
+    """ISSUE 4 chaos bar: exactly-once PER CHUNK.  After any replay or
+    loss, the assembled payload is byte-exact — no duplicated chunk,
+    no zero-filled chunk."""
+
+    def _fleet_pair(self, tmp_path):
+        topo = FleetTopology(build_specs(2, racks=2))
+        table = LinkTable(topo)
+        net = FleetNet(table)
+        a = PyXferd(str(tmp_path / "a"), node="n0", net=net).start()
+        b = PyXferd(str(tmp_path / "b"), node="n1", net=net).start()
+        net.register("n0", a)
+        net.register("n1", b)
+        ca = ResilientDcnXferClient(str(tmp_path / "a"), retry=FAST_RETRY)
+        cb = ResilientDcnXferClient(str(tmp_path / "b"), retry=FAST_RETRY)
+        return net, table, a, b, ca, cb
+
+    def test_kill_mid_send_lost_response_chunks_land_once(
+            self, xferd_pair):
+        """THE kill-mid-send shape, chunk edition: the sender's daemon
+        streams a chunk but the op response dies with the control
+        connection.  The retry round re-sends under the SAME seqs; the
+        already-landed chunk dedups, the rest land — the assembled
+        payload is byte-exact with no double-landed bytes."""
+        a, b, ca, cb = xferd_pair
+        cb.register_flow("pk", bytes=PIPE_N)
+        ca.register_flow("pk", bytes=PIPE_N)
+        d0 = counters.get("dcn.frames.deduped")
+        a.drop_response_once("send")
+        res = dcn_pipeline.send_pipelined(
+            ca, "pk", PIPE_PAYLOAD, "127.0.0.1", b.data_port, PIPE_CFG,
+            timeout_s=10)
+        assert res["rounds"] >= 2  # the lost response forced a retry
+        _wait_stable_rx(cb, "pk", PIPE_N)  # exactly PIPE_N — not PIPE_N + a chunk
+        assert counters.get("dcn.frames.deduped") == d0 + 1
+        assert dcn_pipeline.read_pipelined(cb, "pk", PIPE_N, PIPE_CFG) \
+            == PIPE_PAYLOAD
+
+    def test_receiver_kill9_mid_pipelined_transfer(self, tmp_path):
+        """Kill -9 the receiving daemon with chunks in flight: the
+        transfer fails loudly (the fleet fabric routes by live data
+        port), and the caller-level retry after the restart lands a
+        complete, byte-exact payload into the fresh daemon — no
+        zero-filled chunks from the dead incarnation."""
+        net, _table, a, b, ca, cb = self._fleet_pair(tmp_path)
+        try:
+            cb.register_flow("rk", bytes=PIPE_N)
+            ca.register_flow("rk", bytes=PIPE_N)
+            b.stop(crash=True)
+            with pytest.raises(DcnXferError, match="unconfirmed"):
+                dcn_pipeline.send_pipelined(
+                    ca, "rk", PIPE_PAYLOAD, "127.0.0.1", b.data_port,
+                    PIPE_CFG, timeout_s=3)
+            b.start()
+            net.register("n1", b)
+            cb.ping()  # reconnect + flow-table replay re-registers rk
+            res = dcn_pipeline.send_pipelined(
+                ca, "rk", PIPE_PAYLOAD, "127.0.0.1", b.data_port,
+                PIPE_CFG, timeout_s=10)
+            assert res["rounds"] == 1
+            _wait_stable_rx(cb, "rk", PIPE_N)
+            assert cb.stats()["generation"] == 2
+            assert dcn_pipeline.read_pipelined(cb, "rk", PIPE_N, PIPE_CFG) \
+                == PIPE_PAYLOAD
+        finally:
+            ca.close()
+            cb.close()
+            a.stop()
+            b.stop()
+
+    def test_link_loss_retransmits_only_lost_chunks(self, tmp_path):
+        """Loss ≠ replay, chunk edition: the link eats two chunk
+        frames in flight; the sender's fabric verdicts say 'dropped',
+        the retry round re-sends exactly those chunks under their
+        original seqs, and they LAND (never-landed seqs pass the
+        window) — zero dups, byte-exact assembly."""
+        net, table, a, b, ca, cb = self._fleet_pair(tmp_path)
+        try:
+            cb.register_flow("lk", bytes=PIPE_N)
+            ca.register_flow("lk", bytes=PIPE_N)
+            d0 = counters.get("dcn.frames.deduped")
+            table.apply("node:n0->node:n1:drop:2")
+            res = dcn_pipeline.send_pipelined(
+                ca, "lk", PIPE_PAYLOAD, "127.0.0.1", b.data_port,
+                PIPE_CFG, timeout_s=10)
+            assert res["rounds"] == 2
+            _wait_stable_rx(cb, "lk", PIPE_N)
+            link = table.report()["n0->n1"]
+            assert link["drops"] == 2
+            assert link["dups"] == 0  # lost chunks were never replays
+            assert counters.get("dcn.frames.deduped") == d0
+            assert dcn_pipeline.read_pipelined(cb, "lk", PIPE_N, PIPE_CFG) \
+                == PIPE_PAYLOAD
+        finally:
+            ca.close()
+            cb.close()
+            a.stop()
+            b.stop()
+
+    def test_pipelined_fleet_scenario_converges_under_partition(self):
+        """The fleet rig's ring workload over the pipelined path:
+        partition mid-run, heal, re-converge — the `make fleet`
+        acceptance leg in miniature."""
+        report = run_scenario({
+            "name": "pipelined-partition",
+            "nodes": 3,
+            "racks": 3,
+            "rounds": 4,
+            "payload_bytes": 32768,
+            "pipelined": True,
+            "chunk_bytes": 8192,
+            "stripes": 2,
+            "faults": [
+                {"round": 1, "link": "rack:r0<->rack:r1:partition",
+                 "for": 2},
+            ],
+        })
+        assert report["converged"]
+        r1 = report["rounds"][1]["legs"]
+        assert any(not leg.get("ok", False) for leg in r1)
+        assert all(leg["ok"] for leg in report["rounds"][-1]["legs"])
+        assert report["agent_events_delta"].get(
+            "dcn.pipeline.transfers", 0) > 0
 
 
 @pytest.mark.chaos
