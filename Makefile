@@ -68,26 +68,34 @@ chaos:
 	TPU_FAULT_SPEC="k8s.patch:conflict@1;dcn.send:fail@4" $(CHAOS_RUN)
 	TPU_FAULT_SPEC="total@@garbage;;not-a-spec" $(CHAOS_RUN)
 
-# Observability gate: the obs/ layer (spans, histograms, flight
-# recorder), its exporter surface, and the no-undocumented-counters
-# README lint.
+# Observability gate: the obs/ layer (spans, histograms, time series,
+# flight recorder), its exporter surface (rates / goodput / gauges /
+# exemplars / SLO verdicts), the no-undocumented-counters README lint,
+# and an agent_top smoke against a live MetricServer.
 .PHONY: obs
 obs:
 	$(PY) -m pytest tests/test_obs.py tests/test_metrics.py \
-	    tests/test_chaos.py -q -p no:randomly
+	    tests/test_telemetry.py tests/test_chaos.py -q -p no:randomly
+	$(PY) cmd/agent_top.py --demo --once > /dev/null
 
 # Fleet gate: the multi-node simulation rig — link-level faults
 # (partition / asymmetric loss / latency), partition-heal
 # re-convergence, frame-seq dedup exactly-once, cross-process trace
 # merging — including the scenarios marked slow, then one CLI run of
-# the headline rack-partition scenario (the acceptance path) and one
-# with the chunked/striped pipelined data plane under the same faults.
+# the headline rack-partition scenario (the acceptance path), one
+# with the chunked/striped pipelined data plane under the same faults,
+# and one SLO-annotated run (the report carries an `slo` section and
+# exit 3 — not 0 — means converged-but-breached; the floors here are
+# honest, so it must pass).
 .PHONY: fleet
 fleet:
 	$(PY) -m pytest tests/test_fleet.py -q -p no:randomly
 	$(PY) cmd/fleet_sim.py --rounds 5 > /dev/null
 	$(PY) cmd/fleet_sim.py --rounds 5 --pipelined \
 	    --payload-bytes 262144 --chunk-bytes 65536 > /dev/null
+	$(PY) cmd/fleet_sim.py --rounds 5 \
+	    --slo min_goodput_bps=64 --slo p99_leg_ms=60000 \
+	    --slo max_dedup_ratio=1.0 > /dev/null
 
 # DCN pipelining gate: the serial-vs-pipelined microbench on the
 # loopback rig.  --compare exits non-zero if the pipelined path falls

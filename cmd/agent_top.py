@@ -1,0 +1,326 @@
+#!/usr/bin/env python3
+"""agent_top — htop for the node agent, over a plain Prometheus scrape.
+
+The MetricServer already exports everything an operator needs
+(`agent_rate`, `agent_goodput`, `agent_gauge`, `agent_latency`,
+`agent_exemplar`); what was missing is a way to LOOK
+at it without standing up a Prometheus + Grafana stack next to a
+misbehaving node.  This tool is that way: it scrapes the HTTP endpoint
+(stdlib urllib, no dependencies — it must run in the barest debug
+container), digests the families into one screen, and refreshes in
+place:
+
+- **rates**: the busiest windowed counters (events/s), which is the
+  "is it happening NOW" view the cumulative `agent_events` can't give;
+- **goodput**: landed bytes/s per flow / link / node;
+- **latency**: per-op p50/p99 computed from the cumulative le buckets,
+  with each op's worst-sample trace exemplar — copy the id into
+  ``cmd/agent_trace.py --trace <id>`` (or just run ``--exemplar <op>``
+  on the JSONL) and the metric becomes a span tree;
+- **gauges + SLO status**: in-flight chunks, stripe utilization,
+  retransmit ratio, and every ``slo.<key>`` verdict the fleet
+  aggregator published, rendered ok/BREACH.
+
+Usage:
+  python cmd/agent_top.py                       # live, 2s refresh
+  python cmd/agent_top.py --port 2112 --once    # one snapshot (CI)
+  python cmd/agent_top.py --url http://node:2112/metrics
+  python cmd/agent_top.py --demo --once         # self-contained tour:
+                                                # boots a MetricServer
+                                                # with synthetic traffic
+
+`--once` prints a single snapshot and exits 0 (1 when the scrape
+fails) — the CI-able acceptance surface.
+"""
+
+import argparse
+import os
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)$")
+_LABEL_RE = re.compile(r'(\w+)="((?:\\.|[^"\\])*)"')
+
+FAMILIES = ("agent_rate", "agent_goodput", "agent_gauge",
+            "agent_latency", "agent_exemplar")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--url", default=None,
+                   help="full metrics URL (overrides --host/--port)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=2112)
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in live mode (seconds)")
+    p.add_argument("--top", type=int, default=10,
+                   help="rows per section")
+    p.add_argument("--demo", action="store_true",
+                   help="boot a local MetricServer with synthetic "
+                        "traffic and scrape it (self-contained tour / "
+                        "CI smoke)")
+    return p.parse_args(argv)
+
+
+# -- scrape + parse ----------------------------------------------------------
+
+
+def scrape(url: str, timeout_s: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read().decode()
+
+
+def parse_families(text: str) -> dict:
+    """Prometheus text exposition -> {family: [(labels, value)]} for
+    the agent families (everything else is skipped)."""
+    out = {name: [] for name in FAMILIES}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        if name not in out:
+            continue
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = {k: v.replace('\\"', '"')
+                  for k, v in _LABEL_RE.findall(raw_labels or "")}
+        out[name].append((labels, value))
+    return out
+
+
+def percentile_from_buckets(buckets, total, q):
+    """Smallest le bound whose cumulative count reaches q*total —
+    same upper-bound contract as obs/histo.percentile, in µs."""
+    if not total:
+        return 0.0
+    target = q * total
+    for le in sorted(buckets):
+        if buckets[le] >= target:
+            return float(le)
+    return float(max(buckets)) if buckets else 0.0
+
+
+def digest(fams: dict) -> dict:
+    """Family samples -> the screen model."""
+    rates = sorted(
+        ((lb.get("event", "?"), v) for lb, v in fams["agent_rate"]),
+        key=lambda kv: -kv[1])
+    goodput = sorted(
+        ((lb.get("scope", "?"), lb.get("name", "?"), v)
+         for lb, v in fams["agent_goodput"]),
+        key=lambda row: -row[2])
+
+    per_op = {}
+    for lb, v in fams["agent_latency"]:
+        op, bucket = lb.get("op", "?"), lb.get("bucket", "")
+        entry = per_op.setdefault(op, {"buckets": {}, "count": 0})
+        if bucket == "+Inf":
+            entry["count"] = int(v)
+        else:
+            try:
+                entry["buckets"][int(bucket)] = v
+            except ValueError:
+                pass
+    exemplars = {}
+    for lb, v in fams["agent_exemplar"]:
+        op = lb.get("op", "?")
+        worst = exemplars.get(op)
+        if worst is None or v > worst[1]:
+            exemplars[op] = (lb.get("trace", ""), v)
+    latency = []
+    for op, entry in per_op.items():
+        latency.append({
+            "op": op,
+            "count": entry["count"],
+            "p50_us": percentile_from_buckets(
+                entry["buckets"], entry["count"], 0.50),
+            "p99_us": percentile_from_buckets(
+                entry["buckets"], entry["count"], 0.99),
+            "exemplar": exemplars.get(op, ("", 0.0))[0],
+        })
+    latency.sort(key=lambda r: -r["count"])
+
+    gauges, slos = [], {}
+    for lb, v in fams["agent_gauge"]:
+        name = lb.get("name", "?")
+        if name.startswith("slo."):
+            key, _, field = name[4:].rpartition(".")
+            if field in ("ok", "value") and key:
+                slos.setdefault(key, {})[field] = v
+                continue
+        gauges.append((name, v))
+    gauges.sort()
+    return {"rates": rates, "goodput": goodput,
+            "latency": latency, "gauges": gauges, "slos": slos}
+
+
+# -- render ------------------------------------------------------------------
+
+
+def human_bps(v: float) -> str:
+    for unit in ("B/s", "KiB/s", "MiB/s", "GiB/s"):
+        if abs(v) < 1024 or unit == "GiB/s":
+            return f"{v:.1f} {unit}"
+        v /= 1024
+    return f"{v:.1f} GiB/s"  # pragma: no cover — loop always returns
+
+
+def render(model: dict, source: str, top_n: int = 10) -> str:
+    lines = [f"agent_top — {source} — {time.strftime('%H:%M:%S')}"]
+
+    slos = model["slos"]
+    if slos:
+        lines.append("")
+        lines.append("SLO status:")
+        for key in sorted(slos):
+            entry = slos[key]
+            ok = entry.get("ok", 0.0) >= 1.0
+            lines.append(f"  {key:<24} {entry.get('value', 0.0):>14.3f} "
+                         f"{'ok' if ok else '** BREACH **'}")
+
+    goodput = [g for g in model["goodput"]][:top_n]
+    if goodput:
+        lines.append("")
+        lines.append(f"{'goodput':<8} {'name':<32} {'landed':>14}")
+        for scope, name, v in goodput:
+            lines.append(f"{scope:<8} {name:<32} {human_bps(v):>14}")
+
+    rates = [r for r in model["rates"] if r[1] > 0][:top_n]
+    if rates:
+        lines.append("")
+        lines.append(f"{'rate (windowed)':<44} {'per second':>12}")
+        for name, v in rates:
+            unit = human_bps(v) if name.endswith(".bytes") else f"{v:.2f}"
+            lines.append(f"{name:<44} {unit:>12}")
+
+    latency = model["latency"][:top_n]
+    if latency:
+        lines.append("")
+        lines.append(f"{'op':<26} {'count':>7} {'p50_us':>9} "
+                     f"{'p99_us':>10}  exemplar")
+        for r in latency:
+            lines.append(f"{r['op']:<26} {r['count']:>7} "
+                         f"{r['p50_us']:>9.0f} {r['p99_us']:>10.0f}  "
+                         f"{r['exemplar']}")
+
+    gauges = model["gauges"][:top_n]
+    if gauges:
+        lines.append("")
+        lines.append(f"{'gauge':<44} {'value':>12}")
+        for name, v in gauges:
+            lines.append(f"{name:<44} {v:>12.3f}")
+
+    if len(lines) == 1:
+        lines.append("")
+        lines.append("(no agent_* series yet — is anything running?)")
+    return "\n".join(lines)
+
+
+# -- demo mode ---------------------------------------------------------------
+
+
+def _demo_server():
+    """A throwaway MetricServer fed with synthetic traffic — the
+    self-contained tour (and the `make obs` smoke)."""
+    from prometheus_client import CollectorRegistry
+
+    from container_engine_accelerators_tpu.metrics import counters
+    from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+    from container_engine_accelerators_tpu.obs import timeseries, trace
+    from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+    class _NoChips:
+        def collect_tpu_device(self, name):  # pragma: no cover
+            raise RuntimeError("no chips in demo")
+
+        def devices(self):
+            return []
+
+        def model(self, name):  # pragma: no cover
+            return "demo"
+
+    for _ in range(40):
+        with trace.span("dcn.send", histogram="dcn.send", op="demo"):
+            pass
+    with trace.span("dcn.replay", histogram="dcn.replay", flows=2):
+        time.sleep(0.02)
+    counters.inc("dcn.reconnect.success", 3)
+    counters.inc("dcn.frames.deduped")
+    timeseries.record("xferd.rx.bytes", 6 << 20)
+    timeseries.record("goodput.link.n0->n1", 4 << 20)
+    timeseries.record("goodput.flow.demo.ring", 2 << 20)
+    timeseries.gauge("dcn.chunks.inflight", 3)
+    timeseries.gauge("dcn.stripes.active", 2)
+    timeseries.gauge("dcn.stripes.configured", 2)
+    timeseries.gauge("slo.min_goodput_bps.ok", 1)
+    timeseries.gauge("slo.min_goodput_bps.value", 4 << 20)
+
+    server = MetricServer(
+        collector=_NoChips(), registry=CollectorRegistry(), port=0,
+        pod_resources_socket="/nonexistent-demo.sock",
+        collection_interval_s=3600,
+    )
+    server.start(retry=RetryPolicy(max_attempts=4,
+                                   initial_backoff_s=0.05))
+    server.collect_once()
+    return server
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    server = None
+    if args.demo:
+        server = _demo_server()
+        url = f"http://127.0.0.1:{server.port}/metrics"
+    else:
+        url = args.url or f"http://{args.host}:{args.port}/metrics"
+    screen = None
+    try:
+        while True:
+            try:
+                body = scrape(url)
+                screen = render(digest(parse_families(body)), url,
+                                args.top)
+                banner = ""
+            except (urllib.error.URLError, OSError) as e:
+                if args.once or screen is None:
+                    # No snapshot to fall back on: hard-fail (the CI
+                    # contract, and the very first live poll).
+                    print(f"scrape of {url} failed: {e}",
+                          file=sys.stderr)
+                    return 1
+                # Live mode keeps watching through a blip — a node
+                # struggling enough to miss a scrape is exactly the
+                # node the operator must not lose sight of.
+                banner = (f"\n\n** scrape failed "
+                          f"({time.strftime('%H:%M:%S')}): {e} — "
+                          f"showing last snapshot **")
+            if args.once:
+                print(screen)
+                return 0
+            # Live mode: repaint in place (clear + home), like top.
+            sys.stdout.write("\x1b[2J\x1b[H" + screen + banner + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if server is not None:
+            server.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -13,7 +13,13 @@ flapping node raises:
   (``attrs.fault`` stamped by utils/faults.py);
 - optionally one full trace reconstructed as a parent/child tree
   (``--trace <id>``), e.g. a reconnect with its flow replays nested
-  under it.
+  under it;
+- ``--exemplar <op-or-trace-id>``: resolve a latency exemplar in one
+  hop.  The scrape's ``agent_exemplar{op,bucket,trace}`` row names the
+  trace of an op's worst sample; pass the OP name and this finds the
+  slowest span of that name in the JSONL and prints its whole trace
+  tree (pass the scraped trace id itself and it resolves that id,
+  prefix-matching allowed) — metric → trace without grep.
 
 Also accepts flight-recorder dumps (obs/flight.py): a line whose
 object carries ``flight_recorder`` contributes its ``spans`` list.
@@ -51,6 +57,10 @@ def parse_args(argv=None):
     p.add_argument("--trace", default=None, metavar="ID",
                    help="print this trace id as a span tree instead of "
                         "aggregating")
+    p.add_argument("--exemplar", default=None, metavar="OP|TRACE",
+                   help="resolve a scraped agent_exemplar to its trace "
+                        "tree: an op name picks that op's slowest span; "
+                        "a trace id (prefix ok) resolves directly")
     return p.parse_args(argv)
 
 
@@ -172,6 +182,17 @@ def print_tree(spans, trace_id, file=sys.stderr):
     return len(mine)
 
 
+def resolve_exemplar(spans, key):
+    """An op name -> its slowest span; a trace id (or unique prefix)
+    -> any span of that trace.  None when nothing matches."""
+    named = [s for s in spans if s.get("name") == key]
+    if named:
+        return max(named, key=lambda s: float(s.get("dur_us", 0.0)))
+    by_id = [s for s in spans
+             if str(s.get("trace", "")).startswith(key)]
+    return by_id[0] if by_id else None
+
+
 def main(argv=None):
     args = parse_args(argv)
     spans, skipped = load_spans(args.paths)
@@ -179,6 +200,22 @@ def main(argv=None):
         raise SystemExit(
             f"no spans in {', '.join(args.paths)} ({skipped} bad lines)"
         )
+    if args.exemplar:
+        hit = resolve_exemplar(spans, args.exemplar)
+        if hit is None:
+            raise SystemExit(
+                f"no span named {args.exemplar!r} and no trace id "
+                f"matching it in {', '.join(args.paths)}"
+            )
+        trace_id = hit.get("trace")
+        print(f"exemplar {args.exemplar!r}: worst span "
+              f"{hit.get('name')} {float(hit.get('dur_us', 0)):.0f}us "
+              f"in trace {trace_id}", file=sys.stderr)
+        n = print_tree(spans, trace_id)
+        print(json.dumps({"exemplar": args.exemplar, "trace": trace_id,
+                          "name": hit.get("name"),
+                          "dur_us": hit.get("dur_us"), "spans": n}))
+        return
     if args.trace:
         n = print_tree(spans, args.trace)
         print(json.dumps({"trace": args.trace, "spans": n}))

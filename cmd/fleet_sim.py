@@ -22,9 +22,14 @@ Usage:
 
 Prints human-readable per-node and per-link tables to stderr and one
 JSON report line to stdout (the repo's CLI contract, like
-agent_trace.py).  Exits 0 iff the fleet converged: every surviving
-node's final-round legs completed and every surviving node is fully
-healthy again.
+agent_trace.py).  Exit code: 0 iff the fleet converged AND every
+configured SLO held; 2 when it never re-converged; 3 when it converged
+but breached an SLO (`slo:` in the scenario spec, or `--slo KEY=VALUE`
+— a lossy fleet that still "works" while delivering a third of its
+goodput floor must fail CI, not just dent a dashboard):
+
+  python cmd/fleet_sim.py --slo min_goodput_bps=4096 \
+                          --slo p99_leg_ms=500
 """
 
 import argparse
@@ -38,6 +43,9 @@ from container_engine_accelerators_tpu.fleet.controller import (  # noqa: E402
     DEFAULT_SCENARIO,
     load_scenario,
     run_scenario,
+)
+from container_engine_accelerators_tpu.fleet.telemetry import (  # noqa: E402
+    SLO_KEYS,
 )
 from container_engine_accelerators_tpu.obs import trace  # noqa: E402
 
@@ -65,6 +73,11 @@ def parse_args(argv=None):
                         "or 2)")
     p.add_argument("--metrics", action="store_true",
                    help="start a per-node MetricServer (ephemeral ports)")
+    p.add_argument("--slo", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="add/override one SLO (repeatable): p99_leg_ms, "
+                        "min_goodput_bps, max_retransmit_ratio, "
+                        "max_dedup_ratio; breach exits 3")
     p.add_argument("--trace-file", default=None,
                    help="write the run's span JSONL here "
                         "(summarize with cmd/agent_trace.py)")
@@ -97,6 +110,14 @@ def _print_report(report, file=sys.stderr):
     if report["agent_events_delta"]:
         print(f"\nagent events (delta): "
               f"{report['agent_events_delta']}", file=file)
+    slo = report.get("slo") or {}
+    if slo.get("checks"):
+        print(f"\n{'slo':<22} {'kind':>8} {'limit':>12} {'value':>12} "
+              f"{'ok':>4}", file=file)
+        for c in slo["checks"]:
+            print(f"{c['slo']:<22} {c['kind']:>8} {c['limit']:>12g} "
+                  f"{c['value']:>12g} {'ok' if c['ok'] else 'FAIL':>4}",
+                  file=file)
 
 
 def main(argv=None):
@@ -115,6 +136,23 @@ def main(argv=None):
         scenario["pipelined"] = True
     if args.metrics:
         scenario["metrics"] = True
+    if args.slo:
+        # A scenario file may carry a malformed slo: section; --slo
+        # must still work (the section itself degrades in telemetry).
+        # But an --slo the OPERATOR typed is an explicit CI gate: a
+        # typo'd key must fail the invocation, not silently evaluate
+        # zero checks and exit 0.
+        slo = scenario.get("slo")
+        slo = dict(slo) if isinstance(slo, dict) else {}
+        for entry in args.slo:
+            key, sep, value = entry.partition("=")
+            if not sep or key not in SLO_KEYS:
+                print(f"bad --slo {entry!r}: want KEY=VALUE with KEY "
+                      f"one of {', '.join(sorted(SLO_KEYS))}",
+                      file=sys.stderr)
+                return 2
+            slo[key] = value
+        scenario["slo"] = slo
     if args.trace_file:
         trace.configure(args.trace_file)
 
@@ -124,7 +162,9 @@ def main(argv=None):
     print(json.dumps(report))
     if args.trace_file:
         trace.configure(None)  # flush/close the sink
-    return 0 if report["converged"] else 2
+    if not report["converged"]:
+        return 2
+    return 0 if report["slo"]["ok"] else 3
 
 
 if __name__ == "__main__":

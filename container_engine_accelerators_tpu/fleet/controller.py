@@ -26,8 +26,13 @@ schedules its inverse K rounds later (partition→heal, kill→restart).
 The run returns one report: per-node (device health, daemon
 generation, legs ok/failed), per-link (frames/bytes/drops/dups/blocked,
 tier-annotated by the production scheduler distance), the round log,
-and the fleet-wide ``agent_events`` / ``agent_latency`` deltas — the
-single pane the single-node MetricServer cannot give you.
+the fleet-wide ``agent_events`` / ``agent_latency`` deltas, a
+``telemetry`` section (per-round windowed goodput per ``{node, link}``
+from fleet/telemetry.py), and an ``slo`` section evaluating the
+scenario's declarative SLOs (``slo:`` mapping — p99 leg-latency
+ceiling, goodput floor, retransmit/dedup ratio caps).  A scenario can
+therefore *converge* and still FAIL: ``cmd/fleet_sim.py`` exits
+non-zero on SLO breach, not just on non-convergence.
 """
 
 import json
@@ -44,6 +49,7 @@ from container_engine_accelerators_tpu.fleet.links import (
     parse_link_fault,
 )
 from container_engine_accelerators_tpu.fleet.node import EmulatedNode
+from container_engine_accelerators_tpu.fleet.telemetry import FleetTelemetry
 from container_engine_accelerators_tpu.fleet.topology import (
     FleetTopology,
     NodeSpec,
@@ -143,6 +149,7 @@ class FleetController:
         self._deferred: Dict[int, List[dict]] = {}
         self._booted = False
         self._counters0: Dict[str, int] = {}
+        self.telemetry: Optional[FleetTelemetry] = None
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -157,6 +164,9 @@ class FleetController:
                 metrics=bool(self.scenario.get("metrics", False)),
             )
         self._counters0 = counters.snapshot()
+        self.telemetry = FleetTelemetry(
+            self.nodes, self.links, self.scenario.get("slo")
+        )
         self._booted = True
         log.info("fleet booted: %d node(s) in %d rack(s)",
                  len(self.nodes),
@@ -331,6 +341,9 @@ class FleetController:
                             per_node_failed[src.name] += 1
                     for node in self.nodes.values():
                         node.recover()
+                # Scrape every node's registry while the round's
+                # traffic is still inside the rate window.
+                self.telemetry.sample_round(rnd)
                 round_log.append(
                     {"round": rnd, "faults": fired, "legs": legs}
                 )
@@ -367,13 +380,16 @@ class FleetController:
             for op, h in histo.snapshot().items()
             if op.startswith(("fleet.", "xferd.", "dcn."))
         }
+        links_report = self.links.report()
         return {
             "scenario": self.scenario.get("name", "fleet"),
             "nodes": nodes_report,
-            "links": self.links.report(),
+            "links": links_report,
             "rounds": round_log,
             "agent_events_delta": delta,
             "agent_latency": latency,
+            "telemetry": {"rounds": self.telemetry.history},
+            "slo": self.telemetry.evaluate(links_report),
             "converged": survivors_converged and all_up_healthy,
         }
 

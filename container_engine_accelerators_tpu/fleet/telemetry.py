@@ -1,0 +1,192 @@
+"""Fleet telemetry: per-round scrapes + declarative SLO verdicts.
+
+The fleet report (fleet/controller.py) already says whether a scenario
+*converged* — every surviving node's final legs completed.  That is a
+liveness verdict, and liveness is a low bar: a fleet that re-sends
+every chunk three times through a lossy link still converges while
+delivering a third of the bandwidth anyone provisioned for.  This
+module adds the quality verdict:
+
+- **scrape**: each round the aggregator reads every emulated node's
+  telemetry — windowed goodput per ``{node, link}`` from
+  obs/timeseries.py (the sim runs nodes in one process, so the series
+  registry is the fleet's, keyed by the ``goodput.node.<n>`` /
+  ``goodput.link.<a>-><b>`` naming convention) plus each daemon's flow
+  accounting — into a round-indexed history;
+
+- **SLOs**: the scenario spec's ``slo:`` mapping declares ceilings and
+  floors, evaluated over the whole run::
+
+      slo:
+        p99_leg_ms: 250            # ceiling: p99 of fleet.leg latency
+        min_goodput_bps: 4096      # floor: delivered link bytes/s
+        max_retransmit_ratio: 0.5  # cap: (drops + dups) / frames
+        max_dedup_ratio: 0.25      # cap: dups / frames
+
+  Unknown keys are logged and skipped — the TPU_FAULT_SPEC rule: a
+  typo'd scenario must degrade, not crash the rig.  Each check also
+  lands in the gauge registry as ``slo.<key>.ok`` / ``slo.<key>.value``
+  so the MetricServer scrape (``agent_gauge``), ``cmd/agent_top.py``,
+  and the flight recorder all show SLO state live.
+
+The controller folds :meth:`FleetTelemetry.evaluate`'s result into the
+report's ``slo`` section and ``cmd/fleet_sim.py`` exits non-zero on
+breach — a fleet that converges while violating its goodput floor
+fails CI, not just a dashboard.
+"""
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+from container_engine_accelerators_tpu.obs import histo, timeseries
+
+log = logging.getLogger(__name__)
+
+# SLO key -> (kind, description).  Ceilings fail when value > limit,
+# floors when value < limit.
+SLO_KEYS = {
+    "p99_leg_ms": ("ceiling", "p99 of fleet.leg latency (ms)"),
+    "min_goodput_bps": ("floor", "delivered link bytes per second"),
+    "max_retransmit_ratio": ("ceiling",
+                             "(link drops + deduped replays) / frames"),
+    "max_dedup_ratio": ("ceiling", "deduped replays / frames"),
+}
+
+# The latency histogram the p99 ceiling reads; one fleet-sim leg with
+# its retries included (fleet/controller.py stamps it).
+LEG_OP = "fleet.leg"
+
+
+def parse_slo_spec(raw: Optional[dict]) -> Dict[str, float]:
+    """Validate a scenario's ``slo:`` mapping: known keys with numeric
+    values survive, everything else is logged and dropped — including
+    a section that is not a mapping at all (a YAML authoring typo must
+    cost the SLOs, not the run)."""
+    if raw is None:
+        return {}
+    if not isinstance(raw, dict):
+        log.error("ignoring slo section of type %s (want a mapping)",
+                  type(raw).__name__)
+        return {}
+    spec: Dict[str, float] = {}
+    for key, value in raw.items():
+        if key not in SLO_KEYS:
+            log.error("ignoring unknown SLO key %r (known: %s)",
+                      key, ", ".join(sorted(SLO_KEYS)))
+            continue
+        try:
+            spec[key] = float(value)
+        except (TypeError, ValueError):
+            log.error("ignoring SLO %r with non-numeric limit %r",
+                      key, value)
+    return spec
+
+
+class FleetTelemetry:
+    """Scrapes the fleet's telemetry each round and renders the SLO
+    verdict at the end of the run."""
+
+    def __init__(self, nodes: dict, links, slo: Optional[dict] = None):
+        self.nodes = nodes
+        self.links = links
+        self.slo = parse_slo_spec(slo)
+        self.history: List[dict] = []
+        self._t0 = time.monotonic()
+        # Histograms are process-global and cumulative; the p99 SLO
+        # must judge THIS run only, so snapshot the leg histogram's
+        # buckets at boot and evaluate the delta (the same baseline
+        # discipline FleetController applies to counters).
+        self._leg0: Dict[str, int] = dict(
+            histo.snapshot().get(LEG_OP, {}).get("buckets", {}))
+
+    # -- per-round scrape ----------------------------------------------------
+
+    def sample_round(self, rnd: int) -> dict:
+        """One scrape across every node: windowed goodput per node and
+        per link, plus each live daemon's flow accounting."""
+        per_node = {}
+        for name, node in self.nodes.items():
+            entry = {
+                "goodput_bps": round(
+                    timeseries.rate(f"goodput.node.{name}"), 1),
+                "down": node.down,
+            }
+            if not node.down:
+                stats = node.daemon._stats()
+                entry["active_flows"] = stats["active_flows"]
+                entry["transferred"] = stats["total_transferred"]
+            per_node[name] = entry
+        per_link = {
+            key: round(timeseries.rate(f"goodput.link.{key}"), 1)
+            for key in self.links.report()
+        }
+        sample = {"round": rnd, "nodes": per_node,
+                  "links_goodput_bps": per_link}
+        self.history.append(sample)
+        return sample
+
+    # -- SLO evaluation ------------------------------------------------------
+
+    def _leg_p99_ms(self) -> float:
+        """p99 of THIS run's fleet.leg observations: current buckets
+        minus the boot baseline, upper-bound quantile like
+        histo.percentile."""
+        now = histo.snapshot().get(LEG_OP, {}).get("buckets", {})
+        delta = {int(le): n - self._leg0.get(le, 0)
+                 for le, n in now.items()
+                 if n - self._leg0.get(le, 0) > 0}
+        total = sum(delta.values())
+        if not total:
+            return 0.0
+        target = 0.99 * total
+        seen = 0
+        for le in sorted(delta):
+            seen += delta[le]
+            if seen >= target:
+                return le / 1e3
+        return max(delta) / 1e3  # pragma: no cover — q <= 1
+
+    def _measurements(self, links_report: Dict[str, dict]) -> dict:
+        elapsed_s = max(time.monotonic() - self._t0, 1e-9)
+        delivered_bytes = sum(l["bytes"] for l in links_report.values())
+        frames = sum(l["frames"] for l in links_report.values())
+        drops = sum(l["drops"] for l in links_report.values())
+        dups = sum(l["dups"] for l in links_report.values())
+        return {
+            "elapsed_s": round(elapsed_s, 3),
+            "p99_leg_ms": self._leg_p99_ms(),
+            "min_goodput_bps": delivered_bytes / elapsed_s,
+            "max_retransmit_ratio": (drops + dups) / max(1, frames),
+            "max_dedup_ratio": dups / max(1, frames),
+        }
+
+    def evaluate(self, links_report: Dict[str, dict]) -> dict:
+        """The report's ``slo`` section: every configured check with
+        its measured value, the limit, and pass/fail; ``ok`` is the
+        conjunction (vacuously true with no SLOs configured).  Each
+        verdict is also published as ``slo.<key>.ok`` /
+        ``slo.<key>.value`` gauges for the live scrape surface."""
+        measured = self._measurements(links_report)
+        checks = []
+        for key, limit in self.slo.items():
+            kind, what = SLO_KEYS[key]
+            value = measured[key]
+            ok = value >= limit if kind == "floor" else value <= limit
+            checks.append({
+                "slo": key, "kind": kind, "what": what,
+                "limit": limit, "value": round(value, 3),
+                "ok": bool(ok),
+            })
+            timeseries.gauge(f"slo.{key}.ok", 1.0 if ok else 0.0)
+            timeseries.gauge(f"slo.{key}.value", value)
+        ok = all(c["ok"] for c in checks)
+        if checks and not ok:
+            breached = [c["slo"] for c in checks if not c["ok"]]
+            log.warning("SLO breach: %s", ", ".join(breached))
+        return {
+            "spec": dict(self.slo),
+            "measured": {k: round(v, 3) for k, v in measured.items()},
+            "checks": checks,
+            "ok": ok,
+        }

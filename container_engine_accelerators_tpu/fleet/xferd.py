@@ -79,7 +79,7 @@ import time
 from typing import Dict, Optional, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import trace
+from container_engine_accelerators_tpu.obs import timeseries, trace
 
 log = logging.getLogger(__name__)
 
@@ -627,6 +627,7 @@ class PyXferd:
             except OSError as e:
                 return {"ok": False, "error": f"send failed: {e}"}
         micros = max(1.0, (time.monotonic() - t0) * 1e6)
+        timeseries.record("xferd.tx.bytes", len(payload))
         with self._lock:
             f = self._flows.get(flow)
             if f is not None:
@@ -824,6 +825,28 @@ class PyXferd:
                                                 meta, seq)
                     self._landed.notify_all()
                 span.annotate(verdict=verdict)
+                if verdict == "landed":
+                    # Goodput = bytes that landed USEFULLY: dups and
+                    # link-eaten frames never reach here.  A frame is
+                    # remote-origin when it rode the fleet fabric or
+                    # carries a sender's node stamp; everything else is
+                    # local staging, tracked as its own series so the
+                    # stage rate never inflates goodput.
+                    remote = link is not None or bool(meta.get("src"))
+                    if remote:
+                        timeseries.record("xferd.rx.bytes", len(payload))
+                        timeseries.record(f"goodput.flow.{flow}",
+                                          len(payload))
+                        if self.node:
+                            timeseries.record(
+                                f"goodput.node.{self.node}", len(payload))
+                        if link is not None:
+                            timeseries.record(
+                                f"goodput.link.{link[0]}->{link[1]}",
+                                len(payload))
+                    else:
+                        timeseries.record("xferd.stage.bytes",
+                                          len(payload))
                 return verdict
 
     def _land_locked(self, flow: str, f: _Flow, payload: bytes,

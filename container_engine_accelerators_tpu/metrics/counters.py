@@ -12,6 +12,12 @@ same Prometheus scrape that feeds the HPA also shows recovery churn.
 Kept dependency-free (stdlib only) so utils/ and parallel/ can import
 it without dragging in prometheus_client or grpc.
 
+Every increment also feeds the windowed time-series layer
+(obs/timeseries.py), so each counter has a per-second rate over the
+trailing window for free — exported as ``agent_rate{event=...}`` next
+to the cumulative ``agent_events``.  The cumulative value answers
+"how many since boot"; the rate answers "is it happening NOW".
+
 Counter name convention: dotted ``<component>.<event>`` —
 ``dcn.reconnect.success``, ``health.recovered``, ``retry.exhausted``,
 ``fault.fired.<site>``.
@@ -20,12 +26,15 @@ Counter name convention: dotted ``<component>.<event>`` —
 import threading
 from typing import Dict
 
+from container_engine_accelerators_tpu.obs import timeseries
+
 _lock = threading.Lock()
 _counters: Dict[str, int] = {}
 
 
 def inc(name: str, n: int = 1) -> int:
     """Add ``n`` to counter ``name`` (created at 0); returns the new value."""
+    timeseries.record(name, n)
     with _lock:
         value = _counters.get(name, 0) + n
         _counters[name] = value

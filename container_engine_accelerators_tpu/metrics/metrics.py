@@ -20,7 +20,20 @@ Exported gauges (container): duty_cycle, memory_total, memory_used, request
                              agent_latency{op=...,bucket=...} — the
                              log2 latency histograms from obs/histo.py
                              as cumulative ``le``-style buckets in
-                             microseconds (bucket="+Inf" = total count)
+                             microseconds (bucket="+Inf" = total count);
+                             agent_rate{event=...} — per-second windowed
+                             rates (obs/timeseries.py) for every counter
+                             and byte series;
+                             agent_goodput{scope=...,name=...} — landed
+                             bytes/s per flow/link/node;
+                             agent_gauge{name=...} — explicit gauges
+                             (in-flight chunks, stripe utilization,
+                             retransmit ratio, SLO verdicts);
+                             agent_exemplar{op=...,bucket=...,trace=...}
+                             — each latency bucket's worst sample's
+                             trace id, value = its duration in µs
+                             (metric → trace in one hop via
+                             ``cmd/agent_trace.py --exemplar <op>``)
 
 ``start`` retries a port conflict under a bounded backoff budget (a
 node agent racing its own previous incarnation's socket TIME_WAIT, or a
@@ -42,7 +55,7 @@ from container_engine_accelerators_tpu.metrics.devices import (
     PodResourcesClient,
     TPU_RESOURCE_NAME,
 )
-from container_engine_accelerators_tpu.obs import histo
+from container_engine_accelerators_tpu.obs import histo, timeseries
 from container_engine_accelerators_tpu.tpulib.types import HbmInfo, TpuLib
 from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
@@ -157,6 +170,35 @@ class MetricServer:
             "microseconds; bucket=\"+Inf\" is the total observation count",
             ["op", "bucket"],
         )
+        self.agent_rate = g(
+            "agent_rate",
+            "Per-second windowed rate (obs/timeseries.py ring buckets, "
+            "window TPU_RATE_WINDOW_S) of every counter and byte "
+            "series on this node agent — decays to zero when the "
+            "activity stops",
+            ["event"],
+        )
+        self.agent_goodput = g(
+            "agent_goodput",
+            "Landed-payload bytes per second over the trailing window, "
+            "per flow / link / node (dedup-dropped replays and "
+            "link-eaten frames never count)",
+            ["scope", "name"],
+        )
+        self.agent_gauge = g(
+            "agent_gauge",
+            "Explicit instantaneous gauges (obs/timeseries.py): "
+            "in-flight chunks, active stripes, retransmit ratio, SLO "
+            "verdict gauges (slo.<key>.ok / slo.<key>.value)",
+            ["name"],
+        )
+        self.agent_exemplar = g(
+            "agent_exemplar",
+            "Trace exemplars: for each agent_latency bucket, the trace "
+            "id of its worst sample (value = that sample's duration in "
+            "microseconds); resolve with cmd/agent_trace.py --exemplar",
+            ["op", "bucket", "trace"],
+        )
         self._httpd = None
         self._http_thread = None
 
@@ -232,6 +274,10 @@ class MetricServer:
             self.memory_used_node,
             self.agent_events,
             self.agent_latency,
+            self.agent_rate,
+            self.agent_goodput,
+            self.agent_gauge,
+            self.agent_exemplar,
         ):
             gauge.clear()
 
@@ -300,6 +346,28 @@ class MetricServer:
                 cumulative += count
                 self.agent_latency.labels(op=op, bucket=le).set(cumulative)
             self.agent_latency.labels(op=op, bucket="+Inf").set(h["count"])
+            # Exemplars: one row per bucket that saw a traced sample —
+            # the trace id travels as a label (Prometheus values are
+            # numeric), the value is the worst sample's duration.
+            for le, ex in h.get("exemplars", {}).items():
+                self.agent_exemplar.labels(
+                    op=op, bucket=le, trace=ex["trace"]
+                ).set(ex["dur_us"])
+
+        # Windowed rates: republished wholesale like the counters —
+        # idle series export an explicit 0.0 (a stopped flow must
+        # scrape as zero, not silently vanish between resets).
+        # goodput.* series split into their own labeled family.
+        for name, per_s in timeseries.rates().items():
+            scoped = timeseries.split_goodput(name)
+            if scoped is not None:
+                self.agent_goodput.labels(
+                    scope=scoped[0], name=scoped[1]
+                ).set(per_s)
+            else:
+                self.agent_rate.labels(event=name).set(per_s)
+        for name, value in timeseries.gauges().items():
+            self.agent_gauge.labels(name=name).set(value)
 
         for chip in self.collector.devices():
             try:

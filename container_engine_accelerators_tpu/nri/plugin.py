@@ -6,6 +6,16 @@ logical conn 1, call ``Runtime.RegisterPlugin`` on logical conn 2, then
 answer Configure/Synchronize/CreateContainer events until the runtime
 closes the connection.  Subscription is CreateContainer-only, like the
 reference plugin (nri_device_injector.go:86).
+
+Resilience (ROADMAP "NRI injector resilience"): containerd restarts are
+routine — every upgrade bounces it — and the ttrpc trunk dies with it.
+``run()`` therefore reconnects with backoff under the shared
+:class:`RetryPolicy` budget and re-registers on the fresh trunk, so a
+runtime bounce costs the plugin a few seconds of deafness instead of
+its life (and the devices of every container created meanwhile).  A
+successful session resets the budget; only the runtime's explicit
+``Shutdown`` (or a spent budget — ``nri.reconnect.failed``) ends the
+loop.  Each re-established session counts ``nri.reconnect``.
 """
 
 import logging
@@ -14,14 +24,30 @@ import threading
 import time
 from typing import Optional
 
+from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.nri import injector
 from container_engine_accelerators_tpu.nri import mux as nri_mux
 from container_engine_accelerators_tpu.nri import nri_v1alpha1_pb2 as pb
 from container_engine_accelerators_tpu.nri.ttrpc import TtrpcClient, TtrpcServer
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
 
 DEFAULT_NRI_SOCKET = "/var/run/nri/nri.sock"
+
+# Rides out a containerd restart (systemd gives it seconds, not
+# minutes) without masking a genuinely absent runtime: connect
+# refusals fail instantly, so coverage is the sum of the sleeps.
+RECONNECT_RETRY = RetryPolicy(
+    max_attempts=8, initial_backoff_s=0.2, max_backoff_s=5.0,
+    deadline_s=60.0,
+)
+
+# A session that lives at least this long counts as a real recovery
+# and resets the consecutive-short-session budget; anything shorter is
+# a runtime that accepts and immediately drops us (crash loop,
+# half-up socket) and must cost backoff, not a zero-sleep spin.
+MIN_SESSION_S = 5.0
 PLUGIN_SERVICE = "nri.pkg.api.v1alpha1.Plugin"
 RUNTIME_SERVICE = "nri.pkg.api.v1alpha1.Runtime"
 PLUGIN_NAME = "device_injector_nri"
@@ -141,10 +167,76 @@ class DeviceInjectorPlugin:
                 serve_thread.join(timeout=5)
                 break
 
-    def run(self) -> None:
+    def _dial(self):
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.connect(self.socket_path)
+        try:
+            sock.connect(self.socket_path)
+        except OSError:
+            sock.close()
+            raise
+        return sock
+
+    def run_once(self) -> None:
+        """One dial + serve session, no reconnect (the pre-resilience
+        contract; ``run()`` wraps this in the backoff loop)."""
+        sock = self._dial()
         try:
             self.run_on_socket(sock)
         finally:
             sock.close()
+
+    def run(self, retry: Optional[RetryPolicy] = None) -> None:
+        """Serve forever, reconnecting with backoff when the trunk
+        dies.  Ends cleanly on the runtime's Shutdown; raises the last
+        OSError once a reconnect budget is spent — against a socket
+        that stays unreachable, OR a runtime that keeps accepting and
+        instantly dropping us (each short-lived session costs a
+        backoff sleep and a budget slot; a session that lives past
+        ``MIN_SESSION_S`` resets the budget).  Either way counts
+        ``nri.reconnect.failed``: graceful degradation, never an
+        unbounded spin."""
+        policy = retry or RECONNECT_RETRY
+        sessions = 0
+        short_sessions = 0
+        while not self._shutdown.is_set():
+            try:
+                sock = policy.call(self._dial, retry_on=(OSError,))
+            except OSError:
+                counters.inc("nri.reconnect.failed")
+                log.error("NRI socket %s unreachable through the whole "
+                          "reconnect budget; giving up", self.socket_path)
+                raise
+            if sessions:
+                counters.inc("nri.reconnect")
+                log.warning("NRI trunk re-established (reconnect #%d); "
+                            "re-registering", sessions)
+            sessions += 1
+            started = time.monotonic()
+            try:
+                # Registration/serving failures are connection loss:
+                # the next lap re-dials.  A TtrpcError surfaces as-is —
+                # the runtime actively refusing us is not a blip.
+                self.run_on_socket(sock)
+            except (OSError, EOFError) as e:
+                log.warning("NRI connection lost: %s", e)
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            if self._shutdown.is_set():
+                break
+            if time.monotonic() - started >= MIN_SESSION_S:
+                short_sessions = 0
+                continue
+            short_sessions += 1
+            if short_sessions >= policy.max_attempts:
+                counters.inc("nri.reconnect.failed")
+                log.error("NRI runtime dropped %d consecutive sessions "
+                          "within %.0fs each; giving up",
+                          short_sessions, MIN_SESSION_S)
+                raise OSError(
+                    f"NRI runtime at {self.socket_path} keeps dropping "
+                    f"the trunk ({short_sessions} short sessions)"
+                )
+            time.sleep(policy.backoff_s(short_sessions - 1))

@@ -7,12 +7,20 @@ one that imports *us*, exporting histograms as ``agent_latency`` next
 to the ``agent_events`` counters).  tests/test_obs.py enforces the
 contract with a blocked-import subprocess.
 
-- ``obs.trace``  spans: trace/span ids, thread-local context, JSONL
-                 sink (``TPU_TRACE_FILE``) + in-memory ring buffer
-- ``obs.histo``  log2-bucket latency histograms with percentiles
-- ``obs.flight`` flight recorder: SIGUSR1 / terminal-failure dumps
+- ``obs.trace``       spans: trace/span ids, thread-local context,
+                      JSONL sink (``TPU_TRACE_FILE``) + ring buffer
+- ``obs.histo``       log2-bucket latency histograms with percentiles
+                      and per-bucket trace exemplars
+- ``obs.timeseries``  windowed ring-bucket rates + explicit gauges
+                      (goodput, in-flight, SLO status)
+- ``obs.flight``      flight recorder: SIGUSR1 / terminal-failure dumps
 """
 
-from container_engine_accelerators_tpu.obs import flight, histo, trace
+from container_engine_accelerators_tpu.obs import (
+    flight,
+    histo,
+    timeseries,
+    trace,
+)
 
-__all__ = ["flight", "histo", "trace"]
+__all__ = ["flight", "histo", "timeseries", "trace"]

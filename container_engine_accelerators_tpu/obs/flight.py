@@ -9,6 +9,9 @@ it emits ONE self-contained JSON blob holding
 - the tail of the span ring buffer (obs/trace.py),
 - the full robustness counter snapshot (metrics/counters.py),
 - every latency histogram (obs/histo.py),
+- the windowed-rate/gauge snapshot (obs/timeseries.py) and the SLO
+  verdict gauges (``slo.*``) — what the node was *doing* when it died,
+  not just its lifetime totals,
 
 to stderr (always — `kubectl logs` is the collection path that needs no
 infrastructure) and appended to ``TPU_FLIGHT_FILE`` when set.
@@ -34,7 +37,7 @@ import time
 from typing import Optional
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import histo, trace
+from container_engine_accelerators_tpu.obs import histo, timeseries, trace
 
 log = logging.getLogger(__name__)
 
@@ -47,6 +50,7 @@ STDERR_MARKER = "TPU_FLIGHT_RECORDER"
 def snapshot(reason: str) -> dict:
     """Assemble the dump blob without emitting it."""
     n = trace._env_int(FLIGHT_SPANS_ENV, DEFAULT_SPANS)
+    rates = timeseries.snapshot()
     return {
         "flight_recorder": 1,  # schema tag for offline tooling
         "reason": reason,
@@ -55,6 +59,12 @@ def snapshot(reason: str) -> dict:
         "spans": trace.tail(n),
         "counters": counters.snapshot(),
         "histograms": histo.snapshot(),
+        # What the node was DOING at death, not just lifetime totals:
+        # windowed per-second rates, live gauges, and any SLO verdict
+        # gauges the fleet aggregator (fleet/telemetry.py) published.
+        "rates": rates,
+        "slo": {name: value for name, value in rates["gauges"].items()
+                if name.startswith("slo.")},
     }
 
 

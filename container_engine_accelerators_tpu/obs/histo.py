@@ -14,23 +14,34 @@ Prometheus-style ``le`` buckets in microseconds, plus ``+Inf`` = total
 count) next to ``agent_events``; ``snapshot()``/``percentile()`` serve
 in-process consumers (the flight recorder, bench p50/p99 reporting).
 
+**Trace exemplars**: each bucket additionally remembers the trace id
+of its worst (longest) sample, so a histogram is never a dead end —
+the scrape's ``agent_exemplar{op,bucket,trace}`` row names the exact
+trace whose JSONL tree explains the tail
+(``cmd/agent_trace.py --exemplar <op>``).  ``obs.trace.span(...,
+histogram=op)`` wires the id through automatically; direct
+``observe()`` callers may pass ``trace_id`` themselves or leave the
+bucket exemplar-less.
+
 Stdlib-only, like the rest of obs/: importable from utils/ and
 parallel/ without prometheus_client.
 """
 
 import threading
-from typing import Dict, Iterable, List, Optional
+from typing import Dict, Iterable, List, Optional, Tuple
 
 _lock = threading.Lock()
 
 
 class _Histo:
-    __slots__ = ("buckets", "count", "sum_s")
+    __slots__ = ("buckets", "count", "sum_s", "exemplars")
 
     def __init__(self):
         self.buckets: Dict[int, int] = {}  # exponent k -> count (le 2^k us)
         self.count = 0
         self.sum_s = 0.0
+        # exponent k -> (trace_id, worst duration s) for that bucket
+        self.exemplars: Dict[int, Tuple[str, float]] = {}
 
 
 _registry: Dict[str, _Histo] = {}
@@ -45,8 +56,11 @@ def bucket_le_us(seconds: float) -> int:
     return 1 << (us - 1).bit_length()
 
 
-def observe(op: str, seconds: float) -> None:
-    """Record one duration for ``op`` (created on first observation)."""
+def observe(op: str, seconds: float,
+            trace_id: Optional[str] = None) -> None:
+    """Record one duration for ``op`` (created on first observation).
+    With ``trace_id`` set, the sample competes for its bucket's
+    exemplar slot: the bucket keeps the id of its WORST sample."""
     le = bucket_le_us(seconds)
     exp = le.bit_length() - 1
     with _lock:
@@ -56,11 +70,16 @@ def observe(op: str, seconds: float) -> None:
         h.buckets[exp] = h.buckets.get(exp, 0) + 1
         h.count += 1
         h.sum_s += seconds
+        if trace_id is not None:
+            worst = h.exemplars.get(exp)
+            if worst is None or seconds > worst[1]:
+                h.exemplars[exp] = (trace_id, seconds)
 
 
 def snapshot() -> Dict[str, dict]:
-    """Point-in-time copy: ``{op: {count, sum_us, buckets{le_us: n}}}``
-    with non-cumulative per-bucket counts (the exporter accumulates)."""
+    """Point-in-time copy: ``{op: {count, sum_us, buckets{le_us: n},
+    exemplars{le_us: {trace, dur_us}}}}`` with non-cumulative
+    per-bucket counts (the exporter accumulates)."""
     with _lock:
         return {
             op: {
@@ -70,9 +89,25 @@ def snapshot() -> Dict[str, dict]:
                     str(1 << exp): n
                     for exp, n in sorted(h.buckets.items())
                 },
+                "exemplars": {
+                    str(1 << exp): {"trace": t,
+                                    "dur_us": round(d * 1e6, 1)}
+                    for exp, (t, d) in sorted(h.exemplars.items())
+                },
             }
             for op, h in _registry.items()
         }
+
+
+def exemplar(op: str) -> Optional[Tuple[str, float]]:
+    """The op's overall worst sample as ``(trace_id, seconds)`` — the
+    one-hop answer to "which trace blew the p99?".  None for an
+    unknown op or one whose observations carried no trace id."""
+    with _lock:
+        h = _registry.get(op)
+        if h is None or not h.exemplars:
+            return None
+        return max(h.exemplars.values(), key=lambda td: td[1])
 
 
 def percentile(op: str, q: float) -> Optional[float]:

@@ -24,7 +24,7 @@ import time
 from typing import Dict, Optional
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import flight, trace
+from container_engine_accelerators_tpu.obs import flight, timeseries, trace
 from container_engine_accelerators_tpu.utils import faults
 from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
@@ -188,7 +188,9 @@ class DcnXferClient:
                "seq": seq}
         if nbytes is not None:
             req["bytes"] = nbytes
-        return self._call(**req)
+        resp = self._call(**req)
+        timeseries.record("dcn.tx.bytes", resp.get("bytes", 0))
+        return resp
 
     READ_CHUNK = 512 << 10  # daemon caps per-call reads (outbuf bound)
 
@@ -222,6 +224,7 @@ class DcnXferClient:
                     # error).
                     break
             s.annotate(read=len(out))
+            timeseries.record("dcn.rx.bytes", len(out))
             return bytes(out)
 
     def put(self, flow: str, data: bytes, host: str = "127.0.0.1",
@@ -240,6 +243,7 @@ class DcnXferClient:
         )
         with socket.create_connection((host, port), timeout=30) as s:
             s.sendall(hdr + name + data)
+        timeseries.record("dcn.stage.bytes", len(data))
 
     def stats(self, flow: Optional[str] = None) -> dict:
         """Daemon stats.  ``flow`` asks a filter-aware daemon

@@ -58,7 +58,7 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import trace
+from container_engine_accelerators_tpu.obs import timeseries, trace
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnWaitUnsupported,
     DcnXferClient,
@@ -267,34 +267,41 @@ def _send_worker(uds_dir: str, flow: str, chunks, seqs, idxs,
     keys outbound connections by control connection), which is the
     striping half of the pipeline."""
     ctl = None
+    timeseries.gauge_add("dcn.stripes.active", 1)
     try:
         with trace.attach(ctx.get("trace") if ctx else None,
                           ctx.get("span") if ctx else None):
             ctl = DcnXferClient(uds_dir, timeout_s=max(timeout_s, 10.0))
             for idx in idxs:
                 off, ln = chunks[idx]
-                with trace.span("dcn.chunk.send",
-                                histogram="dcn.chunk.send",
-                                flow=flow, off=off, bytes=ln,
-                                seq=seqs[idx]):
-                    resp = ctl._call(
-                        op="send", flow=flow, host=host,
-                        port=str(port), seq=seqs[idx], offset=off,
-                        bytes=ln, total=total, xid=xid,
-                        stage_wait_ms=int(min(timeout_s, 5.0) * 1e3),
-                    )
+                timeseries.gauge_add("dcn.chunks.inflight", 1)
+                try:
+                    with trace.span("dcn.chunk.send",
+                                    histogram="dcn.chunk.send",
+                                    flow=flow, off=off, bytes=ln,
+                                    seq=seqs[idx]):
+                        resp = ctl._call(
+                            op="send", flow=flow, host=host,
+                            port=str(port), seq=seqs[idx], offset=off,
+                            bytes=ln, total=total, xid=xid,
+                            stage_wait_ms=int(min(timeout_s, 5.0) * 1e3),
+                        )
+                finally:
+                    timeseries.gauge_add("dcn.chunks.inflight", -1)
                 verdict = resp.get("verdict", "sent")
                 if verdict in ("sent", "landed", "dup"):
                     # Count CONFIRMED chunks only (the README table's
                     # contract); dropped/unmatched retransmit attempts
                     # show up in dcn.pipeline.retry_rounds instead.
                     counters.inc("dcn.pipeline.chunks")
+                    timeseries.record("dcn.pipeline.tx.bytes", ln)
                 result.record(idx, verdict)
     except (DcnXferError, OSError) as e:
         # The scoreboard decides what to retry; this stripe's remaining
         # chunks simply stay unrecorded.
         result.fail(e)
     finally:
+        timeseries.gauge_add("dcn.stripes.active", -1)
         if ctl is not None:
             try:
                 ctl.close()
@@ -328,6 +335,10 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
             chunk_bytes, nbytes, MAX_CHUNKS_PER_TRANSFER,
         )
     chunks = plan_chunks(nbytes, chunk_bytes)
+    if not chunks:
+        # Empty payloads never reach here through should_pipeline, but
+        # the public contract must not divide by the chunk count.
+        return {"bytes": 0, "chunks": 0, "stripes": 0, "rounds": 0}
     stripes = min(cfg.stripes, len(chunks))
     # One logical transfer = one xid (the receiver's assembly key) and
     # one contiguous block of per-flow seqs.  A retransmit round reuses
@@ -337,8 +348,12 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     client._send_seq[flow] = base + len(chunks)
     seqs = [base + 1 + i for i in range(len(chunks))]
     counters.inc("dcn.pipeline.transfers")
+    # Stripe utilization = dcn.stripes.active / dcn.stripes.configured
+    # on the scrape; configured reflects the most recent transfer.
+    timeseries.gauge("dcn.stripes.configured", stripes)
     uds_dir = client._uds_dir
     pending = list(range(len(chunks)))
+    resent = 0  # chunk-sends beyond the first round (retransmits)
     with trace.span("dcn.pipeline", histogram="dcn.pipeline",
                     flow=flow, bytes=nbytes, chunks=len(chunks),
                     stripes=stripes, xid=xid) as span:
@@ -353,6 +368,7 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                 break
             if rnd:
                 counters.inc("dcn.pipeline.retry_rounds")
+                resent += len(pending)
                 # Heal before retrying: a resilient primary reconnects
                 # and replays the flow table here, so the fresh stripe
                 # connections below land on a daemon that knows `flow`.
@@ -402,6 +418,8 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                        not in ("sent", "landed", "dup")]
             last_errors = result.errors
             span.annotate(round=rnd, pending=len(pending))
+            timeseries.gauge("dcn.pipeline.retransmit_ratio",
+                             resent / len(chunks))
             if not pending:
                 return {"bytes": nbytes, "chunks": len(chunks),
                         "stripes": stripes, "rounds": rnd + 1}
@@ -468,4 +486,5 @@ def read_pipelined(client, flow: str, nbytes: int,
                                f"{e}")
         finally:
             sock.close()
+    timeseries.record("dcn.pipeline.rx.bytes", nbytes)
     return bytes(out)

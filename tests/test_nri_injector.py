@@ -14,6 +14,7 @@ import threading
 
 import pytest
 
+from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.nri import injector
 from container_engine_accelerators_tpu.nri import mux as nri_mux
 from container_engine_accelerators_tpu.nri import nri_v1alpha1_pb2 as pb
@@ -23,6 +24,7 @@ from container_engine_accelerators_tpu.nri.plugin import (
     DeviceInjectorPlugin,
     event_mask,
 )
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 from container_engine_accelerators_tpu.nri.ttrpc import (
     TtrpcClient,
     TtrpcError,
@@ -231,6 +233,102 @@ def test_shutdown_terminates_plugin(tmp_path):
     assert not t.is_alive()
     runtime_sock.close()
     plugin_sock.close()
+
+
+# ---- reconnect resilience (ROADMAP "NRI injector resilience") --------------
+
+
+FAST_RECONNECT = RetryPolicy(max_attempts=6, initial_backoff_s=0.01,
+                             max_backoff_s=0.05, deadline_s=10.0)
+
+
+def test_plugin_reconnects_after_trunk_loss(tmp_path):
+    """containerd restarts are routine: the trunk dies, the plugin must
+    re-dial with backoff and RE-REGISTER on the fresh connection —
+    counted as `nri.reconnect` — instead of exiting with the runtime."""
+    sock_path = str(tmp_path / "nri.sock")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(sock_path)
+    listener.listen(2)
+    plugin = DeviceInjectorPlugin(socket_path=sock_path)
+    before = counters.get("nri.reconnect")
+    t = threading.Thread(target=plugin.run,
+                         kwargs={"retry": FAST_RECONNECT}, daemon=True)
+    t.start()
+    try:
+        conn1, _ = listener.accept()
+        rt1 = FakeRuntime(conn1)
+        assert rt1.registered.wait(5)
+        # The "containerd restart": the trunk dies mid-life.  Shutdown
+        # before close so the FIN reaches the plugin's blocked reader
+        # (close() alone never wakes a thread already inside recv()).
+        conn1.shutdown(socket.SHUT_RDWR)
+        conn1.close()
+
+        conn2, _ = listener.accept()  # the plugin re-dialed
+        rt2 = FakeRuntime(conn2)
+        assert rt2.registered.wait(5), "no re-registration on reconnect"
+        assert counters.get("nri.reconnect") == before + 1
+        # The reconnected session is fully functional, not a zombie.
+        assert rt2.configure().events == event_mask(pb.CREATE_CONTAINER)
+
+        rt2.client.call(PLUGIN_SERVICE, "Shutdown",
+                        pb.Empty().SerializeToString())
+        t.join(timeout=5)
+        assert not t.is_alive()
+        conn2.close()
+    finally:
+        listener.close()
+
+
+def test_runtime_dropping_sessions_is_bounded_not_a_spin(tmp_path):
+    """A half-up runtime that ACCEPTS and instantly drops the trunk
+    must cost backoff and a bounded budget, not a zero-sleep reconnect
+    spin (the dial succeeds, so the dial budget alone never fires)."""
+    sock_path = str(tmp_path / "crashloop.sock")
+    listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    listener.bind(sock_path)
+    listener.listen(8)
+    stop = threading.Event()
+
+    def dropper():
+        while not stop.is_set():
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            conn.close()
+
+    threading.Thread(target=dropper, daemon=True).start()
+    plugin = DeviceInjectorPlugin(socket_path=sock_path)
+    tiny = RetryPolicy(max_attempts=3, initial_backoff_s=0.01,
+                       max_backoff_s=0.02)
+    before = counters.get("nri.reconnect.failed")
+    try:
+        with pytest.raises(OSError, match="keeps dropping"):
+            plugin.run(retry=tiny)
+        assert counters.get("nri.reconnect.failed") == before + 1
+    finally:
+        stop.set()
+        listener.close()
+
+
+def test_reconnect_budget_exhaustion_is_loud(tmp_path):
+    """A runtime that never comes back must cost the plugin its budget
+    and then a clear error (`nri.reconnect.failed`) — bounded backoff,
+    not an unbounded spin and not a silent exit."""
+    plugin = DeviceInjectorPlugin(
+        socket_path=str(tmp_path / "never-there.sock"))
+    tiny = RetryPolicy(max_attempts=2, initial_backoff_s=0.01,
+                       max_backoff_s=0.02)
+    before = counters.get("nri.reconnect.failed")
+    with pytest.raises(OSError):
+        plugin.run(retry=tiny)
+    assert counters.get("nri.reconnect.failed") == before + 1
 
 
 def test_mux_rejects_oversized_frame():

@@ -5,15 +5,19 @@ Covers the obs/ contract the ISSUE pins:
 - spans carry trace/span ids, parent links, thread-local context,
   attributes, and land in both the ring buffer and the TPU_TRACE_FILE
   JSONL sink;
-- histograms bucket by log2 microseconds and serve percentiles;
-- the flight recorder dumps spans + counters + histograms on SIGUSR1
-  and on terminal failures;
-- cmd/agent_trace.py summarizes the JSONL;
+- histograms bucket by log2 microseconds, serve percentiles, and keep
+  per-bucket trace exemplars (the worst sample's trace id);
+- time series (obs/timeseries.py) give windowed per-second rates that
+  decay to zero when traffic stops, plus explicit gauges;
+- the flight recorder dumps spans + counters + histograms + the
+  windowed-rate/SLO snapshot on SIGUSR1 and on terminal failures;
+- cmd/agent_trace.py summarizes the JSONL (and resolves exemplars);
 - obs/ stays importable (and functional) without prometheus_client or
   grpc — enforced in a subprocess with those imports blocked;
 - every ``counters.inc(...)`` name in the package is documented in the
   README metrics table (no undocumented counters), as is every gauge
-  family the MetricServer exports.
+  family the MetricServer exports and every histogram op fed through
+  ``trace.span(histogram=...)`` / ``histo.observe``.
 """
 
 import json
@@ -28,7 +32,12 @@ import time
 import pytest
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import flight, histo, trace
+from container_engine_accelerators_tpu.obs import (
+    flight,
+    histo,
+    timeseries,
+    trace,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "container_engine_accelerators_tpu")
@@ -300,6 +309,149 @@ class TestHisto:
         assert snap["a"]["count"] == 1 and snap["b"]["count"] == 1
 
 
+class TestExemplars:
+    """Each histogram bucket remembers the trace id of its WORST
+    sample — the metric → trace hop."""
+
+    def setup_method(self):
+        histo.reset()
+
+    def test_bucket_keeps_worst_sample(self):
+        histo.observe("op", 100e-6, trace_id="fast")
+        histo.observe("op", 120e-6, trace_id="slow")  # same le=128 bucket
+        histo.observe("op", 110e-6, trace_id="mid")
+        snap = histo.snapshot()["op"]["exemplars"]
+        assert snap["128"]["trace"] == "slow"
+        assert snap["128"]["dur_us"] == pytest.approx(120, rel=1e-3)
+
+    def test_overall_exemplar_is_cross_bucket_worst(self):
+        histo.observe("op", 100e-6, trace_id="small")
+        histo.observe("op", 0.5, trace_id="huge")
+        trace_id, dur = histo.exemplar("op")
+        assert trace_id == "huge" and dur == pytest.approx(0.5)
+        assert histo.exemplar("missing") is None
+
+    def test_untraced_observations_keep_no_exemplar(self):
+        histo.observe("op", 1e-3)
+        assert histo.snapshot()["op"]["exemplars"] == {}
+        assert histo.exemplar("op") is None
+
+    def test_span_histogram_wires_trace_id_through(self):
+        with trace.span("timed", histogram="timed.op") as s:
+            pass
+        trace_id, _dur = histo.exemplar("timed.op")
+        assert trace_id == s.trace_id
+
+
+# ---------------------------------------------------------------------------
+# timeseries
+# ---------------------------------------------------------------------------
+
+
+class TestTimeseries:
+    """Windowed ring-bucket rates: decay to zero by construction, no
+    background thread; every function takes an injectable clock."""
+
+    def setup_method(self):
+        timeseries.reset()
+
+    def test_rate_over_window(self):
+        t0 = 1000.0
+        for i in range(5):
+            timeseries.record("ev", 2, now=t0 + i)  # 10 over 5 buckets
+        assert timeseries.rate("ev", window_s=10, now=t0 + 4) == \
+            pytest.approx(1.0)
+
+    def test_rate_decays_to_zero_when_traffic_stops(self):
+        t0 = 2000.0
+        timeseries.record("ev", 100, now=t0)
+        assert timeseries.rate("ev", window_s=10, now=t0) > 0
+        assert timeseries.rate("ev", window_s=10, now=t0 + 11) == 0.0
+
+    def test_unknown_series_is_zero_not_error(self):
+        assert timeseries.rate("never.recorded") == 0.0
+
+    def test_old_buckets_are_recycled_not_leaked(self):
+        t0 = 3000.0
+        timeseries.record("ev", 7, now=t0)
+        # One full ring later the same slot is reused; the stale value
+        # must not bleed into the new epoch's rate.
+        t1 = t0 + timeseries.NUM_BUCKETS * timeseries.BUCKET_S
+        timeseries.record("ev", 3, now=t1)
+        assert timeseries.rate("ev", window_s=1, now=t1) == \
+            pytest.approx(3.0)
+
+    def test_gauges(self):
+        timeseries.gauge("inflight", 4)
+        assert timeseries.gauge_add("inflight", -1) == 3
+        timeseries.gauge_add("fresh", 2)
+        assert timeseries.gauges() == {"inflight": 3.0, "fresh": 2.0}
+
+    def test_split_goodput(self):
+        assert timeseries.split_goodput("goodput.link.n0->n1") == \
+            ("link", "n0->n1")
+        assert timeseries.split_goodput("goodput.flow.r1.a.b") == \
+            ("flow", "r1.a.b")
+        assert timeseries.split_goodput("dcn.tx.bytes") is None
+        assert timeseries.split_goodput("goodput.") is None
+
+    def test_counters_feed_rates(self):
+        counters.inc("ts.coupling.marker", 5)
+        assert timeseries.rate("ts.coupling.marker",
+                               window_s=timeseries.NUM_BUCKETS) > 0
+
+    def test_malformed_window_env_degrades(self, monkeypatch):
+        monkeypatch.setenv(timeseries.RATE_WINDOW_ENV, "not-a-window")
+        assert timeseries.default_window_s() == \
+            timeseries.DEFAULT_WINDOW_S
+        monkeypatch.setenv(timeseries.RATE_WINDOW_ENV, "-4")
+        assert timeseries.default_window_s() == \
+            timeseries.DEFAULT_WINDOW_S
+        monkeypatch.setenv(timeseries.RATE_WINDOW_ENV, "5")
+        assert timeseries.default_window_s() == 5.0
+
+    def test_snapshot_shape(self):
+        timeseries.record("a.bytes", 10)
+        timeseries.gauge("g", 1)
+        snap = timeseries.snapshot(window_s=10)
+        assert snap["window_s"] == 10
+        assert "a.bytes" in snap["rates"]
+        assert snap["gauges"] == {"g": 1.0}
+
+    def test_dead_series_are_pruned_not_leaked(self):
+        """Per-flow goodput names are unique per transfer; a long-lived
+        agent must not grow one series per transfer forever.  Past
+        MAX_SERIES, fully-idle series (no bucket inside the ring span)
+        are evicted on the next record."""
+        t0 = 5000.0
+        for i in range(timeseries.MAX_SERIES):
+            timeseries.record(f"goodput.flow.dead{i}", 1, now=t0)
+        # Well past the ring span: every dead series is evictable.
+        t1 = t0 + 2 * timeseries.NUM_BUCKETS * timeseries.BUCKET_S
+        timeseries.record("goodput.flow.live", 1, now=t1)
+        rates = timeseries.rates(now=t1)
+        assert "goodput.flow.live" in rates
+        assert len(rates) == 1  # the dead five hundred are gone
+        # A series still inside the span survives pruning (it is the
+        # explicit-0.0 decay window, not an instant eviction).
+        timeseries.reset()
+        timeseries.record("recent", 1, now=t1 - 5)
+        for i in range(timeseries.MAX_SERIES):
+            timeseries.record(f"filler{i}", 1, now=t1)
+        assert "recent" in timeseries.rates(now=t1)
+
+    def test_series_storm_is_hard_bounded(self):
+        """Thousands of still-LIVE unique names (a flow storm inside
+        one ring span) must hit a hard cardinality ceiling, not grow
+        with the churn rate."""
+        t0 = 9000.0
+        for i in range(3 * timeseries.HARD_MAX_SERIES):
+            timeseries.record(f"goodput.flow.storm{i}", 1, now=t0)
+        with timeseries._lock:
+            n = len(timeseries._series)
+        assert n <= timeseries.HARD_MAX_SERIES
+
+
 # ---------------------------------------------------------------------------
 # flight recorder
 # ---------------------------------------------------------------------------
@@ -318,6 +470,8 @@ class TestFlightRecorder:
         assert blob["counters"]["test.flight.marker"] >= 7
         assert blob["histograms"]["evidence.op"]["count"] >= 1
         assert any(s["name"] == "evidence" for s in blob["spans"])
+        # Windowed snapshot rides along: what was the node DOING.
+        assert blob["rates"]["rates"]["test.flight.marker"] > 0
         # File copy is one parseable JSON line with a schema tag.
         (line,) = open(path).read().splitlines()
         assert json.loads(line)["flight_recorder"] == 1
@@ -367,6 +521,18 @@ class TestFlightRecorder:
             assert any(s["name"] == "pre-signal" for s in blob["spans"])
         finally:
             signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+    def test_dump_carries_slo_verdicts(self):
+        timeseries.gauge("slo.min_goodput_bps.ok", 0.0)
+        timeseries.gauge("slo.min_goodput_bps.value", 12.5)
+        timeseries.gauge("dcn.chunks.inflight", 2)
+        try:
+            blob = flight.snapshot("slo-test")
+            assert blob["slo"] == {"slo.min_goodput_bps.ok": 0.0,
+                                   "slo.min_goodput_bps.value": 12.5}
+            assert blob["rates"]["gauges"]["dcn.chunks.inflight"] == 2
+        finally:
+            timeseries.reset()
 
     def test_install_off_main_thread_degrades(self):
         result = {}
@@ -472,13 +638,18 @@ def test_obs_importable_without_prometheus_or_grpc(tmp_path):
 import sys
 sys.modules["prometheus_client"] = None  # import -> ImportError
 sys.modules["grpc"] = None
-from container_engine_accelerators_tpu.obs import flight, histo, trace
+from container_engine_accelerators_tpu.obs import (
+    flight, histo, timeseries, trace)
 from container_engine_accelerators_tpu.metrics import counters
 with trace.span("bare", histogram="bare.op"):
     counters.inc("bare.counter")
+timeseries.record("goodput.link.a->b", 4096)
 blob = flight.dump("no-deps")
 assert blob["histograms"]["bare.op"]["count"] == 1
 assert blob["counters"]["bare.counter"] == 1
+assert blob["rates"]["rates"]["bare.counter"] > 0
+assert timeseries.rate("goodput.link.a->b") > 0
+assert histo.exemplar("bare.op") is not None
 assert trace.tail(1)[0]["name"] == "bare"
 print("OK")
 """
@@ -530,6 +701,39 @@ def test_readme_documents_every_counter_and_gauge():
     # helper in MetricServer.__init__.
     metrics_src = open(os.path.join(PKG, "metrics", "metrics.py")).read()
     gauges = set(re.findall(r"\bg\(\s*\n?\s*\"([a-z_]+)\"", metrics_src))
-    assert {"agent_events", "agent_latency", "duty_cycle"} <= gauges
+    assert {"agent_events", "agent_latency", "agent_rate",
+            "agent_goodput", "agent_gauge", "agent_exemplar",
+            "duty_cycle"} <= gauges
     missing = {n for n in gauges if f"`{n}`" not in readme}
     assert not missing, f"gauge families missing from README: {missing}"
+
+
+def _histogram_ops():
+    """Every literal (or f-string) histogram op fed through
+    ``trace.span(histogram=...)`` or ``histo.observe(...)``;
+    placeholders normalize to the README's <op> form."""
+    pats = [re.compile(r"histogram=\s*f?\"([^\"]+)\""),
+            re.compile(r"histo\.observe\(\s*f?\"([^\"]+)\"")]
+    ops = set()
+    for path in _package_sources():
+        src = open(path).read()
+        for pat in pats:
+            for m in pat.finditer(src):
+                ops.add(re.sub(r"\{[^}]*\}", "<op>", m.group(1)))
+    return ops
+
+
+def test_readme_documents_every_histogram_op():
+    """Exemplars ride histogram ops (`agent_exemplar{op=...}` reuses
+    the same names), so one lint covers both surfaces: every op that
+    can appear in `agent_latency`/`agent_exemplar` must be in the
+    README's histogram list."""
+    readme = open(os.path.join(REPO, "README.md")).read()
+    ops = _histogram_ops()
+    assert ops, "lint regex found no histogram ops at all?"
+    undocumented = {n for n in ops if f"`{n}`" not in readme}
+    assert not undocumented, (
+        f"histogram ops missing from the README Observability section: "
+        f"{sorted(undocumented)} — every histogram= / histo.observe op "
+        f"must be documented"
+    )

@@ -1,0 +1,431 @@
+"""Fleet telemetry: windowed-rate export, exemplar round trip, SLOs.
+
+The ISSUE 5 acceptance surface:
+
+- e2e HTTP scrape: `agent_rate` / `agent_goodput` / `agent_gauge`
+  appear on the real endpoint, decay to zero when traffic stops, and
+  survive the MetricServer's periodic `_reset`;
+- exemplar round trip: force a slow op, scrape `agent_exemplar`, and
+  `cmd/agent_trace.py --exemplar` resolves the scraped id to the full
+  trace tree;
+- SLOs: a lossy-link fleet scenario that CONVERGES still fails its
+  goodput SLO — the report carries an `slo` section and
+  `cmd/fleet_sim.py` exits 3 on breach (2 stays non-convergence);
+- `cmd/agent_top.py --once` renders rates/goodput/p99/SLO status
+  against a live MetricServer.
+"""
+
+import importlib.util
+import json
+import os
+import re
+import sys
+import time
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from container_engine_accelerators_tpu.fleet.controller import run_scenario
+from container_engine_accelerators_tpu.fleet.telemetry import (
+    FleetTelemetry,
+    parse_slo_spec,
+)
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+from container_engine_accelerators_tpu.obs import histo, timeseries, trace
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_BIND = RetryPolicy(max_attempts=8, initial_backoff_s=0.05,
+                        max_backoff_s=0.2, deadline_s=10.0)
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    timeseries.reset()
+    trace.reset()
+    yield
+    timeseries.reset()
+    trace.reset()
+
+
+class _NoChips:
+    def collect_tpu_device(self, name):  # pragma: no cover
+        raise RuntimeError("no chips")
+
+    def devices(self):
+        return []
+
+    def model(self, name):  # pragma: no cover
+        return "none"
+
+
+def _server(tmp_path):
+    return MetricServer(
+        collector=_NoChips(),
+        registry=CollectorRegistry(),
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+        port=0,
+        collection_interval_s=3600,
+    )
+
+
+def _scrape(port):
+    import urllib.request
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10
+    ) as resp:
+        return resp.read().decode()
+
+
+def _load_cli(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "cmd", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# e2e scrape: rates / goodput / gauges
+# ---------------------------------------------------------------------------
+
+
+class TestRateScrape:
+    def test_rates_goodput_gauges_end_to_end(self, tmp_path):
+        counters.inc("e2e.rate.marker", 50)
+        timeseries.record("goodput.link.n0->n1", 8192)
+        timeseries.record("goodput.flow.r0.a.b", 4096)
+        timeseries.gauge("dcn.chunks.inflight", 3)
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            server.collect_once()
+            body = _scrape(server.port)
+            rate = self._sample(body, "agent_rate",
+                                'event="e2e.rate.marker"')
+            assert rate is not None and rate > 0
+            link = self._sample(body, "agent_goodput",
+                                'name="n0->n1",scope="link"')
+            assert link is not None and link > 0
+            flow = self._sample(body, "agent_goodput",
+                                'name="r0.a.b",scope="flow"')
+            assert flow is not None and flow > 0
+            assert self._sample(body, "agent_gauge",
+                                'name="dcn.chunks.inflight"') == 3.0
+
+            # Decay: a series whose last traffic fell out of the window
+            # exports an explicit 0.0 — a stopped flow scrapes as zero,
+            # it does not vanish.
+            timeseries.record("goodput.link.idle->idle", 999,
+                              now=time.monotonic() - 60)
+            server.collect_once()
+            body = _scrape(server.port)
+            assert self._sample(body, "agent_goodput",
+                                'name="idle->idle",scope="link"') == 0.0
+
+            # Survive the periodic registry reset: wholesale republish.
+            server._last_reset -= 2 * 60
+            server.collect_once()
+            body = _scrape(server.port)
+            rate2 = self._sample(body, "agent_rate",
+                                 'event="e2e.rate.marker"')
+            assert rate2 is not None and rate2 > 0
+            assert self._sample(body, "agent_gauge",
+                                'name="dcn.chunks.inflight"') == 3.0
+        finally:
+            server.stop()
+
+    @staticmethod
+    def _sample(body, family, labels):
+        m = re.search(rf"^{family}\{{{re.escape(labels)}\}} (\S+)$",
+                      body, re.M)
+        return float(m.group(1)) if m else None
+
+
+# ---------------------------------------------------------------------------
+# exemplar round trip: slow op -> scrape -> agent_trace --exemplar
+# ---------------------------------------------------------------------------
+
+
+class TestExemplarRoundTrip:
+    def test_scraped_exemplar_resolves_to_trace_tree(self, tmp_path,
+                                                     capsys):
+        histo.reset()
+        jsonl = str(tmp_path / "trace.jsonl")
+        trace.configure(jsonl)
+        with trace.span("slow.op", histogram="slow.op", who="outer"):
+            with trace.span("slow.inner"):
+                time.sleep(0.03)
+        with trace.span("slow.op", histogram="slow.op", who="fast"):
+            pass
+        trace.configure(None)  # flush before the CLI reads it
+
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            server.collect_once()
+            body = _scrape(server.port)
+        finally:
+            server.stop()
+        rows = re.findall(
+            r'agent_exemplar\{bucket="(\d+)",op="slow\.op",'
+            r'trace="([0-9a-f]+)"\} (\S+)', body)
+        assert rows, f"no exemplar rows in scrape:\n{body[:2000]}"
+        worst_trace = max(rows, key=lambda r: float(r[2]))[1]
+
+        at = _load_cli("agent_trace")
+        at.main([jsonl, "--exemplar", "slow.op"])
+        out = capsys.readouterr()
+        result = json.loads(out.out.strip().splitlines()[-1])
+        # The CLI resolved the SAME trace the scrape named: metric ->
+        # trace in one hop.
+        assert result["trace"] == worst_trace
+        assert result["spans"] == 2
+        assert "slow.inner" in out.err  # the tree, not just the id
+
+    def test_exemplar_accepts_scraped_trace_id_directly(self, tmp_path,
+                                                        capsys):
+        jsonl = str(tmp_path / "t.jsonl")
+        trace.configure(jsonl)
+        with trace.span("an.op") as s:
+            pass
+        trace.configure(None)
+        at = _load_cli("agent_trace")
+        at.main([jsonl, "--exemplar", s.trace_id[:10]])  # prefix ok
+        result = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert result["trace"] == s.trace_id
+
+    def test_exemplar_miss_is_a_clear_error(self, tmp_path):
+        jsonl = str(tmp_path / "t.jsonl")
+        trace.configure(jsonl)
+        with trace.span("an.op"):
+            pass
+        trace.configure(None)
+        at = _load_cli("agent_trace")
+        with pytest.raises(SystemExit, match="no span named"):
+            at.main([jsonl, "--exemplar", "no.such.op"])
+
+
+# ---------------------------------------------------------------------------
+# SLOs: spec parsing, evaluation, the converges-but-breaches scenario
+# ---------------------------------------------------------------------------
+
+
+class TestSloSpec:
+    def test_known_keys_parse(self):
+        spec = parse_slo_spec({"p99_leg_ms": "250",
+                               "min_goodput_bps": 1024})
+        assert spec == {"p99_leg_ms": 250.0, "min_goodput_bps": 1024.0}
+
+    def test_unknown_and_malformed_keys_skip_not_crash(self):
+        spec = parse_slo_spec({"p99_leg_ms": 10, "not_an_slo": 5,
+                               "min_goodput_bps": "lots"})
+        assert spec == {"p99_leg_ms": 10.0}
+
+    def test_empty_spec_is_vacuously_ok(self):
+        t = FleetTelemetry({}, _FakeLinks({}), None)
+        section = t.evaluate({})
+        assert section["ok"] is True and section["checks"] == []
+
+    def test_non_mapping_slo_section_degrades_not_crashes(self):
+        # YAML authoring typo: `slo: [p99_leg_ms]` — costs the SLOs,
+        # never the run (the TPU_FAULT_SPEC rule).
+        assert parse_slo_spec(["p99_leg_ms"]) == {}
+        assert parse_slo_spec("p99_leg_ms=5") == {}
+
+    def test_empty_pipelined_payload_never_divides_by_zero(self):
+        # The retransmit-ratio gauge divides by the chunk count; an
+        # empty payload must short-circuit before the round loop.
+        from container_engine_accelerators_tpu.parallel import (
+            dcn_pipeline,
+        )
+
+        out = dcn_pipeline.send_pipelined(None, "f", b"", "127.0.0.1", 1)
+        assert out == {"bytes": 0, "chunks": 0, "stripes": 0,
+                       "rounds": 0}
+
+
+class _FakeLinks:
+    def __init__(self, report):
+        self._report = report
+
+    def report(self):
+        return self._report
+
+
+class TestSloEvaluation:
+    def test_floor_and_ceiling_verdicts_and_gauges(self):
+        histo.reset()
+        links = {"a->b": {"bytes": 1 << 20, "frames": 10, "drops": 4,
+                          "dups": 1, "blocked": 0}}
+        t = FleetTelemetry({}, _FakeLinks(links), {
+            "min_goodput_bps": 1e12,        # unreachable floor: breach
+            "max_retransmit_ratio": 0.49,   # (4+1)/10 = 0.5: breach
+            "max_dedup_ratio": 0.2,         # 1/10 = 0.1: ok
+        })
+        section = t.evaluate(links)
+        by_key = {c["slo"]: c for c in section["checks"]}
+        assert not section["ok"]
+        assert not by_key["min_goodput_bps"]["ok"]
+        assert not by_key["max_retransmit_ratio"]["ok"]
+        assert by_key["max_dedup_ratio"]["ok"]
+        # Verdicts are live gauges for agent_top / flight recorder.
+        gauges = timeseries.gauges()
+        assert gauges["slo.min_goodput_bps.ok"] == 0.0
+        assert gauges["slo.max_dedup_ratio.ok"] == 1.0
+        assert gauges["slo.max_retransmit_ratio.value"] == \
+            pytest.approx(0.5)
+
+    def test_p99_ceiling_reads_leg_histogram(self):
+        histo.reset()
+        t = FleetTelemetry({}, _FakeLinks({}), {"p99_leg_ms": 100})
+        histo.observe("fleet.leg", 0.2)  # le bucket 262144us ≈ 262ms
+        assert t.evaluate({})["ok"] is False
+        t2 = FleetTelemetry({}, _FakeLinks({}), {"p99_leg_ms": 1000})
+        histo.observe("fleet.leg", 0.2)
+        assert t2.evaluate({})["ok"] is True
+
+    def test_p99_judges_this_run_only(self):
+        """Histograms are process-global; a previous scenario's slow
+        legs must not breach (or mask) THIS run's p99 SLO — the
+        aggregator baselines the buckets at boot, like the controller
+        baselines counters."""
+        histo.reset()
+        histo.observe("fleet.leg", 5.0)  # an earlier run's disaster
+        t = FleetTelemetry({}, _FakeLinks({}), {"p99_leg_ms": 100})
+        histo.observe("fleet.leg", 0.00005)  # this run: 50µs legs
+        section = t.evaluate({})
+        assert section["ok"] is True, section
+        assert section["measured"]["p99_leg_ms"] < 1
+        # And with NO legs this run at all, p99 reads 0, not the past.
+        t2 = FleetTelemetry({}, _FakeLinks({}), {"p99_leg_ms": 100})
+        assert t2.evaluate({})["measured"]["p99_leg_ms"] == 0.0
+
+
+class TestFleetSlo:
+    """Scenario-level: converged is necessary but no longer sufficient."""
+
+    LOSSY = {
+        "name": "lossy-but-alive",
+        "nodes": 2,
+        "racks": 1,
+        "chips": 2,
+        "topology": "1x2x1",
+        "rounds": 3,
+        "payload_bytes": 2048,
+        "land_timeout_s": 0.4,
+        "faults": [
+            {"round": 1, "link": "node:n0->node:n1:drop:1"},
+        ],
+    }
+
+    def test_lossy_scenario_converges_but_breaches_goodput_slo(self):
+        scenario = dict(self.LOSSY,
+                        slo={"min_goodput_bps": 1e12,
+                             "max_dedup_ratio": 1.0})
+        report = run_scenario(scenario)
+        assert report["converged"], report["rounds"][-1]
+        assert report["links"]["n0->n1"]["drops"] >= 1
+        slo = report["slo"]
+        assert slo["ok"] is False
+        breached = {c["slo"] for c in slo["checks"] if not c["ok"]}
+        assert "min_goodput_bps" in breached
+        # The same scenario under an honest floor passes.
+        timeseries.reset()
+        report2 = run_scenario(dict(self.LOSSY,
+                                    slo={"min_goodput_bps": 1.0}))
+        assert report2["converged"] and report2["slo"]["ok"]
+
+    def test_report_carries_telemetry_rounds(self):
+        report = run_scenario(dict(self.LOSSY, faults=[]))
+        rounds = report["telemetry"]["rounds"]
+        assert len(rounds) == self.LOSSY["rounds"]
+        last = rounds[-1]
+        assert set(last["nodes"]) == {"n0", "n1"}
+        assert any(v > 0 for v in last["links_goodput_bps"].values())
+        assert all(n["goodput_bps"] >= 0 for n in last["nodes"].values())
+
+    def test_fleet_sim_exits_3_on_slo_breach(self, tmp_path, capsys):
+        path = str(tmp_path / "lossy.json")
+        with open(path, "w") as f:
+            json.dump(dict(self.LOSSY, faults=[]), f)
+        fs = _load_cli("fleet_sim")
+        rc = fs.main(["--scenario", path,
+                      "--slo", "min_goodput_bps=1e12"])
+        assert rc == 3
+        out = capsys.readouterr()
+        assert json.loads(out.out.strip().splitlines()[-1])["slo"][
+            "ok"] is False
+        assert "FAIL" in out.err  # the SLO table names the breach
+        # And with a sane floor the same scenario exits 0.
+        timeseries.reset()
+        rc = fs.main(["--scenario", path, "--slo", "min_goodput_bps=1"])
+        assert rc == 0
+
+    def test_fleet_sim_rejects_typoed_slo_key(self, capsys):
+        """An operator-typed --slo is an explicit CI gate: a typo'd
+        key must fail the invocation, never silently evaluate zero
+        checks and exit 0."""
+        fs = _load_cli("fleet_sim")
+        rc = fs.main(["--slo", "min_goodput=64"])  # missing _bps
+        assert rc == 2
+        assert "min_goodput_bps" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# agent_top --once against a live MetricServer
+# ---------------------------------------------------------------------------
+
+
+class TestAgentTop:
+    def test_once_renders_rates_goodput_p99_and_slo(self, tmp_path,
+                                                    capsys):
+        histo.reset()
+        counters.inc("top.rate.marker", 9)
+        timeseries.record("goodput.link.n0->n1", 4 << 20)
+        timeseries.gauge("slo.min_goodput_bps.ok", 0.0)
+        timeseries.gauge("slo.min_goodput_bps.value", 17.0)
+        timeseries.gauge("dcn.stripes.active", 2)
+        for _ in range(3):
+            with trace.span("dcn.send", histogram="dcn.send"):
+                pass
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            server.collect_once()
+            top = _load_cli("agent_top")
+            rc = top.main(["--port", str(server.port), "--once"])
+        finally:
+            server.stop()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "top.rate.marker" in out          # rates
+        assert "n0->n1" in out                   # goodput
+        assert "dcn.send" in out and "p99_us" in out  # latency
+        assert "BREACH" in out                   # SLO status rendered
+        assert "dcn.stripes.active" in out       # gauges
+
+    def test_once_fails_cleanly_without_server(self, capsys):
+        top = _load_cli("agent_top")
+        rc = top.main(["--port", "1", "--once"])  # nothing listens there
+        assert rc == 1
+        assert "failed" in capsys.readouterr().err
+
+    def test_percentiles_from_cumulative_buckets(self):
+        top = _load_cli("agent_top")
+        buckets = {128: 99, 1 << 20: 100}  # cumulative le counts
+        assert top.percentile_from_buckets(buckets, 100, 0.5) == 128
+        assert top.percentile_from_buckets(buckets, 100, 0.99) == 128
+        assert top.percentile_from_buckets(buckets, 100, 1.0) == 1 << 20
+        assert top.percentile_from_buckets({}, 0, 0.5) == 0.0
+
+    def test_demo_mode_is_self_contained(self, capsys):
+        top = _load_cli("agent_top")
+        assert top.main(["--demo", "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "goodput" in out and "SLO status" in out
