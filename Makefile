@@ -83,23 +83,30 @@ obs:
 # re-convergence, frame-seq dedup exactly-once, cross-process trace
 # merging — including the scenarios marked slow, then one CLI run of
 # the headline rack-partition scenario (the acceptance path), one
-# with the chunked/striped pipelined data plane under the same faults,
-# and one SLO-annotated run (the report carries an `slo` section and
-# exit 3 — not 0 — means converged-but-breached; the floors here are
-# honest, so it must pass).
+# with the chunked/striped pipelined data plane under the same faults
+# (emulated nodes are same-host, so this leg rides the zero-copy shm
+# staging lane), one pinned to the socket lane (--no-shm: both lanes
+# must keep fault parity), and one SLO-annotated run (the report
+# carries an `slo` section and exit 3 — not 0 — means
+# converged-but-breached; the floors here are honest, so it must
+# pass).
 .PHONY: fleet
 fleet:
 	$(PY) -m pytest tests/test_fleet.py -q -p no:randomly
 	$(PY) cmd/fleet_sim.py --rounds 5 > /dev/null
 	$(PY) cmd/fleet_sim.py --rounds 5 --pipelined \
 	    --payload-bytes 262144 --chunk-bytes 65536 > /dev/null
+	$(PY) cmd/fleet_sim.py --rounds 5 --pipelined --no-shm \
+	    --payload-bytes 262144 --chunk-bytes 65536 > /dev/null
 	$(PY) cmd/fleet_sim.py --rounds 5 \
 	    --slo min_goodput_bps=64 --slo p99_leg_ms=60000 \
 	    --slo max_dedup_ratio=1.0 > /dev/null
 
-# DCN pipelining gate: the serial-vs-pipelined microbench on the
-# loopback rig.  --compare exits non-zero if the pipelined path falls
-# below the serial path at the largest swept message size (a pipeline
+# DCN data-plane gate: the serial / pipelined-socket / shm microbench
+# on the loopback rig, with a memcpy reference series in the same
+# JSONL.  --compare exits non-zero if the pipelined lane falls below
+# serial, or the zero-copy same-host lane falls below 1.5x the socket
+# pipelined lane, at the largest swept message size (a lane
 # regression must fail CI, not just dent a table in the README).
 .PHONY: dcnbench
 dcnbench:

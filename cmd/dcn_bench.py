@@ -1,14 +1,22 @@
 #!/usr/bin/env python3
-"""DCN transfer microbench: serial vs pipelined, message-size sweep.
+"""DCN transfer microbench: serial vs pipelined vs shm, size sweep.
 
 Boots two PyXferd daemons on loopback (the protocol-faithful rig the
-fleet simulator uses) and drives one-way transfers through both data
+fleet simulator uses) and drives one-way transfers through the data
 planes:
 
 - ``serial``: the classic exchange leg — whole-payload ``put``, rx
   wait, whole-payload ``send``, land wait, base64 control-socket read;
-- ``pipelined``: the chunked/striped path — overlapped stage+send via
-  ``parallel.dcn_pipeline.send_pipelined`` and raw DXR1 read-back.
+- ``pipelined``: the chunked/striped SOCKET lane — overlapped
+  stage+send via ``parallel.dcn_pipeline.send_pipelined`` (shm
+  force-disabled) and raw DXR1 read-back;
+- ``shm``: the zero-copy same-host lane — memoryview staging into the
+  flow's mmap segment + one ``shm_commit``, serial chunk sends, and a
+  buffer-reference ``shm_read`` read-back;
+- ``memcpy``: the reference series — the same payload copied through
+  a staging buffer and back out, no daemons.  This is the ceiling the
+  same-host lane is stepping toward; it shares the JSONL so the gap
+  is always on record next to the lanes.
 
 One JSONL record per (mode, size) goes to stdout (or ``--out``), in
 the BENCH_TPU_LOG style: flat keys, one measurement per line, with
@@ -19,7 +27,9 @@ Usage:
   python cmd/dcn_bench.py --sizes 65536,4194304 --iters 5
   python cmd/dcn_bench.py --compare                # exit non-zero if
                                                    # pipelined < serial
-                                                   # at the largest size
+                                                   # OR shm < 1.5x
+                                                   # pipelined at the
+                                                   # largest size
   python cmd/dcn_bench.py --chunk-bytes 262144 --stripes 4
 
 Timing note: wall-clock per leg, best-of-N (min) as the headline and
@@ -50,6 +60,7 @@ from container_engine_accelerators_tpu.parallel.dcn_client import (  # noqa: E40
 )
 
 DEFAULT_SIZES = "65536,262144,1048576,4194304"
+MODES = ("serial", "pipelined", "shm", "memcpy")
 
 
 def parse_args(argv=None):
@@ -71,10 +82,17 @@ def parse_args(argv=None):
                    help="append JSONL here instead of stdout")
     p.add_argument("--compare", action="store_true",
                    help="exit 1 if pipelined throughput falls below "
-                        "--min-ratio x serial at the largest size")
+                        "--min-ratio x serial, or shm below "
+                        "--shm-min-ratio x pipelined, at the largest "
+                        "size")
     p.add_argument("--min-ratio", type=float, default=1.0,
-                   help="the --compare gate (default 1.0: pipelined "
-                        "must not regress below serial)")
+                   help="the pipelined-vs-serial --compare gate "
+                        "(default 1.0: pipelined must not regress "
+                        "below serial)")
+    p.add_argument("--shm-min-ratio", type=float, default=1.5,
+                   help="the shm-vs-pipelined --compare gate (default "
+                        "1.5: the zero-copy lane must be a real step, "
+                        "not noise)")
     return p.parse_args(argv)
 
 
@@ -83,13 +101,22 @@ class BenchRig:
 
     def __init__(self):
         self.workdir = tempfile.mkdtemp(prefix="dcn-bench-")
+        # shm=True pins the daemons' capability regardless of the
+        # TPU_DCN_SHM env: the sweep forces the lane per mode (the
+        # client cfg side), so the daemons must always OFFER it or a
+        # kill-switched environment would crash the shm mode instead
+        # of benching it.
         self.a = PyXferd(os.path.join(self.workdir, "a"),
-                         node="bench-a").start()
+                         node="bench-a", shm=True).start()
         self.b = PyXferd(os.path.join(self.workdir, "b"),
-                         node="bench-b").start()
+                         node="bench-b", shm=True).start()
         self.ca = ResilientDcnXferClient(os.path.join(self.workdir, "a"))
         self.cb = ResilientDcnXferClient(os.path.join(self.workdir, "b"))
         self._n = 0
+        # memcpy reference staging buffer, reused across iterations
+        # (sized up on demand) — the reference measures copies, not
+        # allocator behavior.
+        self._ref = bytearray(0)
 
     def close(self):
         for c in (self.ca, self.cb):
@@ -106,12 +133,28 @@ class BenchRig:
         """One timed transfer a->b; returns seconds.  Verifies the
         landed bytes — a bench that measures corrupt transfers fast
         would be worse than no bench."""
+        n = len(payload)
+        if mode == "memcpy":
+            # The zero-copy ceiling: stage copy in + read copy out,
+            # nothing else.  Same verify as the real lanes.
+            if len(self._ref) < n:
+                self._ref = bytearray(n)
+            t0 = time.perf_counter()
+            self._ref[:n] = payload
+            got = bytes(memoryview(self._ref)[:n])
+            elapsed = time.perf_counter() - t0
+            if got != payload:
+                raise RuntimeError("memcpy reference mismatch")
+            return elapsed
         self._n += 1
         flow = f"bench-{mode}-{self._n}"
-        n = len(payload)
         self.cb.register_flow(flow, peer="bench-a", bytes=n)
         self.ca.register_flow(flow, peer="bench-b", bytes=n)
         try:
+            if mode == "shm":
+                # Pre-attach the landing flow (what exchange_shard
+                # does): peer chunks assemble straight into the mmap.
+                self.cb.shm_attach(flow, n)
             t0 = time.perf_counter()
             if mode == "serial":
                 self.ca.put(flow, payload)
@@ -120,11 +163,17 @@ class BenchRig:
                 dcn.wait_flow_rx(self.cb, flow, n, timeout_s=30)
                 got = self.cb.read(flow, n)
             else:
-                dcn_pipeline.send_pipelined(
+                res = dcn_pipeline.send_pipelined(
                     self.ca, flow, payload, "127.0.0.1",
                     self.b.data_port, cfg, timeout_s=30)
                 got = dcn_pipeline.read_pipelined(
                     self.cb, flow, n, cfg, timeout_s=30)
+                want = "shm" if mode == "shm" else "socket"
+                if res.get("lane") != want:
+                    raise RuntimeError(
+                        f"mode {mode} ran on lane {res.get('lane')!r}"
+                        " — the bench must measure the lane it says"
+                    )
             elapsed = time.perf_counter() - t0
             if got != payload:
                 raise RuntimeError(
@@ -143,6 +192,12 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr):
     """Returns {(mode, size): best_mbps} after writing one JSONL
     record per cell to ``sink``."""
     rig = BenchRig()
+    # The socket-pipelined and shm lanes must be measured apart, so
+    # the sweep forces the lane per mode instead of trusting env.
+    cfg_socket = dcn_pipeline.PipelineConfig(
+        chunk_bytes=cfg.chunk_bytes, stripes=cfg.stripes, shm=False)
+    cfg_shm = dcn_pipeline.PipelineConfig(
+        chunk_bytes=cfg.chunk_bytes, stripes=cfg.stripes, shm=True)
     results = {}
     try:
         print(f"{'bytes':>9} {'mode':>10} {'best_ms':>9} {'med_ms':>9} "
@@ -150,8 +205,9 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr):
         for size in sizes:
             payload = bytes(range(256)) * (size // 256) \
                 + b"\x7f" * (size % 256)
-            for mode in ("serial", "pipelined"):
-                times = [rig.one_way(mode, payload, cfg)
+            for mode in MODES:
+                mode_cfg = cfg_shm if mode == "shm" else cfg_socket
+                times = [rig.one_way(mode, payload, mode_cfg)
                          for _ in range(iters)]
                 best = min(times)
                 med = statistics.median(times)
@@ -195,14 +251,24 @@ def main(argv=None):
     largest = sizes[-1]
     serial = results[("serial", largest)]
     pipelined = results[("pipelined", largest)]
+    shm = results[("shm", largest)]
+    memcpy = results[("memcpy", largest)]
     ratio = pipelined / serial if serial else float("inf")
-    print(f"largest size {largest}: pipelined/serial = {ratio:.2f}x",
+    shm_ratio = shm / pipelined if pipelined else float("inf")
+    print(f"largest size {largest}: pipelined/serial = {ratio:.2f}x, "
+          f"shm/pipelined = {shm_ratio:.2f}x, shm at "
+          f"{shm / memcpy * 100 if memcpy else 0:.1f}% of memcpy",
           file=sys.stderr)
+    rc = 0
     if args.compare and ratio < args.min_ratio:
         print(f"FAIL: pipelined fell below {args.min_ratio:.2f}x "
               f"serial at {largest} bytes", file=sys.stderr)
-        return 1
-    return 0
+        rc = 1
+    if args.compare and shm_ratio < args.shm_min_ratio:
+        print(f"FAIL: shm lane fell below {args.shm_min_ratio:.2f}x "
+              f"pipelined at {largest} bytes", file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":

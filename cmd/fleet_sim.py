@@ -71,6 +71,11 @@ def parse_args(argv=None):
     p.add_argument("--stripes", type=int, default=None,
                    help="pipelined stripe count (default TPU_DCN_STRIPES "
                         "or 2)")
+    p.add_argument("--no-shm", action="store_true",
+                   help="pin the pipelined legs to the socket lane "
+                        "(emulated nodes are same-host, so the "
+                        "zero-copy shm lane engages by default; this "
+                        "is the fault-parity leg)")
     p.add_argument("--metrics", action="store_true",
                    help="start a per-node MetricServer (ephemeral ports)")
     p.add_argument("--slo", action="append", default=[],
@@ -134,6 +139,8 @@ def main(argv=None):
             scenario[key] = value
     if args.pipelined:
         scenario["pipelined"] = True
+    if args.no_shm:
+        scenario["shm"] = False
     if args.metrics:
         scenario["metrics"] = True
     if args.slo:

@@ -130,12 +130,15 @@ class FleetController:
         self.rounds = int(self.scenario.get("rounds", 6))
         self.payload_bytes = int(self.scenario.get("payload_bytes", 2048))
         # Pipelined ring legs: chunked/striped transfers through the
-        # same link-table fault surface.  Chunk/stripe knobs come from
-        # the scenario first, the TPU_DCN_* env second.
+        # same link-table fault surface.  Chunk/stripe/shm knobs come
+        # from the scenario first, the TPU_DCN_* env second.  Emulated
+        # nodes are same-host by construction, so `shm: false` is how
+        # a scenario pins the socket lane (fault-parity runs).
         self.pipelined = bool(self.scenario.get("pipelined", False))
         self.pipe_cfg = dcn_pipeline.PipelineConfig(
             chunk_bytes=self.scenario.get("chunk_bytes"),
             stripes=self.scenario.get("stripes"),
+            shm=self.scenario.get("shm"),
         )
         self.leg_retry = RetryPolicy(
             max_attempts=int(self.scenario.get("leg_attempts", 3)),

@@ -51,6 +51,28 @@ path, ISSUE 4):
   staged bytes back raw — the striped reader's escape from base64 on
   the control socket.
 
+Plus the zero-copy same-host staging lane (ISSUE 6; client half in
+``parallel/dcn_shm.py`` + ``parallel/dcn_pipeline.py``):
+
+- the daemon advertises ``shm``/``shm_dir``/``host_id`` in the
+  ``version`` handshake and hands out per-flow ``mmap``-backed
+  segment files under ``shm_dir`` (``shm_attach``); a same-host
+  client (exact ``host_id`` match) writes payload memoryviews
+  straight into the segment and declares them staged with one
+  ``shm_commit`` control op — the whole-frame landing happens **in
+  place**, no payload bytes on any socket;
+- a flow with a segment keeps ALL its staging storage there: remote
+  chunks landing over the data plane assemble directly into the
+  mmap, so the local reader's ``shm_read`` op (which migrates any
+  heap-staged content into the segment first) is a buffer reference,
+  not a copy stream;
+- control semantics are untouched: commits are seq-less staging
+  (dedup-exempt, like seq-0 frames), sends/waits/stats behave
+  identically whether the bytes arrived by socket or by segment, and
+  a daemon restart takes the segments with it — clients re-probe the
+  handshake on reconnect and transparently drop back to the socket
+  lane.
+
 Frame wire format (data plane):
 
     v1 (native-compatible): "DXF1" | u32 LE name_len | u64 LE
@@ -69,9 +91,12 @@ construction, and a restage must be able to overwrite.
 """
 
 import base64
+import hashlib
 import json
 import logging
+import mmap
 import os
+import shutil
 import socket
 import struct
 import threading
@@ -80,6 +105,8 @@ from typing import Dict, Optional, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import timeseries, trace
+from container_engine_accelerators_tpu.parallel import dcn_shm
+from container_engine_accelerators_tpu.utils import netio
 
 log = logging.getLogger(__name__)
 
@@ -104,12 +131,16 @@ _MAGIC_V1 = b"DXF1"
 _MAGIC_V2 = b"DXF2"
 _MAGIC_READ = b"DXR1"
 
+# Segment files are at least a page so a 1-byte flow still maps.
+SHM_MIN_SEGMENT = 4096
+
 
 class _Flow:
     __slots__ = ("owner", "peer", "buffer_bytes", "transferred",
                  "rx_bytes", "frame_bytes", "staged", "seen_seqs",
                  "max_seq", "asm_xid", "asm_total", "asm_buf",
-                 "asm_chunks", "asm_seqs")
+                 "asm_chunks", "asm_seqs", "seg_path", "seg_map",
+                 "seg_size")
 
     def __init__(self, owner: int, peer: str, buffer_bytes: int):
         self.owner = owner
@@ -128,6 +159,12 @@ class _Flow:
         self.asm_buf = None  # bytearray(asm_total) while assembling
         self.asm_chunks: Dict[int, int] = {}  # landed off -> len
         self.asm_seqs = set()  # seqs whose bytes live in THIS assembly
+        # Shared-memory segment (same-host zero-copy lane).  When set,
+        # the flow's staging storage lives IN the mmap: ``staged`` and
+        # ``asm_buf`` become memoryviews of ``seg_map``.
+        self.seg_path: Optional[str] = None
+        self.seg_map = None  # mmap.mmap while attached
+        self.seg_size = 0
 
     def discard_assembly(self) -> None:
         """Drop the in-progress assembly AND un-see its seqs: a seq is
@@ -139,6 +176,34 @@ class _Flow:
         self.asm_xid = None
         self.asm_buf = None
         self.asm_chunks = {}
+
+    def seg_view(self, nbytes: int) -> memoryview:
+        """A writable view of the segment's first ``nbytes``."""
+        return memoryview(self.seg_map)[:nbytes]
+
+    def close_segment(self, unlink: bool = True) -> None:
+        """Detach the flow's shm segment: drop view-backed staging (the
+        bytes die with the flow/daemon, same as heap staging), close
+        the mmap, and unlink the file unless this is a crash (SIGKILL
+        leaves files behind; the next start() wipes the directory)."""
+        path, m = self.seg_path, self.seg_map
+        self.seg_path, self.seg_map, self.seg_size = None, None, 0
+        if isinstance(self.staged, memoryview):
+            self.staged = b""
+            self.frame_bytes = 0
+        if isinstance(self.asm_buf, memoryview):
+            self.discard_assembly()
+        if m is not None:
+            try:
+                m.close()
+            except (BufferError, ValueError):
+                pass  # an exported slice keeps it alive until GC
+            timeseries.gauge_add("dcn.shm.segments", -1)
+        if unlink and path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     def range_staged(self, offset: int, nbytes: int,
                      xid: Optional[str] = None) -> bool:
@@ -167,20 +232,16 @@ class _Flow:
                    xid: Optional[str] = None) -> bytes:
         if (self.frame_bytes and offset + nbytes <= len(self.staged)
                 and (xid is None or self.asm_xid == xid)):
-            return self.staged[offset:offset + nbytes]
+            # bytes() either way: a memoryview (shm-backed staging)
+            # must not escape the lock — the segment can be remapped
+            # or closed the moment the caller lets go.
+            return bytes(self.staged[offset:offset + nbytes])
         return bytes(self.asm_buf[offset:offset + nbytes])
 
 
-def _recv_exact(conn: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = conn.recv_into(view[got:], n - got)
-        if not r:
-            raise ConnectionError("data connection closed mid-frame")
-        got += r
-    return bytes(buf)
+# Exact reads and capped, short-write-proof sends live in utils/netio
+# (the rig's stack truncates very large single-syscall payloads).
+_recv_exact = netio.recv_exact
 
 
 def _set_nodelay(sock: socket.socket) -> None:
@@ -242,8 +303,7 @@ class _PeerConn:
                 _set_nodelay(s)
                 self.sock = s
             try:
-                for part in parts:
-                    self.sock.sendall(part)
+                netio.sendall_parts(self.sock, parts)
             except OSError:
                 self.close_locked()
                 raise
@@ -265,12 +325,23 @@ class PyXferd:
     """One emulated node's transfer daemon."""
 
     def __init__(self, uds_dir: str, node: str = "", net=None,
-                 data_host: str = "127.0.0.1"):
+                 data_host: str = "127.0.0.1",
+                 shm: Optional[bool] = None,
+                 host_id: Optional[str] = None):
         self.uds_dir = uds_dir
         self.node = node
         self.net = net
         self.data_host = data_host
         self.sock_path = os.path.join(uds_dir, SOCKET_NAME)
+        # Zero-copy same-host lane: per-flow mmap segments under
+        # shm_dir, advertised with this daemon's host identity so a
+        # client can tell "same address" from "same machine".
+        # ``shm``/``host_id`` overrides are the cross-host and
+        # capability-less test handles.
+        self.shm_enabled = (dcn_shm.shm_enabled() if shm is None
+                            else bool(shm))
+        self.shm_dir = os.path.join(uds_dir, "shm")
+        self.host_id = host_id or dcn_shm.host_identity()
         self.data_port = 0
         self.generation = 0
         self._flows: Dict[str, _Flow] = {}
@@ -301,6 +372,11 @@ class PyXferd:
         os.makedirs(self.uds_dir, exist_ok=True)
         if os.path.exists(self.sock_path):
             os.unlink(self.sock_path)  # the real daemon unlinks-then-binds
+        # Crash-lingering segment files belong to the dead incarnation;
+        # wipe them the same way the socket path is unlinked.
+        shutil.rmtree(self.shm_dir, ignore_errors=True)
+        if self.shm_enabled:
+            os.makedirs(self.shm_dir, exist_ok=True)
         self._stopping.clear()
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         srv.bind(self.sock_path)
@@ -346,7 +422,14 @@ class PyXferd:
             os.unlink(self.sock_path)
         # Process death: all staging buffers, seqs windows, accounting
         # die with it — exactly what the restart chaos scenarios need.
+        # Segments go too: on a clean stop the files are unlinked, on a
+        # crash they linger (like the socket path) until the next
+        # start() wipes the directory — either way a client holding a
+        # stale mapping writes into an orphaned inode the next daemon
+        # can never see, which is why the client remaps per transfer.
         with self._lock:
+            for f in self._flows.values():
+                f.close_segment(unlink=not crash)
             self._flows.clear()
             self._total_transferred = 0
             self._unmatched = 0
@@ -415,6 +498,7 @@ class PyXferd:
         with self._lock:
             for name in [n for n, f in self._flows.items()
                          if f.owner == conn_id]:
+                self._flows[name].close_segment()
                 del self._flows[name]
             self._landed.notify_all()  # waiters re-check released flows
             stale = [k for k in self._peer_conns if k[0] == conn_id]
@@ -433,8 +517,16 @@ class PyXferd:
 
     def _dispatch(self, conn_id: int, op: str, req: dict) -> dict:
         if op == "version":
-            return {"ok": True, "version": VERSION, "frame_version": 2,
+            resp = {"ok": True, "version": VERSION, "frame_version": 2,
                     "pipeline": 1}
+            if self.shm_enabled:
+                # The zero-copy lane's capability triple: clients take
+                # it only on an exact host_id match (boot identity —
+                # same ADDRESS is not same MACHINE), and only if the
+                # advertised segment paths actually map.
+                resp.update(shm=1, shm_dir=self.shm_dir,
+                            host_id=self.host_id)
+            return resp
         if op == "ping":
             return {"ok": True}
         if op == "data_port":
@@ -471,6 +563,7 @@ class PyXferd:
                 if f.owner != conn_id:
                     return {"ok": False,
                             "error": "flow owned by another client"}
+                f.close_segment()
                 del self._flows[req["flow"]]
             return {"ok": True}
         if op == "read":
@@ -481,6 +574,12 @@ class PyXferd:
             return self._wait(req)
         if op == "stats":
             return self._stats(req.get("flow"))
+        if op == "shm_attach":
+            return self._shm_attach(req)
+        if op == "shm_commit":
+            return self._shm_commit(req)
+        if op == "shm_read":
+            return self._shm_read(req)
         return {"ok": False, "error": f"unknown op: {op}"}
 
     def _wait(self, req: dict) -> dict:
@@ -522,13 +621,14 @@ class PyXferd:
             f = self._flows.get(req["flow"])
             if f is None:
                 return {"ok": False, "error": "unknown flow"}
-            staged = f.staged
             frame_bytes = f.frame_bytes
-        if offset > len(staged):
-            return {"ok": False,
-                    "error": f"'offset' beyond staged data "
-                             f"(frame_bytes={frame_bytes})"}
-        chunk = staged[offset:offset + min(nbytes, READ_CAP)]
+            if offset > len(f.staged):
+                return {"ok": False,
+                        "error": f"'offset' beyond staged data "
+                                 f"(frame_bytes={frame_bytes})"}
+            # Copy under the lock: shm-backed staging is a memoryview
+            # whose mapping must not outlive this critical section.
+            chunk = bytes(f.staged[offset:offset + min(nbytes, READ_CAP)])
         return {"ok": True, "data": base64.b64encode(chunk).decode(),
                 "frame_bytes": frame_bytes}
 
@@ -544,12 +644,13 @@ class PyXferd:
                 f = self._flows.get(flow)
                 if f is None:
                     return {"ok": False, "error": "unknown flow"}
-                payload = f.staged
+                # bytes() under the lock: shm-backed staging is a view
+                # of a mapping that may be remapped once we let go.
+                nbytes = int(req.get("bytes") or len(f.staged))
+                payload = bytes(f.staged[:nbytes])
             if not payload:
                 return {"ok": False,
                         "error": f"nothing staged for flow {flow!r}"}
-            nbytes = int(req.get("bytes") or len(payload))
-            payload = payload[:nbytes]
             meta_extra = {}
         else:
             # Chunked send: stream staged[offset:offset+bytes] as one
@@ -646,8 +747,9 @@ class PyXferd:
                   seq: Optional[int], meta: dict) -> None:
         with socket.create_connection((host, port), timeout=30) as s:
             _set_nodelay(s)
-            s.sendall(encode_frame_header(flow, len(payload), seq, meta))
-            s.sendall(payload)
+            netio.sendall_parts(
+                s, (encode_frame_header(flow, len(payload), seq, meta),
+                    payload))
 
     def _peer_conn(self, conn_id: int, host: str, port: int) -> _PeerConn:
         key = (conn_id, host, port)
@@ -679,10 +781,147 @@ class PyXferd:
                      "transferred": f.transferred,
                      "rx_bytes": f.rx_bytes,
                      "frame_bytes": f.frame_bytes,
-                     "max_seq": f.max_seq}
+                     "max_seq": f.max_seq,
+                     "shm": f.seg_map is not None}
                     for name, f in items
                 ],
             }
+
+    # -- shm lane (zero-copy same-host staging) ------------------------------
+
+    def _ensure_segment_locked(self, flow: str, f: _Flow,
+                               nbytes: int) -> None:
+        """Create (or grow) ``flow``'s mmap segment to >= ``nbytes``
+        and move every live staging buffer into the current mapping —
+        heap content is copied once, old-mapping views are repointed
+        (same inode, same bytes).  After this, "the flow has a
+        segment" always implies "the flow's bytes are readable through
+        it".  Caller holds the lock; raises ``OSError`` on filesystem
+        trouble (the client's fallback signal)."""
+        need = max(int(nbytes), SHM_MIN_SEGMENT)
+        old_map = None
+        remapped = False
+        if f.seg_map is None or f.seg_size < need:
+            os.makedirs(self.shm_dir, exist_ok=True)
+            path = f.seg_path or os.path.join(
+                self.shm_dir,
+                hashlib.sha1(flow.encode()).hexdigest()[:16] + ".seg")
+            size = max(need, f.seg_size)
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                new_map = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+            if f.seg_map is None:
+                timeseries.gauge_add("dcn.shm.segments", 1)
+            old_map = f.seg_map
+            f.seg_map, f.seg_path, f.seg_size = new_map, path, size
+            remapped = True
+        view = memoryview(f.seg_map)
+        if f.asm_buf is not None and f.asm_total <= f.seg_size:
+            staged_is_asm = f.staged is f.asm_buf
+            if isinstance(f.asm_buf, bytearray):
+                view[:f.asm_total] = f.asm_buf  # heap -> segment, once
+                f.asm_buf = view[:f.asm_total]
+            elif remapped:  # old-mapping view: repoint, no copy
+                f.asm_buf = view[:f.asm_total]
+            if staged_is_asm:
+                f.staged = f.asm_buf
+        if isinstance(f.staged, (bytes, bytearray)) and f.frame_bytes \
+                and f.frame_bytes <= f.seg_size:
+            view[:f.frame_bytes] = f.staged
+            f.staged = view[:f.frame_bytes]
+        elif (isinstance(f.staged, memoryview) and remapped
+                and f.staged is not f.asm_buf):
+            f.staged = view[:len(f.staged)]
+        if old_map is not None:
+            try:
+                old_map.close()
+            except (BufferError, ValueError):
+                pass  # an exported slice keeps it alive until GC
+
+    def _shm_attach(self, req: dict) -> dict:
+        """Hand the client a per-flow segment (path + mapped size).
+        Idempotent; growing re-truncates the same inode so existing
+        content — and existing client mappings of the old range —
+        stay valid."""
+        if not self.shm_enabled:
+            return {"ok": False, "error": "shm lane disabled"}
+        flow = req["flow"]
+        nbytes = int(req.get("bytes") or 0)
+        if nbytes < 0:
+            return {"ok": False, "error": "invalid 'bytes'"}
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            try:
+                self._ensure_segment_locked(flow, f, nbytes)
+            except OSError as e:
+                return {"ok": False, "error": f"shm attach failed: {e}"}
+            return {"ok": True, "path": f.seg_path,
+                    "bytes": f.seg_size, "frame_bytes": f.frame_bytes}
+
+    def _shm_commit(self, req: dict) -> dict:
+        """Declare ``[0, bytes)`` of the flow's segment a completed
+        staged frame — the zero-copy analog of a whole-payload ``put``.
+        The landing happens IN PLACE: no payload bytes cross a socket,
+        but the bookkeeping (rx accounting, wait wakeups, assembly
+        invalidation) is the same ``land_frame`` every other staging
+        path uses.  Commits are seq-less staging, dedup-exempt and
+        idempotent by construction — a restage after a failed round
+        simply commits again."""
+        if not self.shm_enabled:
+            return {"ok": False, "error": "shm lane disabled"}
+        flow = req["flow"]
+        nbytes = int(req.get("bytes") or 0)
+        xid = req.get("xid") or ""
+        if nbytes <= 0:
+            return {"ok": False, "error": "shm commit needs bytes > 0"}
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            if f.seg_map is None or f.seg_size < nbytes:
+                return {"ok": False,
+                        "error": "no shm segment attached for "
+                                 f"{nbytes} bytes; shm_attach first"}
+            view = f.seg_view(nbytes)
+        verdict = self.land_frame(flow, view, None,
+                                  {"xid": xid} if xid else {},
+                                  in_place=True)
+        if verdict != "landed":
+            return {"ok": False,
+                    "error": f"shm commit not landed: {verdict}"}
+        counters.inc("dcn.shm.commits")
+        return {"ok": True, "bytes": nbytes}
+
+    def _shm_read(self, req: dict) -> dict:
+        """Make the flow's completed frame readable through its
+        segment and say where: frames that landed into heap buffers
+        (the flow was never attached, or the segment was too small)
+        are migrated in with one copy — still one copy fewer than any
+        socket read-back.  The client maps the returned path and
+        slices; no payload bytes cross the control socket."""
+        if not self.shm_enabled:
+            return {"ok": False, "error": "shm lane disabled"}
+        flow = req["flow"]
+        nbytes = int(req.get("bytes") or 0)
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            if not f.frame_bytes:
+                return {"ok": False,
+                        "error": "no completed frame staged"}
+            try:
+                self._ensure_segment_locked(
+                    flow, f, max(nbytes, f.frame_bytes))
+            except OSError as e:
+                return {"ok": False, "error": f"shm read failed: {e}"}
+            return {"ok": True, "path": f.seg_path,
+                    "bytes": f.seg_size, "frame_bytes": f.frame_bytes}
 
     # -- data plane ----------------------------------------------------------
 
@@ -748,10 +987,12 @@ class PyXferd:
             else:
                 end = min(offset + nbytes, f.frame_bytes,
                           len(f.staged))
-                data = f.staged[offset:end] if offset < end else b""
+                # bytes() under the lock — shm staging is a view.
+                data = bytes(f.staged[offset:end]) if offset < end \
+                    else b""
         try:
-            conn.sendall(struct.pack("<Q", len(data)))
-            conn.sendall(data)
+            netio.sendall_parts(conn, (struct.pack("<Q", len(data)),
+                                       data))
         except OSError:
             return False
         return True
@@ -779,9 +1020,10 @@ class PyXferd:
         payload = _recv_exact(conn, payload_len)
         return flow, payload, seq, meta
 
-    def land_frame(self, flow: str, payload: bytes,
+    def land_frame(self, flow: str, payload,
                    seq: Optional[int] = None, meta: Optional[dict] = None,
-                   link: Optional[Tuple[str, str]] = None) -> str:
+                   link: Optional[Tuple[str, str]] = None,
+                   in_place: bool = False) -> str:
         """Land one frame into a flow's staging buffer.
 
         Returns "landed", "dup" (seq already landed — dropped without
@@ -795,6 +1037,11 @@ class PyXferd:
         frame) bypasses dedup: that is local staging, idempotent by
         construction.  Landing joins the SENDER's trace via the frame
         meta.
+
+        ``in_place=True`` (the shm commit path) means the payload
+        bytes already live in the flow's segment: the landing does all
+        the bookkeeping — accounting, wait wakeups, assembly
+        invalidation — without ever copying the payload.
         """
         meta = meta or {}
         with trace.attach(meta.get("trace"), meta.get("span")):
@@ -822,7 +1069,7 @@ class PyXferd:
                             f.seen_seqs = {s for s in f.seen_seqs
                                            if s >= floor}
                     verdict = self._land_locked(flow, f, payload,
-                                                meta, seq)
+                                                meta, seq, in_place)
                     self._landed.notify_all()
                 span.annotate(verdict=verdict)
                 if verdict == "landed":
@@ -849,8 +1096,8 @@ class PyXferd:
                                           len(payload))
                 return verdict
 
-    def _land_locked(self, flow: str, f: _Flow, payload: bytes,
-                     meta: dict, seq) -> str:
+    def _land_locked(self, flow: str, f: _Flow, payload,
+                     meta: dict, seq, in_place: bool = False) -> str:
         """Write one (deduped) frame into flow state; caller holds the
         lock."""
         off = meta.get("off")
@@ -858,10 +1105,24 @@ class PyXferd:
             # Whole-payload frame: replaces staging wholesale and
             # cancels any in-progress assembly (the serial fallback
             # after a pipelined attempt must win outright).
-            f.staged = bytes(payload)
+            if in_place:
+                # shm commit: the bytes are already in the segment.
+                # Re-take the view under THIS lock hold — the segment
+                # could have been remapped since the caller sliced it.
+                if f.seg_map is None or f.seg_size < len(payload):
+                    return "rejected"
+                f.staged = f.seg_view(len(payload))
+            else:
+                f.staged = bytes(payload)
             f.frame_bytes = len(payload)
             f.rx_bytes += len(payload)
             f.discard_assembly()
+            if in_place:
+                # Stamp the committing transfer's xid so offset-sends
+                # of the same transfer match this frame (the sender's
+                # stale-frame guard on reused flows).
+                f.asm_xid = meta.get("xid") or None
+                f.asm_total = len(payload)
             return "landed"
         off = int(off)
         tot = int(meta.get("tot") or 0)
@@ -886,7 +1147,13 @@ class PyXferd:
             f.frame_bytes = 0
             f.asm_xid = xid
             f.asm_total = tot
-            f.asm_buf = bytearray(tot)
+            if f.seg_map is not None and f.seg_size >= tot:
+                # shm-attached flow: assemble straight into the mmap,
+                # so the local reader's shm_read is a buffer reference
+                # with no migration copy.
+                f.asm_buf = f.seg_view(tot)
+            else:
+                f.asm_buf = bytearray(tot)
         f.asm_buf[off:off + len(payload)] = payload
         f.asm_chunks[off] = len(payload)
         if seq:

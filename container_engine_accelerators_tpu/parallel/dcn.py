@@ -303,15 +303,29 @@ def exchange_shard(
 def _exchange_pipelined(client, local_flow, peer_flow, data, peer_host,
                         peer_port, cfg, timeout_s) -> bytes:
     """The pipelined leg body: overlapped chunked stage+send of the
-    local shard, then land-wait and DXR1 read-back of the peer's.
-    Flows are already registered; the caller owns release."""
+    local shard, then land-wait and read-back of the peer's (zero-copy
+    shm when the daemon is same-host, DXR1 otherwise).  Flows are
+    already registered; the caller owns release."""
     from container_engine_accelerators_tpu.obs import trace
     from container_engine_accelerators_tpu.parallel import dcn_pipeline
+    from container_engine_accelerators_tpu.parallel.dcn_client import (
+        DcnXferError,
+    )
 
     nbytes = len(data)
     with trace.span("dcn.exchange.pipeline",
                     histogram="dcn.exchange.pipeline",
                     local_flow=local_flow, bytes=nbytes):
+        if cfg.shm and dcn_pipeline.shm_same_host(client):
+            # Attach the LANDING flow's segment before the peer's
+            # chunks arrive: they then assemble straight into the
+            # mmap and the shm read below is a pure buffer reference.
+            # Best effort — without it, shm_read migrates with one
+            # copy, which still beats any socket stream.
+            try:
+                client.shm_attach(peer_flow, nbytes)
+            except (DcnXferError, OSError):
+                pass
         dcn_pipeline.send_pipelined(client, local_flow, data,
                                     peer_host, peer_port, cfg,
                                     timeout_s=timeout_s)

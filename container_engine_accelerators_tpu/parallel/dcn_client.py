@@ -25,7 +25,7 @@ from typing import Dict, Optional
 
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import flight, timeseries, trace
-from container_engine_accelerators_tpu.utils import faults
+from container_engine_accelerators_tpu.utils import faults, netio
 from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
@@ -53,8 +53,10 @@ class DcnXferClient:
         # Per-flow monotonic frame sequence for `send` (client-owned:
         # it must survive daemon restarts, which reset daemon state).
         self._send_seq: Dict[str, int] = {}
-        # Daemon capability cache (version-op response); tri-state for
-        # the wait op so the unsupported path is probed exactly once.
+        # Daemon capability cache (version-op response), valid for ONE
+        # connection — _connect() resets it so a daemon restart is
+        # re-probed, never trusted stale; tri-state for the wait op so
+        # the unsupported path is probed exactly once per connection.
         self._caps: Optional[dict] = None
         self._wait_supported: Optional[bool] = None
         self._connect()
@@ -75,6 +77,14 @@ class DcnXferClient:
         self._sock = sock
         self._rfile = sock.makefile("r")
         self._broken = False
+        # Capabilities are a property of the CONNECTION, not the
+        # client: the daemon on the other end of a reconnect may be a
+        # different binary (a restart downgraded/upgraded it), so every
+        # cached handshake answer is re-probed on the next use instead
+        # of trusted stale — the shm/pipeline lane selection depends
+        # on this.
+        self._caps = None
+        self._wait_supported = None
 
     def close(self) -> None:
         """Closing releases every flow this client registered (the daemon
@@ -130,9 +140,13 @@ class DcnXferClient:
         return self._call(op="version")["version"]
 
     def capabilities(self) -> dict:
-        """The version-op response, cached: daemons advertise protocol
-        extensions here (``frame_version``, ``pipeline``); absent keys
-        mean the native DXF1-only daemon."""
+        """The version-op response, cached PER CONNECTION: daemons
+        advertise protocol extensions here (``frame_version``,
+        ``pipeline``, and the shm lane's ``shm``/``shm_dir``/
+        ``host_id`` triple); absent keys mean the native DXF1-only
+        daemon.  The cache dies with the connection — after a
+        reconnect the next call re-probes, so a daemon that restarted
+        into a different capability set is never trusted stale."""
         if self._caps is None:
             self._caps = self._call(op="version")
         return self._caps
@@ -142,6 +156,33 @@ class DcnXferClient:
 
     def supports_pipeline(self) -> bool:
         return bool(self.capabilities().get("pipeline", 0))
+
+    def supports_shm(self) -> bool:
+        """The daemon OFFERS the shm lane.  Whether this client can
+        take it also needs the same-host identity check — that lives
+        in ``parallel.dcn_pipeline.shm_same_host`` next to the lane
+        selection."""
+        return bool(self.capabilities().get("shm", 0))
+
+    # -- shm lane ops (zero-copy same-host staging; fleet/xferd.py) ----------
+
+    def shm_attach(self, flow: str, nbytes: int) -> dict:
+        """Ask the daemon for the flow's mmap segment; returns
+        ``{path, bytes, frame_bytes}``.  Idempotent, grows in place."""
+        return self._call(op="shm_attach", flow=flow, bytes=int(nbytes))
+
+    def shm_commit(self, flow: str, nbytes: int, xid: str = "") -> dict:
+        """Declare ``[0, nbytes)`` of the attached segment a completed
+        staged frame (in-place landing; dedup-exempt like any other
+        staging, idempotent by construction)."""
+        return self._call(op="shm_commit", flow=flow, bytes=int(nbytes),
+                          xid=xid)
+
+    def shm_read(self, flow: str, nbytes: int) -> dict:
+        """Make the flow's completed frame visible in its segment and
+        return ``{path, bytes, frame_bytes}`` for the caller to map —
+        the read-back that never puts payload bytes on a socket."""
+        return self._call(op="shm_read", flow=flow, bytes=int(nbytes))
 
     def ping(self) -> None:
         self._call(op="ping")
@@ -242,7 +283,10 @@ class DcnXferClient:
             "<Q", len(data)
         )
         with socket.create_connection((host, port), timeout=30) as s:
-            s.sendall(hdr + name + data)
+            # Separate buffers (no concat copy of the payload) through
+            # the short-write-proof capped sender — multi-MiB frames
+            # must survive platforms whose sendmsg truncates.
+            netio.sendall_parts(s, (hdr, name, data))
         timeseries.record("dcn.stage.bytes", len(data))
 
     def stats(self, flow: Optional[str] = None) -> dict:

@@ -42,8 +42,23 @@ to finish assembling (the daemon's blocking ``wait`` op), then fetches
 contiguous slabs in parallel over raw data-plane ``DXR1`` requests —
 no base64, no 512 KiB control-socket chunking.
 
-Both fall back loudly (``DcnXferError``) rather than silently: the
-callers (``dcn.exchange_shard``, the fleet ring workload) own the
+On top of both sits the **zero-copy same-host lane** (ISSUE 6): when
+the daemon advertises ``shm`` in its handshake AND its ``host_id``
+matches this process's boot identity, staging becomes memoryview
+writes into the flow's mmap segment plus one ``shm_commit`` control
+op (no payload bytes on any socket, no stager/stripe thread fan-out —
+this rig's thread handoffs cost more than they buy), and read-back
+becomes ``shm_read`` + a client-side mapping instead of DXR1 socket
+copies.  The daemon→peer leg and every control op (seq assignment,
+dedup, ``wait``, fabric verdicts) are untouched, so exactly-once
+semantics are identical on either lane.  Lane selection happens PER
+RETRY ROUND: a daemon that restarts without the capability mid-
+transfer downgrades the remaining rounds to the socket lane
+(``dcn.shm.fallback``) under the same chunk seqs — cross-host peers
+and capability-less daemons simply never leave it.
+
+All of it falls back loudly (``DcnXferError``) rather than silently:
+the callers (``dcn.exchange_shard``, the fleet ring workload) own the
 serial fallback and the leg-level retry.
 """
 
@@ -59,17 +74,20 @@ from typing import Dict, List, Optional, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import timeseries, trace
+from container_engine_accelerators_tpu.parallel import dcn_shm
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnWaitUnsupported,
     DcnXferClient,
     DcnXferError,
 )
+from container_engine_accelerators_tpu.utils import netio
 
 log = logging.getLogger(__name__)
 
 CHUNK_BYTES_ENV = "TPU_DCN_CHUNK_BYTES"
 STRIPES_ENV = "TPU_DCN_STRIPES"
 PIPELINE_ENV = "TPU_DCN_PIPELINE"
+SHM_ENV = dcn_shm.SHM_ENV
 
 DEFAULT_CHUNK_BYTES = 1 << 20
 DEFAULT_STRIPES = 2
@@ -98,7 +116,7 @@ class PipelineConfig:
     def __init__(self, chunk_bytes: Optional[int] = None,
                  stripes: Optional[int] = None,
                  max_rounds: int = DEFAULT_MAX_ROUNDS,
-                 env=None):
+                 env=None, shm: Optional[bool] = None):
         env = env if env is not None else os.environ
         if chunk_bytes is None:
             chunk_bytes = int(env.get(CHUNK_BYTES_ENV,
@@ -110,10 +128,15 @@ class PipelineConfig:
         self.max_rounds = max(1, int(max_rounds))
         self.enabled = env.get(PIPELINE_ENV, "1") not in ("0", "false",
                                                           "off")
+        # Zero-copy same-host lane kill switch (TPU_DCN_SHM): ``shm``
+        # here means "MAY take the lane" — the daemon capability and
+        # the host-identity match still gate each transfer.
+        self.shm = (dcn_shm.shm_enabled(env) if shm is None
+                    else bool(shm))
 
     def __repr__(self):
         return (f"PipelineConfig(chunk_bytes={self.chunk_bytes}, "
-                f"stripes={self.stripes})")
+                f"stripes={self.stripes}, shm={self.shm})")
 
 
 def plan_chunks(nbytes: int, chunk_bytes: int) -> List[Tuple[int, int]]:
@@ -138,6 +161,20 @@ def should_pipeline(client, nbytes: int,
                 and client.supports_pipeline())
     except (DcnXferError, OSError, AttributeError):
         return False
+
+
+def shm_same_host(client) -> bool:
+    """The daemon offers the shm lane AND lives on this machine.
+    Identity is the handshake's ``host_id`` (boot id + hostname)
+    compared to ours — never the socket address: a forwarded UDS or a
+    shared loopback across a netns boundary is "same address" without
+    being "same filesystem"."""
+    try:
+        caps = client.capabilities()
+    except (DcnXferError, OSError, AttributeError):
+        return False
+    return (bool(caps.get("shm"))
+            and caps.get("host_id") == dcn_shm.host_identity())
 
 
 def _chunk_frame_header(flow: str, payload_len: int,
@@ -192,16 +229,9 @@ def _set_nodelay(sock: socket.socket) -> None:
         pass
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        r = sock.recv_into(view[got:], n - got)
-        if not r:
-            raise ConnectionError("data connection closed mid-read")
-        got += r
-    return bytes(buf)
+# Exact reads and capped short-write-proof sends live in utils/netio
+# (this rig's stack truncates very large single-syscall payloads).
+_recv_exact = netio.recv_exact
 
 
 class _StripeResult:
@@ -243,10 +273,12 @@ def _stage_worker(data_host: str, data_port: int, flow: str, data,
                 with trace.span("dcn.chunk.stage",
                                 histogram="dcn.chunk.stage",
                                 flow=flow, off=off, bytes=ln):
-                    dsock.sendall(_chunk_frame_header(flow, ln, {
-                        "off": off, "tot": total, "xid": xid,
-                    }))
-                    dsock.sendall(view[off:off + ln])
+                    netio.sendall_parts(dsock, (
+                        _chunk_frame_header(flow, ln, {
+                            "off": off, "tot": total, "xid": xid,
+                        }),
+                        view[off:off + ln],
+                    ))
     except (DcnXferError, OSError) as e:
         result.fail(e)
     finally:
@@ -255,6 +287,40 @@ def _stage_worker(data_host: str, data_port: int, flow: str, data,
                 dsock.close()
             except OSError:
                 pass
+
+
+def _send_chunk(ctl, flow: str, chunks, seqs, idx: int, xid: str,
+                host: str, port: int, total: int, timeout_s: float,
+                result: _StripeResult,
+                lane: Optional[str] = None) -> None:
+    """Issue one offset-send and score its verdict — shared by the
+    stripe workers and the shm round, so the settled-verdict set and
+    the confirmed-chunk accounting can never diverge between lanes.
+    Raises on control-connection trouble; the caller owns what the
+    unrecorded chunks mean then."""
+    off, ln = chunks[idx]
+    span_attrs = {"lane": lane} if lane else {}
+    timeseries.gauge_add("dcn.chunks.inflight", 1)
+    try:
+        with trace.span("dcn.chunk.send", histogram="dcn.chunk.send",
+                        flow=flow, off=off, bytes=ln, seq=seqs[idx],
+                        **span_attrs):
+            resp = ctl._call(
+                op="send", flow=flow, host=host, port=str(port),
+                seq=seqs[idx], offset=off, bytes=ln, total=total,
+                xid=xid,
+                stage_wait_ms=int(min(timeout_s, 5.0) * 1e3),
+            )
+    finally:
+        timeseries.gauge_add("dcn.chunks.inflight", -1)
+    verdict = resp.get("verdict", "sent")
+    if verdict in ("sent", "landed", "dup"):
+        # Count CONFIRMED chunks only (the README table's contract);
+        # dropped/unmatched retransmit attempts show up in
+        # dcn.pipeline.retry_rounds instead.
+        counters.inc("dcn.pipeline.chunks")
+        timeseries.record("dcn.pipeline.tx.bytes", ln)
+    result.record(idx, verdict)
 
 
 def _send_worker(uds_dir: str, flow: str, chunks, seqs, idxs,
@@ -273,35 +339,89 @@ def _send_worker(uds_dir: str, flow: str, chunks, seqs, idxs,
                           ctx.get("span") if ctx else None):
             ctl = DcnXferClient(uds_dir, timeout_s=max(timeout_s, 10.0))
             for idx in idxs:
-                off, ln = chunks[idx]
-                timeseries.gauge_add("dcn.chunks.inflight", 1)
-                try:
-                    with trace.span("dcn.chunk.send",
-                                    histogram="dcn.chunk.send",
-                                    flow=flow, off=off, bytes=ln,
-                                    seq=seqs[idx]):
-                        resp = ctl._call(
-                            op="send", flow=flow, host=host,
-                            port=str(port), seq=seqs[idx], offset=off,
-                            bytes=ln, total=total, xid=xid,
-                            stage_wait_ms=int(min(timeout_s, 5.0) * 1e3),
-                        )
-                finally:
-                    timeseries.gauge_add("dcn.chunks.inflight", -1)
-                verdict = resp.get("verdict", "sent")
-                if verdict in ("sent", "landed", "dup"):
-                    # Count CONFIRMED chunks only (the README table's
-                    # contract); dropped/unmatched retransmit attempts
-                    # show up in dcn.pipeline.retry_rounds instead.
-                    counters.inc("dcn.pipeline.chunks")
-                    timeseries.record("dcn.pipeline.tx.bytes", ln)
-                result.record(idx, verdict)
+                _send_chunk(ctl, flow, chunks, seqs, idx, xid, host,
+                            port, total, timeout_s, result)
     except (DcnXferError, OSError) as e:
         # The scoreboard decides what to retry; this stripe's remaining
         # chunks simply stay unrecorded.
         result.fail(e)
     finally:
         timeseries.gauge_add("dcn.stripes.active", -1)
+        if ctl is not None:
+            try:
+                ctl.close()
+            except OSError:
+                pass
+
+
+def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
+               xid: str, host: str, port: int, timeout_s: float,
+               result: _StripeResult, ctx: Optional[dict],
+               already_staged: bool = False) -> bool:
+    """One zero-copy-lane round: stage the payload into the flow's
+    segment (memoryview writes + ONE in-place ``shm_commit``), then
+    issue this round's offset-sends serially on a dedicated fail-fast
+    control connection — no stager thread, no stripe fan-out: staging
+    is a memcpy now, and this rig's thread handoffs cost more than
+    they buy.
+
+    ``already_staged`` means an earlier round of THIS transfer staged
+    and committed the whole frame; when the daemon still holds it
+    (``shm_attach`` reports the full ``frame_bytes`` — a restart would
+    have reset that to 0 through flow replay), the memcpy and the
+    re-commit are skipped and the round pays only for the chunks it
+    re-sends.
+
+    Returns False when the shm machinery itself is unusable (attach
+    rejected, segment unmappable, daemon gone) — the caller's signal
+    to run the socket lane instead.  Send failures after a successful
+    stage return True with the chunks left pending: the normal retry
+    round re-sends them under the SAME seqs, on whichever lane is
+    alive then."""
+    nbytes = len(data)
+    ctl = None
+    seg = None
+    try:
+        with trace.attach(ctx.get("trace") if ctx else None,
+                          ctx.get("span") if ctx else None):
+            try:
+                ctl = DcnXferClient(uds_dir,
+                                    timeout_s=max(timeout_s, 10.0))
+                resp = ctl.shm_attach(flow, nbytes)
+                if not (already_staged
+                        and int(resp.get("frame_bytes") or 0)
+                        >= nbytes):
+                    with trace.span("dcn.shm.stage",
+                                    histogram="dcn.shm.stage",
+                                    flow=flow, bytes=nbytes, xid=xid):
+                        seg = dcn_shm.map_segment(
+                            resp.get("path", ""),
+                            int(resp.get("bytes") or 0))
+                        if seg.size < nbytes:
+                            raise OSError(
+                                "segment smaller than payload")
+                        src = memoryview(data)
+                        for off, ln in chunks:
+                            seg.view[off:off + ln] = src[off:off + ln]
+                        ctl.shm_commit(flow, nbytes, xid)
+                    timeseries.record("dcn.shm.tx.bytes", nbytes)
+            except (DcnXferError, OSError) as e:
+                result.fail(e)
+                return False
+            for idx in idxs:
+                try:
+                    _send_chunk(ctl, flow, chunks, seqs, idx, xid,
+                                host, port, nbytes, timeout_s, result,
+                                lane="shm")
+                except (DcnXferError, OSError) as e:
+                    # Staged fine; these chunks simply stay pending
+                    # for the next round (same seqs, any lane).
+                    result.fail(e)
+                    return True
+            return True
+    finally:
+        if seg is not None:
+            seg.close()
         if ctl is not None:
             try:
                 ctl.close()
@@ -318,9 +438,18 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     ``client`` is the primary (usually resilient) control client: it
     owns the flow registration, the per-flow seq counter, and the
     control-plane healing between retry rounds.  Returns
-    ``{bytes, chunks, stripes, rounds}``; raises :class:`DcnXferError`
-    once the round budget is spent (callers own the serial fallback /
-    leg retry).
+    ``{bytes, chunks, stripes, rounds, lane}`` (``lane`` is ``shm``,
+    ``socket``, or ``shm+socket`` when a mid-transfer downgrade mixed
+    them); raises :class:`DcnXferError` once the round budget is spent
+    (callers own the serial fallback / leg retry).
+
+    Lane selection is per retry round: a same-host daemon advertising
+    ``shm`` gets the zero-copy staging round (no threads, one commit,
+    serial sends); everything else — cross-host, capability-less,
+    kill-switched, or a lane that broke mid-transfer
+    (``dcn.shm.fallback``) — gets the threaded socket round.  Chunk
+    seqs are fixed up front, so retransmits are exactly-once no matter
+    which lane a round ran on.
     """
     cfg = cfg or PipelineConfig()
     nbytes = len(data)
@@ -338,7 +467,8 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     if not chunks:
         # Empty payloads never reach here through should_pipeline, but
         # the public contract must not divide by the chunk count.
-        return {"bytes": 0, "chunks": 0, "stripes": 0, "rounds": 0}
+        return {"bytes": 0, "chunks": 0, "stripes": 0, "rounds": 0,
+                "lane": "none"}
     stripes = min(cfg.stripes, len(chunks))
     # One logical transfer = one xid (the receiver's assembly key) and
     # one contiguous block of per-flow seqs.  A retransmit round reuses
@@ -354,6 +484,8 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     uds_dir = client._uds_dir
     pending = list(range(len(chunks)))
     resent = 0  # chunk-sends beyond the first round (retransmits)
+    lanes = set()  # lanes that actually ran a round
+    shm_broken = False  # shm machinery failed once: stay on sockets
     with trace.span("dcn.pipeline", histogram="dcn.pipeline",
                     flow=flow, bytes=nbytes, chunks=len(chunks),
                     stripes=stripes, xid=xid) as span:
@@ -371,42 +503,71 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                 resent += len(pending)
                 # Heal before retrying: a resilient primary reconnects
                 # and replays the flow table here, so the fresh stripe
-                # connections below land on a daemon that knows `flow`.
+                # connections below land on a daemon that knows `flow`
+                # — and re-probes capabilities, which is how a daemon
+                # that restarted WITHOUT shm downgrades the remaining
+                # rounds to the socket lane.
                 client.ping()
-            data_port = client.data_port()
             result = _StripeResult()
-            workers = [threading.Thread(
-                target=_stage_worker,
-                args=("127.0.0.1", data_port, flow, data, chunks,
-                      list(pending), xid, nbytes, timeout_s, result,
-                      ctx),
-                name=f"dcn-stage-{flow}",
-                daemon=True,
-            )]
-            for s in range(stripes):
-                idxs = pending[s::stripes]
-                if not idxs:
-                    continue
-                workers.append(threading.Thread(
-                    target=_send_worker,
-                    args=(uds_dir, flow, chunks, seqs, idxs, xid,
-                          host, port, nbytes, timeout_s, result, ctx),
-                    name=f"dcn-stripe-{flow}-{s}",
+            # Zero-copy lane, decided per round: kill switch off, the
+            # machinery has not failed this transfer, and the daemon
+            # both offers shm and shares our boot identity.
+            ran_shm = False
+            if cfg.shm and not shm_broken and shm_same_host(client):
+                ran_shm = _shm_round(uds_dir, flow, data, chunks,
+                                     seqs, list(pending), xid, host,
+                                     port, timeout_s, result, ctx,
+                                     already_staged="shm" in lanes)
+                if ran_shm:
+                    if "shm" not in lanes:
+                        counters.inc("dcn.shm.transfers")
+                    lanes.add("shm")
+                else:
+                    shm_broken = True
+                    counters.inc("dcn.shm.fallback")
+                    log.warning(
+                        "shm staging of %r unavailable (%s); falling "
+                        "back to the socket lane", flow,
+                        result.errors[-1] if result.errors else "?",
+                    )
+            if not ran_shm:
+                lanes.add("socket")
+                data_port = client.data_port()
+                workers = [threading.Thread(
+                    target=_stage_worker,
+                    args=("127.0.0.1", data_port, flow, data, chunks,
+                          list(pending), xid, nbytes, timeout_s,
+                          result, ctx),
+                    name=f"dcn-stage-{flow}",
                     daemon=True,
-                ))
-            for t in workers:
-                t.start()
-            for t in workers:
-                t.join(timeout=max(0.0, deadline - time.monotonic()))
-            if any(t.is_alive() for t in workers):
-                # Budget spent with workers still wedged (daemon hung
-                # mid-op): surface now; the daemon-thread workers die
-                # with their sockets and later frames dedup away.
-                raise DcnXferError(
-                    f"pipelined send of {flow!r} exceeded its "
-                    f"{timeout_s:.1f}s budget with stripe workers "
-                    "still blocked"
-                )
+                )]
+                for s in range(stripes):
+                    idxs = pending[s::stripes]
+                    if not idxs:
+                        continue
+                    workers.append(threading.Thread(
+                        target=_send_worker,
+                        args=(uds_dir, flow, chunks, seqs, idxs, xid,
+                              host, port, nbytes, timeout_s, result,
+                              ctx),
+                        name=f"dcn-stripe-{flow}-{s}",
+                        daemon=True,
+                    ))
+                for t in workers:
+                    t.start()
+                for t in workers:
+                    t.join(timeout=max(0.0,
+                                       deadline - time.monotonic()))
+                if any(t.is_alive() for t in workers):
+                    # Budget spent with workers still wedged (daemon
+                    # hung mid-op): surface now; the daemon-thread
+                    # workers die with their sockets and later frames
+                    # dedup away.
+                    raise DcnXferError(
+                        f"pipelined send of {flow!r} exceeded its "
+                        f"{timeout_s:.1f}s budget with stripe workers "
+                        "still blocked"
+                    )
             # A chunk is settled ONLY on a verdict that means the peer
             # has (or had) the bytes: "sent" (standalone TCP, no
             # fabric verdict), "landed", or "dup".  Everything else —
@@ -417,12 +578,14 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                        if result.verdicts.get(i)
                        not in ("sent", "landed", "dup")]
             last_errors = result.errors
-            span.annotate(round=rnd, pending=len(pending))
+            span.annotate(round=rnd, pending=len(pending),
+                          lane="+".join(sorted(lanes)))
             timeseries.gauge("dcn.pipeline.retransmit_ratio",
                              resent / len(chunks))
             if not pending:
                 return {"bytes": nbytes, "chunks": len(chunks),
-                        "stripes": stripes, "rounds": rnd + 1}
+                        "stripes": stripes, "rounds": rnd + 1,
+                        "lane": "+".join(sorted(lanes))}
         raise DcnXferError(
             f"pipelined send of {flow!r} left {len(pending)}/"
             f"{len(chunks)} chunk(s) unconfirmed after "
@@ -445,7 +608,13 @@ def read_pipelined(client, flow: str, nbytes: int,
     bench rig.  Chunk-sized requests keep the daemon's per-request
     copy bounded, so read-back still pipelines with the daemon's other
     work.  Falls back to the base64 control read for daemons without
-    the wait op."""
+    the wait op.
+
+    A same-host daemon offering ``shm`` skips DXR1 entirely: one
+    ``shm_read`` control op and the frame is read out of the client's
+    own mapping of the flow's segment — a buffer reference, not a
+    socket stream (``dcn.shm.reads``; any shm trouble falls back to
+    DXR1 under ``dcn.shm.fallback``)."""
     if nbytes <= 0:
         return b""
     cfg = cfg or PipelineConfig()
@@ -464,6 +633,13 @@ def read_pipelined(client, flow: str, nbytes: int,
                 f"short read of {flow!r}: {len(got)} != {nbytes}"
             )
         return got
+    if cfg.shm and shm_same_host(client):
+        try:
+            return _read_shm(client, flow, nbytes)
+        except (DcnXferError, OSError) as e:
+            counters.inc("dcn.shm.fallback")
+            log.warning("shm read of %r failed (%s); falling back to "
+                        "DXR1", flow, e)
     data_port = client.data_port()
     out = bytearray(nbytes)
     with trace.span("dcn.chunk.read", histogram="dcn.chunk.read",
@@ -488,3 +664,29 @@ def read_pipelined(client, flow: str, nbytes: int,
             sock.close()
     timeseries.record("dcn.pipeline.rx.bytes", nbytes)
     return bytes(out)
+
+
+def _read_shm(client, flow: str, nbytes: int) -> bytes:
+    """The zero-copy read-back: ask the daemon to surface the
+    completed frame in the flow's segment, map it, copy the payload
+    out of shared pages.  Raises on any shortfall — the caller owns
+    the DXR1 fallback."""
+    with trace.span("dcn.shm.read", histogram="dcn.shm.read",
+                    flow=flow, bytes=nbytes):
+        resp = client.shm_read(flow, nbytes)
+        frame = int(resp.get("frame_bytes") or 0)
+        if frame < nbytes:
+            raise DcnXferError(
+                f"short shm read of {flow!r}: {frame} != {nbytes}"
+            )
+        seg = dcn_shm.map_segment(resp.get("path", ""),
+                                  int(resp.get("bytes") or 0))
+        try:
+            if seg.size < nbytes:
+                raise OSError("segment smaller than frame")
+            out = bytes(seg.view[:nbytes])
+        finally:
+            seg.close()
+    counters.inc("dcn.shm.reads")
+    timeseries.record("dcn.shm.rx.bytes", nbytes)
+    return out

@@ -1,0 +1,106 @@
+"""Client half of the zero-copy same-host staging lane.
+
+Every local stage/send/read used to cross a TCP socket even when the
+client and its daemon share a host — the analog of the CPU-proxy hop
+GPU-Initiated Networking removes from the transfer path (PAPERS.md).
+The shm lane removes ours: a daemon that advertises ``shm`` in its
+``version`` handshake owns per-flow ``mmap``-backed segments under
+``shm_dir``; a same-host client writes chunk ``memoryview``s straight
+into the segment and reads landed frames back out of it, so the two
+client↔daemon payload passes become memcpys while the daemon→peer
+leg (the actual network) and EVERY control op — seq assignment,
+dedup, ``wait``, fabric verdicts — stay exactly where they were.
+Exactly-once semantics are therefore unchanged: the shm lane moves
+bytes, never authority.
+
+Same-host detection compares **boot identity**, not addresses: two
+containers can share ``127.0.0.1`` across a netns boundary without
+sharing a filesystem, and a daemon behind a forwarded UDS may be on
+another machine entirely.  ``host_identity()`` is the kernel boot id
+plus hostname (override: ``TPU_DCN_HOST_ID``, which is also how tests
+fake a cross-host daemon); the daemon stamps its own into the
+handshake and the client only takes the lane on an exact match — and
+even then, a segment that fails to map falls back to the socket lane
+(``dcn.shm.fallback``) rather than failing the transfer.
+
+This module owns host identity and the client-side segment mapping;
+lane *selection* and the transfer logic live in
+``parallel/dcn_pipeline.py``, the daemon half in ``fleet/xferd.py``.
+"""
+
+import mmap
+import os
+import socket
+from typing import Optional
+
+HOST_ID_ENV = "TPU_DCN_HOST_ID"
+SHM_ENV = "TPU_DCN_SHM"
+
+_BOOT_ID_PATH = "/proc/sys/kernel/random/boot_id"
+_host_id_cache: Optional[str] = None
+
+
+def host_identity(env=None) -> str:
+    """This process's host identity: ``<boot_id>:<hostname>``, with
+    ``TPU_DCN_HOST_ID`` as the explicit override (tests, and operators
+    whose mounts make the default ambiguous)."""
+    env = env if env is not None else os.environ
+    override = env.get(HOST_ID_ENV)
+    if override:
+        return override
+    global _host_id_cache
+    if _host_id_cache is None:
+        try:
+            with open(_BOOT_ID_PATH) as f:
+                boot = f.read().strip()
+        except OSError:
+            boot = "no-boot-id"
+        _host_id_cache = f"{boot}:{socket.gethostname()}"
+    return _host_id_cache
+
+
+def shm_enabled(env=None) -> bool:
+    """The env kill switch, same grammar as ``TPU_DCN_PIPELINE``."""
+    env = env if env is not None else os.environ
+    return env.get(SHM_ENV, "1") not in ("0", "false", "off")
+
+
+class Segment:
+    """One client-side mapping of a daemon-owned segment file.  The
+    daemon owns creation, sizing, and unlinking; the client only maps
+    what the ``shm_attach`` / ``shm_read`` response named — a path it
+    cannot open or map is a lane fallback, never an error surface."""
+
+    def __init__(self, path: str, size: int):
+        self.path = path
+        self.size = int(size)
+        f = open(path, "r+b")
+        try:
+            self.map = mmap.mmap(f.fileno(), self.size)
+        except ValueError as e:
+            # mmap says ValueError when the file is smaller than the
+            # advertised size (a crash-restarted daemon recreated the
+            # segment at minimum size); normalize to the documented
+            # OSError so the lane-fallback handlers catch it.
+            raise OSError(f"segment {path!r} unmappable: {e}") from e
+        finally:
+            f.close()
+        self.view = memoryview(self.map)
+
+    def close(self) -> None:
+        try:
+            self.view.release()
+        except (BufferError, AttributeError):
+            pass
+        try:
+            self.map.close()
+        except (BufferError, ValueError):
+            pass  # an exported slice keeps the map alive until GC
+
+
+def map_segment(path: str, size: int) -> Segment:
+    """Map a daemon-advertised segment; raises ``OSError`` (the
+    caller's fallback signal) when the path is gone or undersized."""
+    if size <= 0:
+        raise OSError(f"segment {path!r} has no size")
+    return Segment(path, size)

@@ -1,0 +1,65 @@
+"""Short-write/short-read hardened socket IO.
+
+Every raw data-plane send and receive in the stack routes through
+these helpers instead of bare ``socket.sendall`` / ``recv``:
+
+- the bench rig's loopback stack truncates very large single-syscall
+  payloads (an ``sendmsg`` quirk several container runtimes share), so
+  sends are capped at :data:`SENDALL_CAP` per syscall and explicitly
+  loop on the kernel's own short-write accounting — ``sendall``
+  semantics that hold even where the platform's ``sendall`` does not;
+- receives always loop ``recv_into`` against an exact byte budget: a
+  frame is either fully read or the connection is reported dead,
+  never a silently-short buffer.
+
+The wire *formats* stay where they live (fleet/xferd.py and its
+deliberate client-side duplicates in parallel/dcn_pipeline.py); this
+module owns only the byte movement.
+"""
+
+import socket
+from typing import Iterable
+
+# Per-syscall send cap.  1 MiB is far above the point where another
+# syscall costs anything measurable, and far below every truncation
+# threshold observed in the wild.
+SENDALL_CAP = 1 << 20
+
+
+def sendall(sock: socket.socket, data, cap: int = SENDALL_CAP) -> None:
+    """``sock.sendall(data)`` with an explicit short-write loop and a
+    per-syscall size cap.  Accepts bytes/bytearray/memoryview."""
+    view = memoryview(data)
+    off = 0
+    n = len(view)
+    while off < n:
+        sent = sock.send(view[off:off + min(cap, n - off)])
+        if sent <= 0:
+            raise ConnectionError("socket closed mid-send")
+        off += sent
+
+
+def sendall_parts(sock: socket.socket, parts: Iterable) -> None:
+    """Send each buffer in ``parts`` back to back (header + name +
+    payload as separate buffers — no concat copy of the payload)."""
+    for part in parts:
+        sendall(sock, part)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes or raise ``ConnectionError``."""
+    buf = bytearray(n)
+    recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Fill ``view`` completely from the socket or raise
+    ``ConnectionError`` — never a silent short read."""
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
+            raise ConnectionError("connection closed mid-read")
+        got += r

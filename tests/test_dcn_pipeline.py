@@ -414,7 +414,9 @@ class TestExchangePipelined:
 class TestBenchHarness:
     def test_bench_emits_well_formed_jsonl(self, tmp_path):
         """The make dcnbench smoke gate's contract: one JSON record
-        per (mode, size), flat keys, parses line by line."""
+        per (mode, size) — serial, the socket pipelined lane, the shm
+        lane, and the memcpy reference series — flat keys, parses
+        line by line."""
         import importlib.util
         import os
 
@@ -431,11 +433,76 @@ class TestBenchHarness:
                        "--out", str(out)])
         assert rc == 0
         lines = out.read_text().strip().splitlines()
-        assert len(lines) == 4  # 2 sizes x 2 modes
+        assert len(lines) == 8  # 2 sizes x 4 modes
+        modes = set()
         for line in lines:
             rec = json.loads(line)
             assert rec["bench"] == "dcn_xfer"
-            assert rec["mode"] in ("serial", "pipelined")
+            assert rec["mode"] in mod.MODES
+            modes.add(rec["mode"])
             assert rec["bytes"] in (4096, 16384)
             assert rec["mbps"] > 0 and rec["best_s"] > 0
             assert rec["chunk_bytes"] == 4096
+        # The memcpy reference rides the SAME JSONL as the lanes — the
+        # "how far from memcpy speed" gap is always on record.
+        assert modes == set(mod.MODES)
+
+
+class TestLargeFrameShortWriteGuard:
+    """Satellite: the rig's stack truncates very large single-syscall
+    payloads, so every raw data-plane send loops under a per-syscall
+    cap (utils/netio.py).  A multi-MiB frame must round-trip
+    byte-exact on every lane."""
+
+    MB6 = 6 << 20
+
+    def test_netio_sendall_survives_tiny_caps(self):
+        """The cap loop itself: a 3 MiB buffer pushed 8 KiB per
+        syscall arrives byte-exact."""
+        import socket as _socket
+        import threading
+
+        from container_engine_accelerators_tpu.utils import netio
+
+        a, b = _socket.socketpair()
+        payload = bytes(range(256)) * (3 << 12)  # 3 MiB
+        out = bytearray(len(payload))
+
+        def rx():
+            netio.recv_exact_into(b, memoryview(out))
+
+        t = threading.Thread(target=rx)
+        t.start()
+        netio.sendall(a, payload, cap=8192)
+        t.join(timeout=30)
+        assert not t.is_alive() and bytes(out) == payload
+        a.close()
+        b.close()
+
+    def test_multi_mib_frame_roundtrips_serial(self, pair):
+        _a, b, ca, cb = pair
+        payload = bytes(range(256)) * (self.MB6 // 256)
+        flow = _flow("big")
+        cb.register_flow(flow, bytes=len(payload))
+        ca.register_flow(flow, bytes=len(payload))
+        ca.put(flow, payload)
+        dcn.wait_flow_rx(ca, flow, len(payload), timeout_s=30)
+        ca.send(flow, "127.0.0.1", b.data_port, len(payload))
+        dcn.wait_flow_rx(cb, flow, len(payload), timeout_s=30)
+        assert cb.read(flow, len(payload)) == payload
+
+    def test_multi_mib_frame_roundtrips_pipelined_socket(self, pair):
+        _a, b, ca, cb = pair
+        payload = bytes(range(256)) * (self.MB6 // 256)
+        cfg = dcn_pipeline.PipelineConfig(chunk_bytes=1 << 20,
+                                          stripes=2, shm=False)
+        flow = _flow("bigp")
+        cb.register_flow(flow, bytes=len(payload))
+        ca.register_flow(flow, bytes=len(payload))
+        res = dcn_pipeline.send_pipelined(
+            ca, flow, payload, "127.0.0.1", b.data_port, cfg,
+            timeout_s=60)
+        assert res["lane"] == "socket"
+        assert dcn_pipeline.read_pipelined(cb, flow, len(payload),
+                                           cfg, timeout_s=60) \
+            == payload

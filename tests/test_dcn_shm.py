@@ -1,0 +1,334 @@
+"""Zero-copy same-host staging lane (ISSUE 6).
+
+Coverage map:
+
+- capability handshake: the shm triple (``shm``/``shm_dir``/
+  ``host_id``) is advertised only by shm-enabled daemons, and the
+  client's capability cache is PER CONNECTION — a daemon restart is
+  re-probed, never trusted stale;
+- lane selection: same-host → shm; cross-host identity, shm-disabled
+  daemon, or the ``TPU_DCN_SHM`` kill switch → socket, transparently
+  (``dcn.shm.fallback`` only when the lane was wanted but unusable);
+- segment lifecycle: release/restart unlink segments; frames that
+  landed over sockets migrate into the segment on ``shm_read``;
+- downgrade: a daemon that loses the capability mid-transfer drops
+  the remaining rounds to the socket lane under the SAME chunk seqs.
+
+The chaos half (kill/loss exactly-once with one leg on shm) lives in
+tests/test_fleet.py next to the other chunk-chaos scenarios.
+"""
+
+import os
+import uuid
+
+import pytest
+
+from container_engine_accelerators_tpu.fleet.xferd import PyXferd
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.parallel import (
+    dcn_pipeline,
+    dcn_shm,
+)
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    DcnXferClient,
+    ResilientDcnXferClient,
+)
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+from tests.xferd_stub import XferdStub
+
+FAST_RETRY = RetryPolicy(
+    max_attempts=6, initial_backoff_s=0.01, max_backoff_s=0.1,
+    deadline_s=10.0,
+)
+
+CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                  shm=True)
+CFG_SOCKET = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                         shm=False)
+PAYLOAD = bytes(range(256)) * 64  # 16 KiB == 4 chunks under CFG
+N = len(PAYLOAD)
+
+
+@pytest.fixture
+def pair(tmp_path):
+    a = PyXferd(str(tmp_path / "a"), node="sa").start()
+    b = PyXferd(str(tmp_path / "b"), node="sb").start()
+    ca = ResilientDcnXferClient(str(tmp_path / "a"), retry=FAST_RETRY)
+    cb = ResilientDcnXferClient(str(tmp_path / "b"), retry=FAST_RETRY)
+    yield a, b, ca, cb
+    for c in (ca, cb):
+        try:
+            c.close()
+        except OSError:
+            pass
+    a.stop()
+    b.stop()
+
+
+def _flow(prefix="sf"):
+    return f"{prefix}-{uuid.uuid4().hex[:8]}"
+
+
+def _roundtrip(ca, cb, b, cfg, payload=PAYLOAD, flow=None):
+    flow = flow or _flow()
+    cb.register_flow(flow, bytes=len(payload))
+    ca.register_flow(flow, bytes=len(payload))
+    res = dcn_pipeline.send_pipelined(
+        ca, flow, payload, "127.0.0.1", b.data_port, cfg, timeout_s=10)
+    got = dcn_pipeline.read_pipelined(cb, flow, len(payload), cfg,
+                                      timeout_s=10)
+    assert got == payload
+    return res
+
+
+class TestHostIdentity:
+    def test_env_override_wins(self):
+        assert dcn_shm.host_identity(
+            env={dcn_shm.HOST_ID_ENV: "h:override"}) == "h:override"
+
+    def test_identity_is_stable_and_nonempty(self):
+        first = dcn_shm.host_identity(env={})
+        assert first and first == dcn_shm.host_identity(env={})
+
+
+class TestCapabilityHandshake:
+    def test_daemon_advertises_the_shm_triple(self, pair):
+        a, _b, ca, _cb = pair
+        caps = ca.capabilities()
+        assert caps["shm"] == 1
+        assert caps["shm_dir"] == a.shm_dir
+        assert caps["host_id"] == dcn_shm.host_identity()
+        assert ca.supports_shm()
+        assert dcn_pipeline.shm_same_host(ca)
+
+    def test_shm_disabled_daemon_hides_the_capability(self, tmp_path):
+        d = PyXferd(str(tmp_path / "d"), node="nd", shm=False).start()
+        try:
+            c = DcnXferClient(str(tmp_path / "d"))
+            assert not c.supports_shm()
+            assert not dcn_pipeline.shm_same_host(c)
+            # The shm ops refuse loudly rather than half-working.
+            c.register_flow("f", bytes=64)
+            from container_engine_accelerators_tpu.parallel.dcn_client \
+                import DcnXferError
+
+            with pytest.raises(DcnXferError, match="disabled"):
+                c.shm_attach("f", 64)
+            c.close()
+        finally:
+            d.stop()
+
+    def test_stub_daemon_has_no_shm(self, tmp_path):
+        stub = XferdStub(str(tmp_path / "tpu-dcn")).start()
+        try:
+            c = DcnXferClient(stub.uds_dir)
+            assert not c.supports_shm()
+            assert not dcn_pipeline.shm_same_host(c)
+            c.close()
+        finally:
+            stub.stop()
+
+    def test_caps_cache_invalidated_on_reconnect(self, tmp_path):
+        """Satellite: capabilities are per-connection.  A daemon that
+        restarts WITHOUT shm must be re-probed after the resilient
+        client reconnects — a stale handshake would send the client
+        into shm ops the new daemon rejects."""
+        a = PyXferd(str(tmp_path / "a"), node="ra").start()
+        ca = ResilientDcnXferClient(str(tmp_path / "a"),
+                                    retry=FAST_RETRY)
+        try:
+            assert ca.supports_shm()
+            assert ca._wait_supported is None  # not probed yet
+            a.stop()
+            a.shm_enabled = False
+            a.start()
+            ca.ping()  # reconnect + flow replay; caches dropped
+            assert not ca.supports_shm()
+            assert not dcn_pipeline.shm_same_host(ca)
+        finally:
+            ca.close()
+            a.stop()
+
+
+class TestLaneSelection:
+    def test_same_host_takes_the_shm_lane(self, pair):
+        _a, b, ca, cb = pair
+        t0 = counters.get("dcn.shm.transfers")
+        r0 = counters.get("dcn.shm.reads")
+        f0 = counters.get("dcn.shm.fallback")
+        res = _roundtrip(ca, cb, b, CFG)
+        assert res["lane"] == "shm"
+        assert res["chunks"] == 4 and res["rounds"] == 1
+        assert counters.get("dcn.shm.transfers") == t0 + 1
+        assert counters.get("dcn.shm.reads") == r0 + 1
+        assert counters.get("dcn.shm.fallback") == f0
+
+    def test_kill_switch_pins_the_socket_lane(self, pair):
+        """shm=False is an explicit opt-out: socket lane, and NO
+        fallback counter — nothing fell back, the operator chose."""
+        _a, b, ca, cb = pair
+        f0 = counters.get("dcn.shm.fallback")
+        res = _roundtrip(ca, cb, b, CFG_SOCKET)
+        assert res["lane"] == "socket"
+        assert counters.get("dcn.shm.fallback") == f0
+
+    def test_cross_host_identity_stays_on_sockets(self, tmp_path):
+        """A daemon advertising a DIFFERENT boot identity (what a
+        forwarded UDS to another machine looks like) must never be
+        shm-attached, however same its address looks."""
+        a = PyXferd(str(tmp_path / "a"), node="xa",
+                    host_id="other-boot:other-host").start()
+        b = PyXferd(str(tmp_path / "b"), node="xb").start()
+        ca = ResilientDcnXferClient(str(tmp_path / "a"),
+                                    retry=FAST_RETRY)
+        cb = ResilientDcnXferClient(str(tmp_path / "b"),
+                                    retry=FAST_RETRY)
+        try:
+            assert ca.supports_shm()  # offered...
+            assert not dcn_pipeline.shm_same_host(ca)  # ...not taken
+            res = _roundtrip(ca, cb, b, CFG)
+            assert res["lane"] == "socket"
+        finally:
+            ca.close()
+            cb.close()
+            a.stop()
+            b.stop()
+
+    def test_capability_less_daemon_falls_back_with_counter(
+            self, tmp_path):
+        """The daemon speaks the pipeline but not shm (the future
+        native DXF2 port): the lane is wanted (cfg.shm) but not
+        offered — socket lane, silently, no fallback inflation (the
+        fallback counter is for a lane that BROKE, not one that was
+        never there)."""
+        a = PyXferd(str(tmp_path / "a"), node="ca", shm=False).start()
+        b = PyXferd(str(tmp_path / "b"), node="cb2").start()
+        ca = ResilientDcnXferClient(str(tmp_path / "a"),
+                                    retry=FAST_RETRY)
+        cb = ResilientDcnXferClient(str(tmp_path / "b"),
+                                    retry=FAST_RETRY)
+        try:
+            res = _roundtrip(ca, cb, b, CFG)
+            assert res["lane"] == "socket"
+        finally:
+            ca.close()
+            cb.close()
+            a.stop()
+            b.stop()
+
+
+class TestSegmentLifecycle:
+    def test_stats_reports_shm_backed_flows(self, pair):
+        _a, b, ca, cb = pair
+        flow = _flow()
+        cb.register_flow(flow, bytes=N)
+        ca.register_flow(flow, bytes=N)
+        assert not ca.stats(flow=flow)["flows"][0]["shm"]
+        ca.shm_attach(flow, N)
+        assert ca.stats(flow=flow)["flows"][0]["shm"]
+
+    def test_release_unlinks_the_segment_file(self, pair):
+        a, _b, ca, _cb = pair
+        flow = _flow()
+        ca.register_flow(flow, bytes=N)
+        path = ca.shm_attach(flow, N)["path"]
+        assert os.path.exists(path)
+        ca.release_flow(flow)
+        assert not os.path.exists(path)
+
+    def test_crash_leaves_files_and_restart_wipes_them(self, pair):
+        a, _b, ca, _cb = pair
+        flow = _flow()
+        ca.register_flow(flow, bytes=N)
+        path = ca.shm_attach(flow, N)["path"]
+        a.stop(crash=True)
+        assert os.path.exists(path)  # SIGKILL cannot clean up
+        a.start()
+        assert not os.path.exists(path)  # ...so the next boot does
+
+    def test_shm_read_migrates_socket_landed_frames(self, pair):
+        """A frame that landed the classic way (socket staging, no
+        segment) becomes shm-readable on demand: shm_read migrates it
+        into a fresh segment with one copy."""
+        from container_engine_accelerators_tpu.parallel import dcn
+
+        _a, _b, ca, _cb = pair
+        flow = _flow()
+        ca.register_flow(flow, bytes=N)
+        ca.put(flow, PAYLOAD)
+        dcn.wait_flow_rx(ca, flow, N, timeout_s=10)
+        resp = ca.shm_read(flow, N)
+        assert resp["frame_bytes"] == N
+        seg = dcn_shm.map_segment(resp["path"], resp["bytes"])
+        try:
+            assert bytes(seg.view[:N]) == PAYLOAD
+        finally:
+            seg.close()
+
+    def test_attach_grows_in_place_and_keeps_content(self, pair):
+        """Re-attaching with a larger size re-truncates the same
+        inode: staged content survives, existing mappings of the old
+        range stay valid."""
+        _a, _b, ca, _cb = pair
+        flow = _flow()
+        ca.register_flow(flow, bytes=N)
+        first = ca.shm_attach(flow, N)
+        seg = dcn_shm.map_segment(first["path"], first["bytes"])
+        seg.view[:N] = PAYLOAD
+        ca.shm_commit(flow, N)
+        bigger = ca.shm_attach(flow, 4 * N)
+        assert bigger["path"] == first["path"]
+        assert bigger["bytes"] >= 4 * N
+        seg2 = dcn_shm.map_segment(bigger["path"], bigger["bytes"])
+        try:
+            assert bytes(seg2.view[:N]) == PAYLOAD
+            assert ca.shm_read(flow, N)["frame_bytes"] == N
+        finally:
+            seg.close()
+            seg2.close()
+
+
+class TestDowngrade:
+    def test_lost_capability_downgrades_within_the_transfer(
+            self, pair):
+        """The daemon stops offering shm while the client's handshake
+        cache still says yes (the stale-cache window): the shm round's
+        attach is rejected, the SAME round completes on the socket
+        lane, and the fallback counter records the downgrade."""
+        a, b, ca, cb = pair
+        assert _roundtrip(ca, cb, b, CFG)["lane"] == "shm"
+        a.shm_enabled = False  # no restart: the client cache is stale
+        f0 = counters.get("dcn.shm.fallback")
+        res = _roundtrip(ca, cb, b, CFG, payload=PAYLOAD[::-1])
+        assert res["lane"] == "socket"
+        assert res["rounds"] == 1  # downgrade costs no extra round
+        assert counters.get("dcn.shm.fallback") == f0 + 1
+
+    def test_restart_without_shm_downgrades_next_transfers(self, pair):
+        """Mid-run daemon restart into a capability-less binary: the
+        reconnect re-probes the handshake, and later transfers ride
+        sockets with no fallback noise (the lane was re-negotiated,
+        not broken)."""
+        a, b, ca, cb = pair
+        flow = _flow()
+        cb.register_flow(flow, bytes=N)
+        ca.register_flow(flow, bytes=N)
+        res = dcn_pipeline.send_pipelined(
+            ca, flow, PAYLOAD, "127.0.0.1", b.data_port, CFG,
+            timeout_s=10)
+        assert res["lane"] == "shm"
+        assert dcn_pipeline.read_pipelined(cb, flow, N, CFG,
+                                           timeout_s=10) == PAYLOAD
+        a.stop(crash=True)
+        a.shm_enabled = False
+        a.start()
+        ca.ping()  # reconnect + flow replay + capability re-probe
+        f0 = counters.get("dcn.shm.fallback")
+        res = dcn_pipeline.send_pipelined(
+            ca, flow, PAYLOAD[::-1], "127.0.0.1", b.data_port, CFG,
+            timeout_s=10)
+        assert res["lane"] == "socket"
+        assert counters.get("dcn.shm.fallback") == f0
+        assert dcn_pipeline.read_pipelined(cb, flow, N, CFG,
+                                           timeout_s=10) \
+            == PAYLOAD[::-1]

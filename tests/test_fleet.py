@@ -345,17 +345,29 @@ class TestFrameDedup:
 
 
 # Small grid so the chaos scenarios exercise real multi-chunk
-# transfers in milliseconds: 16 KiB payload = 4 chunks.
+# transfers in milliseconds: 16 KiB payload = 4 chunks.  The chaos
+# bar holds on BOTH data lanes — the zero-copy same-host shm lane
+# (the default in the one-process rig) and the socket lane cross-host
+# deployments ride — so the chunk-chaos scenarios run once per lane.
 PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2)
 PIPE_PAYLOAD = bytes(range(256)) * 64  # 16 KiB
 PIPE_N = len(PIPE_PAYLOAD)
+
+LANE_CFGS = {
+    "shm": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                       shm=True),
+    "socket": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                          shm=False),
+}
 
 
 @pytest.mark.chaos
 class TestPipelinedChunkChaos:
     """ISSUE 4 chaos bar: exactly-once PER CHUNK.  After any replay or
     loss, the assembled payload is byte-exact — no duplicated chunk,
-    no zero-filled chunk."""
+    no zero-filled chunk.  Parametrized over the shm and socket lanes
+    (ISSUE 6 fault parity): the lane moves bytes, never authority, so
+    every verdict/dedup expectation is lane-invariant."""
 
     def _fleet_pair(self, tmp_path):
         topo = FleetTopology(build_specs(2, racks=2))
@@ -369,25 +381,28 @@ class TestPipelinedChunkChaos:
         cb = ResilientDcnXferClient(str(tmp_path / "b"), retry=FAST_RETRY)
         return net, table, a, b, ca, cb
 
+    @pytest.mark.parametrize("lane", sorted(LANE_CFGS))
     def test_kill_mid_send_lost_response_chunks_land_once(
-            self, xferd_pair):
+            self, xferd_pair, lane):
         """THE kill-mid-send shape, chunk edition: the sender's daemon
         streams a chunk but the op response dies with the control
         connection.  The retry round re-sends under the SAME seqs; the
         already-landed chunk dedups, the rest land — the assembled
         payload is byte-exact with no double-landed bytes."""
+        cfg = LANE_CFGS[lane]
         a, b, ca, cb = xferd_pair
         cb.register_flow("pk", bytes=PIPE_N)
         ca.register_flow("pk", bytes=PIPE_N)
         d0 = counters.get("dcn.frames.deduped")
         a.drop_response_once("send")
         res = dcn_pipeline.send_pipelined(
-            ca, "pk", PIPE_PAYLOAD, "127.0.0.1", b.data_port, PIPE_CFG,
+            ca, "pk", PIPE_PAYLOAD, "127.0.0.1", b.data_port, cfg,
             timeout_s=10)
         assert res["rounds"] >= 2  # the lost response forced a retry
+        assert res["lane"] == lane
         _wait_stable_rx(cb, "pk", PIPE_N)  # exactly PIPE_N — not PIPE_N + a chunk
         assert counters.get("dcn.frames.deduped") == d0 + 1
-        assert dcn_pipeline.read_pipelined(cb, "pk", PIPE_N, PIPE_CFG) \
+        assert dcn_pipeline.read_pipelined(cb, "pk", PIPE_N, cfg) \
             == PIPE_PAYLOAD
 
     def test_receiver_kill9_mid_pipelined_transfer(self, tmp_path):
@@ -422,12 +437,15 @@ class TestPipelinedChunkChaos:
             a.stop()
             b.stop()
 
-    def test_link_loss_retransmits_only_lost_chunks(self, tmp_path):
+    @pytest.mark.parametrize("lane", sorted(LANE_CFGS))
+    def test_link_loss_retransmits_only_lost_chunks(self, tmp_path,
+                                                    lane):
         """Loss ≠ replay, chunk edition: the link eats two chunk
         frames in flight; the sender's fabric verdicts say 'dropped',
         the retry round re-sends exactly those chunks under their
         original seqs, and they LAND (never-landed seqs pass the
         window) — zero dups, byte-exact assembly."""
+        cfg = LANE_CFGS[lane]
         net, table, a, b, ca, cb = self._fleet_pair(tmp_path)
         try:
             cb.register_flow("lk", bytes=PIPE_N)
@@ -436,15 +454,53 @@ class TestPipelinedChunkChaos:
             table.apply("node:n0->node:n1:drop:2")
             res = dcn_pipeline.send_pipelined(
                 ca, "lk", PIPE_PAYLOAD, "127.0.0.1", b.data_port,
-                PIPE_CFG, timeout_s=10)
+                cfg, timeout_s=10)
             assert res["rounds"] == 2
+            assert res["lane"] == lane
             _wait_stable_rx(cb, "lk", PIPE_N)
             link = table.report()["n0->n1"]
             assert link["drops"] == 2
             assert link["dups"] == 0  # lost chunks were never replays
             assert counters.get("dcn.frames.deduped") == d0
-            assert dcn_pipeline.read_pipelined(cb, "lk", PIPE_N, PIPE_CFG) \
+            assert dcn_pipeline.read_pipelined(cb, "lk", PIPE_N, cfg) \
                 == PIPE_PAYLOAD
+        finally:
+            ca.close()
+            cb.close()
+            a.stop()
+            b.stop()
+
+    def test_shm_lane_node_kill_downgrade_exactly_once(self, tmp_path):
+        """The satellite's mid-run restart shape: a transfer completes
+        on the shm lane, the sending daemon is SIGKILLed and comes
+        back WITHOUT the capability, and the next transfer on the SAME
+        flow rides the socket lane — byte-exact, no dups, the seq
+        numbering continuous across the lane switch."""
+        net, _table, a, b, ca, cb = self._fleet_pair(tmp_path)
+        try:
+            cb.register_flow("dg", bytes=PIPE_N)
+            ca.register_flow("dg", bytes=PIPE_N)
+            res = dcn_pipeline.send_pipelined(
+                ca, "dg", PIPE_PAYLOAD, "127.0.0.1", b.data_port,
+                LANE_CFGS["shm"], timeout_s=10)
+            assert res["lane"] == "shm"
+            assert dcn_pipeline.read_pipelined(
+                cb, "dg", PIPE_N, LANE_CFGS["shm"]) == PIPE_PAYLOAD
+            a.stop(crash=True)
+            a.shm_enabled = False  # restarts as a capability-less build
+            a.start()
+            net.register("n0", a)
+            ca.ping()  # reconnect + flow replay + capability re-probe
+            d0 = counters.get("dcn.frames.deduped")
+            res = dcn_pipeline.send_pipelined(
+                ca, "dg", PIPE_PAYLOAD[::-1], "127.0.0.1", b.data_port,
+                LANE_CFGS["shm"], timeout_s=10)
+            assert res["lane"] == "socket"
+            _wait_stable_rx(cb, "dg", 2 * PIPE_N)
+            assert counters.get("dcn.frames.deduped") == d0
+            assert dcn_pipeline.read_pipelined(
+                cb, "dg", PIPE_N, LANE_CFGS["shm"]) \
+                == PIPE_PAYLOAD[::-1]
         finally:
             ca.close()
             cb.close()
@@ -454,7 +510,10 @@ class TestPipelinedChunkChaos:
     def test_pipelined_fleet_scenario_converges_under_partition(self):
         """The fleet rig's ring workload over the pipelined path:
         partition mid-run, heal, re-converge — the `make fleet`
-        acceptance leg in miniature."""
+        acceptance leg in miniature.  One-process fleet nodes are
+        same-host, so these legs ride the shm lane; the scenario's
+        `shm: false` knob pins the socket lane for the parity run
+        below."""
         report = run_scenario({
             "name": "pipelined-partition",
             "nodes": 3,
@@ -475,6 +534,30 @@ class TestPipelinedChunkChaos:
         assert all(leg["ok"] for leg in report["rounds"][-1]["legs"])
         assert report["agent_events_delta"].get(
             "dcn.pipeline.transfers", 0) > 0
+        assert report["agent_events_delta"].get(
+            "dcn.shm.transfers", 0) > 0
+
+    def test_socket_lane_scenario_knob_pins_the_lane(self):
+        """`shm: false` in a scenario spec keeps every leg on the
+        socket lane — the fault-parity run `make fleet` drives via
+        --no-shm."""
+        report = run_scenario({
+            "name": "pipelined-socket-parity",
+            "nodes": 2,
+            "racks": 2,
+            "rounds": 2,
+            "payload_bytes": 16384,
+            "pipelined": True,
+            "chunk_bytes": 8192,
+            "stripes": 2,
+            "shm": False,
+            "faults": [],
+        })
+        assert report["converged"]
+        assert report["agent_events_delta"].get(
+            "dcn.pipeline.transfers", 0) > 0
+        assert report["agent_events_delta"].get(
+            "dcn.shm.transfers", 0) == 0
 
 
 @pytest.mark.chaos

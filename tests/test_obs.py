@@ -737,3 +737,38 @@ def test_readme_documents_every_histogram_op():
         f"{sorted(undocumented)} — every histogram= / histo.observe op "
         f"must be documented"
     )
+
+
+def test_readme_documents_the_shm_lane_families():
+    """The zero-copy same-host lane's whole metric surface, pinned by
+    name: the counters ride the generic counter lint above, but the
+    `dcn.shm.*` time series and gauges are recorded via
+    `timeseries.record`/`gauge_add`, which the generic lints don't
+    scan — so this test walks those call sites too and holds every
+    family to the same document-or-fail bar."""
+    counter_names = _counter_names()
+    assert {"dcn.shm.transfers", "dcn.shm.reads", "dcn.shm.commits",
+            "dcn.shm.fallback"} <= counter_names, (
+        "the shm lane's counter family went missing from the sources"
+    )
+    assert {"dcn.shm.stage", "dcn.shm.read"} <= _histogram_ops(), (
+        "the shm lane's histogram ops went missing from the sources"
+    )
+    pat = re.compile(
+        r"timeseries\.(?:record|gauge|gauge_add)\(\s*\n?\s*f?\""
+        r"(dcn\.shm\.[^\"]+)\"")
+    series = set()
+    for path in _package_sources():
+        with open(path) as fh:
+            series |= {m.group(1) for m in pat.finditer(fh.read())}
+    assert {"dcn.shm.tx.bytes", "dcn.shm.rx.bytes",
+            "dcn.shm.segments"} <= series, (
+        "the shm lane's series/gauge family went missing from the "
+        "sources"
+    )
+    readme = open(os.path.join(REPO, "README.md")).read()
+    undocumented = {n for n in series if f"`{n}`" not in readme}
+    assert not undocumented, (
+        f"dcn.shm.* series/gauges missing from the README metrics "
+        f"tables: {sorted(undocumented)}"
+    )

@@ -247,7 +247,7 @@ class TestSloSpec:
 
         out = dcn_pipeline.send_pipelined(None, "f", b"", "127.0.0.1", 1)
         assert out == {"bytes": 0, "chunks": 0, "stripes": 0,
-                       "rounds": 0}
+                       "rounds": 0, "lane": "none"}
 
 
 class _FakeLinks:
