@@ -102,6 +102,19 @@ fleet:
 	    --slo min_goodput_bps=64 --slo p99_leg_ms=60000 \
 	    --slo max_dedup_ratio=1.0 > /dev/null
 
+# Process-mode fleet gate: every node its own OS process — the full
+# multi-process matrix (SIGKILL mid-transfer exactly-once on both
+# lanes, shm crash cleanup + socket downgrade, supervised-restart
+# budget exhaustion, flight-on-SIGTERM, scrape staleness), then one
+# CLI run of the built-in SIGKILL scenario: a node killed with real
+# SIGKILL mid-scenario, respawned by the supervisor, the report's
+# goodput/SLO sections aggregated by HTTP scrape of each worker's
+# MetricServer (exit 0 iff converged and SLOs held, like `make fleet`).
+.PHONY: fleet-proc
+fleet-proc:
+	$(PY) -m pytest tests/test_fleet_proc.py -q -p no:randomly
+	$(PY) cmd/fleet_sim.py --proc > /dev/null
+
 # DCN data-plane gate: the serial / pipelined-socket / shm microbench
 # on the loopback rig, with a memcpy reference series in the same
 # JSONL.  --compare exits non-zero if the pipelined lane falls below

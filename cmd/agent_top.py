@@ -35,7 +35,6 @@ fails) — the CI-able acceptance surface.
 
 import argparse
 import os
-import re
 import sys
 import time
 import urllib.error
@@ -43,9 +42,7 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_SAMPLE_RE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)$")
-_LABEL_RE = re.compile(r'(\w+)="((?:\\.|[^"\\])*)"')
+from container_engine_accelerators_tpu.obs import promtext  # noqa: E402
 
 FAMILIES = ("agent_rate", "agent_goodput", "agent_gauge",
             "agent_latency", "agent_exemplar")
@@ -80,25 +77,11 @@ def scrape(url: str, timeout_s: float = 10.0) -> str:
 
 def parse_families(text: str) -> dict:
     """Prometheus text exposition -> {family: [(labels, value)]} for
-    the agent families (everything else is skipped)."""
-    out = {name: [] for name in FAMILIES}
-    for line in text.splitlines():
-        if not line or line.startswith("#"):
-            continue
-        m = _SAMPLE_RE.match(line)
-        if m is None:
-            continue
-        name, raw_labels, raw_value = m.groups()
-        if name not in out:
-            continue
-        try:
-            value = float(raw_value)
-        except ValueError:
-            continue
-        labels = {k: v.replace('\\"', '"')
-                  for k, v in _LABEL_RE.findall(raw_labels or "")}
-        out[name].append((labels, value))
-    return out
+    the agent families (everything else is skipped).  Parsing itself
+    is the shared obs/promtext parser — one exposition grammar for
+    every scrape surface."""
+    samples = promtext.parse_samples(text)
+    return {name: samples.get(name, []) for name in FAMILIES}
 
 
 def percentile_from_buckets(buckets, total, q):

@@ -17,6 +17,12 @@ Usage:
                                                    # re-converge
   python cmd/fleet_sim.py --scenario fleet.yaml    # declarative spec
   python cmd/fleet_sim.py --nodes 6 --racks 3 --rounds 8
+  python cmd/fleet_sim.py --proc                   # process mode: one
+                                                   # OS process per
+                                                   # node, real SIGKILL
+                                                   # + supervised
+                                                   # restart, HTTP-
+                                                   # scraped telemetry
   python cmd/fleet_sim.py --trace-file /tmp/fleet.jsonl
                                                    # + cmd/agent_trace.py
 
@@ -40,9 +46,13 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from container_engine_accelerators_tpu.fleet.controller import (  # noqa: E402
+    DEFAULT_PROC_SCENARIO,
     DEFAULT_SCENARIO,
     load_scenario,
     run_scenario,
+)
+from container_engine_accelerators_tpu.fleet.proc import (  # noqa: E402
+    ProcHandshakeError,
 )
 from container_engine_accelerators_tpu.fleet.telemetry import (  # noqa: E402
     SLO_KEYS,
@@ -76,6 +86,14 @@ def parse_args(argv=None):
                         "(emulated nodes are same-host, so the "
                         "zero-copy shm lane engages by default; this "
                         "is the fault-parity leg)")
+    p.add_argument("--proc", action="store_true",
+                   help="process mode: one OS process per node, real "
+                        "SIGKILL on scenario kills, supervised restart "
+                        "under a bounded budget, telemetry aggregated "
+                        "by HTTP scrape of each worker's MetricServer. "
+                        "Without --scenario this runs the built-in "
+                        "SIGKILL scenario; a worker that never "
+                        "completes its handshake exits 2, not a hang")
     p.add_argument("--metrics", action="store_true",
                    help="start a per-node MetricServer (ephemeral ports)")
     p.add_argument("--slo", action="append", default=[],
@@ -128,8 +146,11 @@ def _print_report(report, file=sys.stderr):
 def main(argv=None):
     args = parse_args(argv)
     scenario = dict(
-        load_scenario(args.scenario) if args.scenario else DEFAULT_SCENARIO
+        load_scenario(args.scenario) if args.scenario
+        else (DEFAULT_PROC_SCENARIO if args.proc else DEFAULT_SCENARIO)
     )
+    if args.proc:
+        scenario["proc"] = True
     for key, value in (("nodes", args.nodes), ("racks", args.racks),
                        ("rounds", args.rounds),
                        ("payload_bytes", args.payload_bytes),
@@ -163,7 +184,16 @@ def main(argv=None):
     if args.trace_file:
         trace.configure(args.trace_file)
 
-    report = run_scenario(scenario)
+    try:
+        report = run_scenario(scenario)
+    except ProcHandshakeError as e:
+        # A worker that never reported ready: the controller killed
+        # and reaped every spawned process; say why and fail —
+        # a boot that cannot complete must never hang CI.
+        print(f"fleet boot failed: {e}", file=sys.stderr)
+        if args.trace_file:
+            trace.configure(None)
+        return 2
 
     _print_report(report)
     print(json.dumps(report))

@@ -25,6 +25,13 @@ package is the rig that makes those scenarios testable on a laptop:
 - ``fleet.node``       EmulatedNode: TpuManager + health checker +
                        PyXferd + resilient client (+ optional
                        MetricServer), one per simulated host;
+- ``fleet.proc``       process mode: each node as its own OS process
+                       (worker entrypoint + coordinator-side ProcNode
+                       with real SIGKILL, supervised restart, and
+                       handshake/reap hygiene) — ``proc: true``
+                       scenarios run chaos against real process
+                       boundaries and aggregate telemetry by scraping
+                       each worker's MetricServer over HTTP;
 - ``fleet.controller`` FleetController: declarative scenarios (nodes,
                        topology, fault schedule, workload rounds) and
                        the per-node / per-link report.
@@ -34,6 +41,7 @@ scenario spec schema is documented in the README ("Fleet simulation").
 """
 
 from container_engine_accelerators_tpu.fleet.controller import (
+    DEFAULT_PROC_SCENARIO,
     DEFAULT_SCENARIO,
     FleetController,
     load_scenario,
@@ -44,6 +52,10 @@ from container_engine_accelerators_tpu.fleet.links import (
     LinkTable,
 )
 from container_engine_accelerators_tpu.fleet.node import EmulatedNode
+from container_engine_accelerators_tpu.fleet.proc import (
+    ProcHandshakeError,
+    ProcNode,
+)
 from container_engine_accelerators_tpu.fleet.topology import (
     FleetTopology,
     NodeSpec,
@@ -51,6 +63,7 @@ from container_engine_accelerators_tpu.fleet.topology import (
 from container_engine_accelerators_tpu.fleet.xferd import PyXferd
 
 __all__ = [
+    "DEFAULT_PROC_SCENARIO",
     "DEFAULT_SCENARIO",
     "EmulatedNode",
     "FleetController",
@@ -59,6 +72,8 @@ __all__ = [
     "LinkPartitioned",
     "LinkTable",
     "NodeSpec",
+    "ProcHandshakeError",
+    "ProcNode",
     "PyXferd",
     "load_scenario",
 ]

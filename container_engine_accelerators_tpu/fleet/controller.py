@@ -33,6 +33,17 @@ scenario's declarative SLOs (``slo:`` mapping — p99 leg-latency
 ceiling, goodput floor, retransmit/dedup ratio caps).  A scenario can
 therefore *converge* and still FAIL: ``cmd/fleet_sim.py`` exits
 non-zero on SLO breach, not just on non-convergence.
+
+**Process mode** (``proc: true``): every node boots as its own OS
+process (fleet/proc.py) — the scenario ``kill`` action delivers a real
+``SIGKILL``, ``restart`` respawns under a supervisor with RetryPolicy
+backoff and a bounded per-scenario budget (``restart_budget``, default
+3; exhaustion marks the node permanently down and the scenario
+non-converged), and telemetry aggregates by scraping each worker's
+MetricServer over HTTP (``stale`` verdicts instead of hangs).  The
+report schema is the same in both modes.  Link-table faults need the
+in-process delivery fabric and are logged-and-skipped in proc mode;
+endpoint chaos (kill / chip faults) is the point there.
 """
 
 import json
@@ -49,6 +60,7 @@ from container_engine_accelerators_tpu.fleet.links import (
     parse_link_fault,
 )
 from container_engine_accelerators_tpu.fleet.node import EmulatedNode
+from container_engine_accelerators_tpu.fleet.proc import ProcNode
 from container_engine_accelerators_tpu.fleet.telemetry import FleetTelemetry
 from container_engine_accelerators_tpu.fleet.topology import (
     FleetTopology,
@@ -79,6 +91,30 @@ DEFAULT_SCENARIO = {
          "chip": "accel0"},
         {"round": 2, "link": "rack:r0<->rack:r1:partition", "for": 2},
         {"round": 3, "action": "chip_recover", "node": "n1"},
+    ],
+}
+
+# The `--proc` headline: real OS-process nodes, a real SIGKILL
+# mid-scenario, supervised respawn two rounds later, pipelined
+# multi-chunk legs so the kill lands against in-flight transfer state,
+# and a chip fault recovering through a worker's own health checker.
+DEFAULT_PROC_SCENARIO = {
+    "name": "proc-sigkill",
+    "proc": True,
+    "nodes": 3,
+    "racks": 1,
+    "chips": 2,
+    "topology": "1x2x1",
+    "rounds": 5,
+    "payload_bytes": 16384,
+    "pipelined": True,
+    "chunk_bytes": 4096,
+    "stripes": 2,
+    "faults": [
+        {"round": 1, "action": "kill", "node": "n1", "for": 2},
+        {"round": 2, "action": "chip_fault", "node": "n2",
+         "chip": "accel0"},
+        {"round": 3, "action": "chip_recover", "node": "n2"},
     ],
 }
 
@@ -129,6 +165,9 @@ class FleetController:
         self.nodes: Dict[str, EmulatedNode] = {}
         self.rounds = int(self.scenario.get("rounds", 6))
         self.payload_bytes = int(self.scenario.get("payload_bytes", 2048))
+        # Process mode: one OS process per node, real SIGKILL chaos,
+        # HTTP-scraped telemetry (fleet/proc.py).
+        self.proc_mode = bool(self.scenario.get("proc", False))
         # Pipelined ring legs: chunked/striped transfers through the
         # same link-table fault surface.  Chunk/stripe/shm knobs come
         # from the scenario first, the TPU_DCN_* env second.  Emulated
@@ -159,21 +198,43 @@ class FleetController:
     def boot(self) -> "FleetController":
         if self._booted:
             return self
-        for spec in self.topology.specs.values():
-            self.nodes[spec.name] = EmulatedNode(
-                spec,
-                os.path.join(self.workdir, spec.name),
-                net=self.net,
-                metrics=bool(self.scenario.get("metrics", False)),
-            )
+        try:
+            for spec in self.topology.specs.values():
+                root = os.path.join(self.workdir, spec.name)
+                if self.proc_mode:
+                    # One OS process per node; MetricServer always on
+                    # (it is the aggregation transport).  A worker that
+                    # never handshakes raises ProcHandshakeError —
+                    # already-spawned siblings are reaped below.
+                    self.nodes[spec.name] = ProcNode(
+                        spec, root,
+                        env=self.child_env(),
+                        handshake_timeout_s=float(
+                            self.scenario.get("handshake_timeout_s",
+                                              60.0)),
+                        restart_budget=int(
+                            self.scenario.get("restart_budget", 3)),
+                    )
+                else:
+                    self.nodes[spec.name] = EmulatedNode(
+                        spec, root,
+                        net=self.net,
+                        metrics=bool(self.scenario.get("metrics",
+                                                       False)),
+                    )
+        except Exception:
+            self.close()  # no orphan workers on a half-booted fleet
+            raise
         self._counters0 = counters.snapshot()
         self.telemetry = FleetTelemetry(
-            self.nodes, self.links, self.scenario.get("slo")
+            self.nodes, self.links, self.scenario.get("slo"),
+            scrape=self.proc_mode,
         )
         self._booted = True
-        log.info("fleet booted: %d node(s) in %d rack(s)",
+        log.info("fleet booted: %d node(s) in %d rack(s)%s",
                  len(self.nodes),
-                 len({s.rack for s in self.topology.specs.values()}))
+                 len({s.rack for s in self.topology.specs.values()}),
+                 " [one process each]" if self.proc_mode else "")
         return self
 
     def close(self) -> None:
@@ -187,6 +248,16 @@ class FleetController:
         record = dict(entry)
         record["round"] = rnd
         if "link" in entry:
+            if self.proc_mode:
+                # The delivery fabric cannot interpose on another
+                # process's TCP stack; degrade, don't crash (the
+                # TPU_FAULT_SPEC rule).
+                log.error("link faults need the in-process fabric; "
+                          "skipping %r in proc mode", entry["link"])
+                record["link"] = str(entry["link"])  # JSON-clean log
+                record["applied"] = 0
+                record["skipped"] = "proc mode"
+                return record
             fault = (entry["link"] if isinstance(entry["link"], LinkFault)
                      else parse_link_fault(entry["link"]))
             if fault is None:
@@ -207,22 +278,40 @@ class FleetController:
             log.error("fault entry names unknown node: %r", entry)
             record["applied"] = 0
             return record
-        if action == "chip_fault":
-            node.inject_chip_fault(entry.get("chip", "accel0"),
-                                   int(entry.get("code", 48)))
-        elif action == "chip_recover":
-            record["recovered"] = node.force_recover()
-        elif action == "kill":
-            node.kill_daemon()
-            lifetime = int(entry.get("for", 0))
-            if lifetime > 0:
-                self._deferred.setdefault(rnd + lifetime, []).append(
-                    {"action": "restart", "node": node.name}
-                )
-        elif action == "restart":
-            node.restart_daemon()
-        else:
-            log.error("unknown fault action %r", action)
+        try:
+            if action == "chip_fault":
+                node.inject_chip_fault(entry.get("chip", "accel0"),
+                                       int(entry.get("code", 48)))
+            elif action == "chip_recover":
+                record["recovered"] = node.force_recover()
+            elif action == "kill":
+                node.kill_daemon()
+                lifetime = int(entry.get("for", 0))
+                if lifetime > 0:
+                    self._deferred.setdefault(rnd + lifetime, []).append(
+                        {"action": "restart", "node": node.name}
+                    )
+            elif action == "restart":
+                if node.restart_daemon() is False:
+                    # Refused (permanently down / budget spent): the
+                    # round log must not claim a respawn that never
+                    # happened — that's the scenario's whole verdict.
+                    record["applied"] = 0
+                    record["skipped"] = "restart refused (node " \
+                        "permanently down or budget exhausted)"
+                    return record
+            else:
+                log.error("unknown fault action %r", action)
+        except OSError as e:
+            # A fault aimed at a node whose worker is dark (SIGKILLed
+            # earlier in the schedule, or mid-crash): in proc mode the
+            # RPC has no one to talk to.  Degrade, don't crash — same
+            # rule as link faults above; the round log says why.
+            log.error("fault %r on node %s not applied: %s",
+                      action, node.name, e)
+            record["applied"] = 0
+            record["skipped"] = str(e)
+            return record
         record["applied"] = 1
         return record
 
@@ -365,11 +454,25 @@ class FleetController:
             snap["legs_ok"] = per_node_ok[name]
             snap["legs_failed"] = per_node_failed[name]
             nodes_report[name] = snap
-            if not node.down and not node.all_healthy():
+            # Judge healthiness from the snapshot in hand: in proc
+            # mode all_healthy() would issue a SECOND snapshot RPC per
+            # node, and the two could disagree mid-recovery.
+            if not snap.get("down") and not (
+                    snap.get("total", 0) > 0
+                    and snap.get("healthy") == snap.get("total")):
                 all_up_healthy = False
-        # Fleet-wide observability snapshot: every node's self-healing
-        # counters and latency histograms aggregated (the simulator is
-        # one process, so the process registries ARE the fleet's).
+        # A node whose restart budget exhausted is permanently down:
+        # its legs being "skipped" must not let the scenario converge —
+        # capacity is gone and nothing will bring it back.
+        none_permanently_down = not any(
+            getattr(node, "permanently_down", False)
+            for node in self.nodes.values()
+        )
+        # Observability snapshot: THIS process's counters and latency
+        # histograms.  In the one-process rig the process registries
+        # ARE the fleet's; in proc mode this is the coordinator side
+        # only (client/pipeline healing) — the workers' registries
+        # arrive via the telemetry section's HTTP scrapes instead.
         delta = {}
         now = counters.snapshot()
         for k, v in now.items():
@@ -386,6 +489,7 @@ class FleetController:
         links_report = self.links.report()
         return {
             "scenario": self.scenario.get("name", "fleet"),
+            "proc": self.proc_mode,
             "nodes": nodes_report,
             "links": links_report,
             "rounds": round_log,
@@ -393,7 +497,8 @@ class FleetController:
             "agent_latency": latency,
             "telemetry": {"rounds": self.telemetry.history},
             "slo": self.telemetry.evaluate(links_report),
-            "converged": survivors_converged and all_up_healthy,
+            "converged": (survivors_converged and all_up_healthy
+                          and none_permanently_down),
         }
 
     # -- coordinator env -----------------------------------------------------

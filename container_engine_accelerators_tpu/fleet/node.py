@@ -24,6 +24,7 @@ from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
 from container_engine_accelerators_tpu.fleet.topology import NodeSpec
 from container_engine_accelerators_tpu.fleet.xferd import PyXferd
 from container_engine_accelerators_tpu.health import TpuHealthChecker
+from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import trace
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     ResilientDcnXferClient,
@@ -55,12 +56,18 @@ class EmulatedNode:
         recovery_window_s: float = DEFAULT_RECOVERY_WINDOW_S,
         metrics: bool = False,
         client_retry: Optional[RetryPolicy] = None,
+        metrics_interval_s: float = 30.0,
     ):
         self.spec = spec
         self.name = spec.name
         self.root = root
         self.net = net
         self.down = False  # daemon intentionally killed by the scenario
+        # Parity fields with fleet/proc.ProcNode, so reports carry one
+        # schema whichever mode booted the node: an in-process node is
+        # never budget-limited, but its restarts are still counted.
+        self.permanently_down = False
+        self.restarts = 0
 
         write_fixture(root, spec.chips, topology=spec.topology)
         cfg_json = ({"tpuPartitionSize": spec.partition_size}
@@ -97,6 +104,7 @@ class EmulatedNode:
             self.metrics = MetricServer(
                 collector=TpuMetricsCollector(self.lib),
                 port=0,
+                collection_interval_s=metrics_interval_s,
                 pod_resources_socket=os.path.join(root, "noresources.sock"),
             )
             self.metrics.start()
@@ -150,12 +158,15 @@ class EmulatedNode:
             self.net.unregister(self.name)
         self.daemon.stop(crash=True)
 
-    def restart_daemon(self) -> None:
+    def restart_daemon(self) -> bool:
         trace.event("fleet.node_restart", node=self.name)
         self.daemon.start()
         if self.net is not None:
             self.net.register(self.name, self.daemon)
         self.down = False
+        self.restarts += 1
+        counters.inc("fleet.node.restarts")
+        return True
 
     # -- reporting -----------------------------------------------------------
 
@@ -168,6 +179,8 @@ class EmulatedNode:
             "total": len(health),
             "daemon_generation": self.daemon.generation,
             "down": self.down,
+            "restarts": self.restarts,
+            "permanently_down": self.permanently_down,
         }
         if self.metrics is not None:
             snap["metrics_port"] = self.metrics.port

@@ -8,11 +8,16 @@ delivering a third of the bandwidth anyone provisioned for.  This
 module adds the quality verdict:
 
 - **scrape**: each round the aggregator reads every emulated node's
-  telemetry — windowed goodput per ``{node, link}`` from
-  obs/timeseries.py (the sim runs nodes in one process, so the series
-  registry is the fleet's, keyed by the ``goodput.node.<n>`` /
-  ``goodput.link.<a>-><b>`` naming convention) plus each daemon's flow
-  accounting — into a round-indexed history;
+  telemetry into a round-indexed history.  In the one-process rig the
+  series registry IS the fleet's (windowed goodput per ``{node,
+  link}`` from obs/timeseries.py, keyed by the ``goodput.node.<n>`` /
+  ``goodput.link.<a>-><b>`` naming convention, plus each daemon's flow
+  accounting).  In **process mode** (``proc: true`` scenarios) there
+  is no shared registry: the aggregator scrapes each node worker's
+  MetricServer over HTTP — per-node timeout, one retry, and a
+  ``stale: true`` verdict on the round entry when a node cannot be
+  scraped (down, killed, or just slow), so one dead node degrades the
+  report instead of hanging the round;
 
 - **SLOs**: the scenario spec's ``slo:`` mapping declares ceilings and
   floors, evaluated over the whole run::
@@ -29,6 +34,16 @@ module adds the quality verdict:
   so the MetricServer scrape (``agent_gauge``), ``cmd/agent_top.py``,
   and the flight recorder all show SLO state live.
 
+  In scrape mode the measurements come from the HTTP history instead
+  of the link table (process workers see no link fabric): the goodput
+  floor is judged over the per-round scraped ``goodput.node.*`` sums
+  with **stale windows skipped** (a round where a node was down must
+  not count as zero goodput against the floor — the kill is the
+  scenario's point), and the retransmit/dedup ratios come from each
+  worker's scraped ``dcn.frames.deduped`` / ``xferd.frames.landed``
+  counters, accumulated restart-aware (a respawned worker's counters
+  restart at zero; the aggregator sums increments, not raw values).
+
 The controller folds :meth:`FleetTelemetry.evaluate`'s result into the
 report's ``slo`` section and ``cmd/fleet_sim.py`` exits non-zero on
 breach — a fleet that converges while violating its goodput floor
@@ -37,11 +52,19 @@ fails CI, not just a dashboard.
 
 import logging
 import time
-from typing import Dict, List, Optional
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
 
-from container_engine_accelerators_tpu.obs import histo, timeseries
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import histo, promtext, timeseries
 
 log = logging.getLogger(__name__)
+
+# Per-node HTTP scrape budget (scrape mode): one attempt + one retry,
+# each under this timeout — a dead node costs the round at most
+# 2 * timeout and a `stale` entry, never a hang.
+DEFAULT_SCRAPE_TIMEOUT_S = 1.0
 
 # SLO key -> (kind, description).  Ceilings fail when value > limit,
 # floors when value < limit.
@@ -83,15 +106,70 @@ def parse_slo_spec(raw: Optional[dict]) -> Dict[str, float]:
     return spec
 
 
+class ScrapeError(OSError):
+    """One node's /metrics endpoint could not be read (connection
+    refused, timeout, bad body) — the per-node degradation signal."""
+
+
+class NodeScrape:
+    """One parsed Prometheus exposition: labeled samples per family."""
+
+    def __init__(self, families: Dict[str, List[Tuple[dict, float]]]):
+        self._families = families
+
+    def value(self, family: str, default: float = 0.0,
+              **labels: str) -> float:
+        """First sample of ``family`` whose labels include ``labels``
+        (absent family/labels -> ``default`` — an idle node and a
+        never-active one scrape the same, like timeseries.rate)."""
+        for lab, v in self._families.get(family, []):
+            if all(lab.get(k) == want for k, want in labels.items()):
+                return v
+        return default
+
+
+def parse_prometheus_text(body: str) -> NodeScrape:
+    return NodeScrape(promtext.parse_samples(body))
+
+
+def scrape_metric_server(port: int,
+                         timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S,
+                         host: str = "127.0.0.1") -> NodeScrape:
+    """One GET of a node's /metrics, parsed.  Raises
+    :class:`ScrapeError` on any transport or parse trouble."""
+    url = f"http://{host}:{int(port)}/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            body = resp.read().decode("utf-8", "replace")
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise ScrapeError(f"scrape of {url} failed: {e}") from e
+    return parse_prometheus_text(body)
+
+
 class FleetTelemetry:
     """Scrapes the fleet's telemetry each round and renders the SLO
-    verdict at the end of the run."""
+    verdict at the end of the run.
 
-    def __init__(self, nodes: dict, links, slo: Optional[dict] = None):
+    ``scrape=True`` (process-mode fleets) aggregates over HTTP from
+    each node's MetricServer instead of reading this process's
+    registries — the in-process registry reads are gone from that
+    path entirely; a node that cannot be scraped degrades to a
+    ``stale`` round entry instead of raising.
+    """
+
+    def __init__(self, nodes: dict, links, slo: Optional[dict] = None,
+                 *, scrape: bool = False,
+                 scrape_timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S):
         self.nodes = nodes
         self.links = links
         self.slo = parse_slo_spec(slo)
+        self.scrape = bool(scrape)
+        self.scrape_timeout_s = float(scrape_timeout_s)
         self.history: List[dict] = []
+        # Restart-aware counter accumulation per node: worker counters
+        # reset to zero on respawn, so the fleet totals sum increments
+        # between scrapes, treating a decrease as a fresh process.
+        self._accum: Dict[str, Dict[str, float]] = {}
         self._t0 = time.monotonic()
         # Histograms are process-global and cumulative; the p99 SLO
         # must judge THIS run only, so snapshot the leg histogram's
@@ -104,13 +182,19 @@ class FleetTelemetry:
 
     def sample_round(self, rnd: int) -> dict:
         """One scrape across every node: windowed goodput per node and
-        per link, plus each live daemon's flow accounting."""
+        per link, plus each live daemon's flow accounting.  The entry
+        schema is identical in both modes; scrape mode adds HTTP as
+        the transport and ``stale`` as the degradation verdict."""
         per_node = {}
         for name, node in self.nodes.items():
+            if self.scrape:
+                per_node[name] = self._scrape_entry(name, node)
+                continue
             entry = {
                 "goodput_bps": round(
                     timeseries.rate(f"goodput.node.{name}"), 1),
                 "down": node.down,
+                "stale": False,
             }
             if not node.down:
                 stats = node.daemon._stats()
@@ -120,11 +204,88 @@ class FleetTelemetry:
         per_link = {
             key: round(timeseries.rate(f"goodput.link.{key}"), 1)
             for key in self.links.report()
-        }
+        } if not self.scrape else {}
         sample = {"round": rnd, "nodes": per_node,
                   "links_goodput_bps": per_link}
         self.history.append(sample)
         return sample
+
+    # -- HTTP scrape path (process-mode fleets) ------------------------------
+
+    def _scrape_entry(self, name: str, node) -> dict:
+        """One node's round entry, from its /metrics endpoint.  A down
+        or unreachable node yields ``stale: true`` — never an
+        exception, never a hang past the per-node budget."""
+        if node.down:
+            return {"goodput_bps": 0.0, "down": True, "stale": True}
+        last: Optional[ScrapeError] = None
+        for _attempt in range(2):  # one retry, same budget each
+            try:
+                s = scrape_metric_server(node.metrics_port,
+                                         self.scrape_timeout_s)
+                break
+            except ScrapeError as e:
+                last = e
+        else:
+            counters.inc("fleet.scrape.stale")
+            log.warning("node %s metrics scrape degraded to stale: %s",
+                        name, last)
+            return {"goodput_bps": 0.0, "down": False, "stale": True}
+        # Fleet ratio inputs are cumulative worker counters; fold them
+        # into the restart-aware totals while the scrape is fresh,
+        # keyed by the worker's incarnation (the coordinator-side
+        # spawn count) so a respawn is detected even when the new
+        # process has already climbed past the dead one's last value.
+        gen = getattr(getattr(node, "daemon", None), "generation", None)
+        self._accumulate(name, "deduped",
+                         s.value("agent_events",
+                                 event="dcn.frames.deduped"), gen=gen)
+        self._accumulate(name, "frames",
+                         s.value("agent_events",
+                                 event="xferd.frames.landed"), gen=gen)
+        return {
+            "goodput_bps": round(
+                s.value("agent_goodput", scope="node", name=name), 1),
+            "down": False,
+            "stale": False,
+            "active_flows": int(s.value("agent_gauge",
+                                        name="xferd.active_flows")),
+            "transferred": int(s.value("agent_gauge",
+                                       name="xferd.total_transferred")),
+        }
+
+    def _accumulate(self, node: str, key: str, current: float,
+                    gen: Optional[int] = None) -> None:
+        st = self._accum.setdefault(node, {})
+        last = st.get("_last_" + key, 0.0)
+        if gen is not None and gen != st.get("_gen_" + key):
+            # A new worker incarnation: its counters started at zero,
+            # so everything it shows is new increment — even when it
+            # has already climbed PAST the dead incarnation's last
+            # scraped value (the decrease heuristic alone misses that).
+            delta = current
+        elif gen is not None and current < last:
+            # Same incarnation but the counter went DOWN: a worker
+            # cannot decrement its own cumulative counters and the
+            # supervisor bumps the generation on every respawn, so
+            # this can only be a misread (e.g. the scrape raced the
+            # exporter's periodic registry reset).  Folding it in
+            # would double-count the pre-reset total on the next
+            # scrape — drop the sample and keep the last-known state.
+            return
+        elif current < last:
+            # No incarnation evidence but the counter went DOWN:
+            # still unmistakably a fresh process.
+            delta = current
+        else:
+            delta = current - last
+        st[key] = st.get(key, 0.0) + delta
+        st["_last_" + key] = current
+        if gen is not None:
+            st["_gen_" + key] = gen
+
+    def _accum_total(self, key: str) -> float:
+        return sum(st.get(key, 0.0) for st in self._accum.values())
 
     # -- SLO evaluation ------------------------------------------------------
 
@@ -161,13 +322,49 @@ class FleetTelemetry:
             "max_dedup_ratio": dups / max(1, frames),
         }
 
+    def _measurements_scraped(self) -> dict:
+        """Scrape-mode measurements, from the HTTP history.  Stale
+        windows are SKIPPED, not zeroed: a round where a node was down
+        must not be averaged in as zero goodput — the kill is the
+        scenario's point, and the floor judges the fleet while it was
+        observable.  Node entries that were stale are excluded from
+        their round's sum; rounds with no live entry at all are
+        dropped outright."""
+        elapsed_s = max(time.monotonic() - self._t0, 1e-9)
+        round_sums = []
+        stale_entries = 0
+        for sample in self.history:
+            live = [e["goodput_bps"] for e in sample["nodes"].values()
+                    if not e.get("stale")]
+            stale_entries += sum(1 for e in sample["nodes"].values()
+                                 if e.get("stale"))
+            if live:
+                round_sums.append(sum(live))
+        goodput = (sum(round_sums) / len(round_sums)
+                   if round_sums else 0.0)
+        # No link fabric between processes: drops are invisible here,
+        # so both ratio caps judge the receiver-side dedup evidence
+        # (replays that actually re-landed) over frames that landed.
+        deduped = self._accum_total("deduped")
+        frames = self._accum_total("frames")
+        ratio = deduped / max(1.0, frames)
+        return {
+            "elapsed_s": round(elapsed_s, 3),
+            "p99_leg_ms": self._leg_p99_ms(),
+            "min_goodput_bps": goodput,
+            "max_retransmit_ratio": ratio,
+            "max_dedup_ratio": ratio,
+            "stale_entries_skipped": stale_entries,
+        }
+
     def evaluate(self, links_report: Dict[str, dict]) -> dict:
         """The report's ``slo`` section: every configured check with
         its measured value, the limit, and pass/fail; ``ok`` is the
         conjunction (vacuously true with no SLOs configured).  Each
         verdict is also published as ``slo.<key>.ok`` /
         ``slo.<key>.value`` gauges for the live scrape surface."""
-        measured = self._measurements(links_report)
+        measured = (self._measurements_scraped() if self.scrape
+                    else self._measurements(links_report))
         checks = []
         for key, limit in self.slo.items():
             kind, what = SLO_KEYS[key]

@@ -433,6 +433,7 @@ class PyXferd:
             self._flows.clear()
             self._total_transferred = 0
             self._unmatched = 0
+            self._publish_flow_gauges_locked()
             self._landed.notify_all()  # unpark any blocked wait op
             peer_conns = list(self._peer_conns.values())
             self._peer_conns.clear()
@@ -494,12 +495,22 @@ class PyXferd:
         requests (chaos tests)."""
         self._drop_response[op] = self._drop_response.get(op, 0) + times
 
+    def _publish_flow_gauges_locked(self) -> None:
+        """Flow accounting as gauges (caller holds the lock): what the
+        in-process aggregator reads via ``_stats()``, the process-mode
+        HTTP aggregator scrapes as ``agent_gauge`` — same numbers,
+        different transport."""
+        timeseries.gauge("xferd.active_flows", float(len(self._flows)))
+        timeseries.gauge("xferd.total_transferred",
+                         float(self._total_transferred))
+
     def _release_owned(self, conn_id: int) -> None:
         with self._lock:
             for name in [n for n, f in self._flows.items()
                          if f.owner == conn_id]:
                 self._flows[name].close_segment()
                 del self._flows[name]
+            self._publish_flow_gauges_locked()
             self._landed.notify_all()  # waiters re-check released flows
             stale = [k for k in self._peer_conns if k[0] == conn_id]
             conns = [self._peer_conns.pop(k) for k in stale]
@@ -540,6 +551,7 @@ class PyXferd:
                 nbytes = int(req.get("bytes") or 4096)
                 self._flows[flow] = _Flow(conn_id, req.get("peer", ""),
                                           nbytes)
+                self._publish_flow_gauges_locked()
             return {"ok": True, "flow": flow, "buffer_bytes": nbytes}
         if op == "record_transfer":
             nbytes = req.get("bytes")
@@ -554,6 +566,7 @@ class PyXferd:
                             "error": "flow owned by another client"}
                 f.transferred += nbytes
                 self._total_transferred += nbytes
+                self._publish_flow_gauges_locked()
                 return {"ok": True, "flow_bytes": f.transferred}
         if op == "release_flow":
             with self._lock:
@@ -565,6 +578,7 @@ class PyXferd:
                             "error": "flow owned by another client"}
                 f.close_segment()
                 del self._flows[req["flow"]]
+                self._publish_flow_gauges_locked()
             return {"ok": True}
         if op == "read":
             return self._read(req)
@@ -734,6 +748,7 @@ class PyXferd:
             if f is not None:
                 f.transferred += len(payload)
                 self._total_transferred += len(payload)
+                self._publish_flow_gauges_locked()
         resp = {"ok": True, "bytes": len(payload),
                 "micros": round(micros, 1),
                 "gbps": round(len(payload) * 8 / micros / 1e3, 3)}
@@ -1081,6 +1096,11 @@ class PyXferd:
                     # stage rate never inflates goodput.
                     remote = link is not None or bool(meta.get("src"))
                     if remote:
+                        # Cumulative landed-frame count: the scrapeable
+                        # denominator for fleet dedup/retransmit ratios
+                        # when there is no link table to read (the
+                        # process-mode aggregator's HTTP path).
+                        counters.inc("xferd.frames.landed")
                         timeseries.record("xferd.rx.bytes", len(payload))
                         timeseries.record(f"goodput.flow.{flow}",
                                           len(payload))

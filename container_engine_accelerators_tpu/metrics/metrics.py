@@ -280,6 +280,56 @@ class MetricServer:
             self.agent_exemplar,
         ):
             gauge.clear()
+        # The registry has no scrape-wide lock, so a GET landing between
+        # the clears above and the next collection pass would serve the
+        # agent families empty (scrapers read vanished counters as 0).
+        # Republish the cumulative state immediately; only the per-pod
+        # device series stay absent until their next sample.
+        self._republish_cumulative()
+
+    def _republish_cumulative(self) -> None:
+        """Re-export every family backed by cumulative process state
+        (counters, histograms, rates, gauges) — called after a registry
+        reset and on every collection pass."""
+        # Robustness counters are cumulative process state, re-published
+        # wholesale each pass (so the periodic registry reset cannot lose
+        # them the way it drops vanished pods' series).
+        for name, value in counters.snapshot().items():
+            self.agent_events.labels(event=name).set(value)
+
+        # Latency histograms ride the same contract: cumulative process
+        # state, re-published wholesale.  Buckets are exported
+        # Prometheus-style (cumulative over ascending le bounds) so
+        # histogram_quantile-like math works on the scrape.
+        for op, h in histo.snapshot().items():
+            cumulative = 0
+            for le, count in sorted(h["buckets"].items(),
+                                    key=lambda kv: int(kv[0])):
+                cumulative += count
+                self.agent_latency.labels(op=op, bucket=le).set(cumulative)
+            self.agent_latency.labels(op=op, bucket="+Inf").set(h["count"])
+            # Exemplars: one row per bucket that saw a traced sample —
+            # the trace id travels as a label (Prometheus values are
+            # numeric), the value is the worst sample's duration.
+            for le, ex in h.get("exemplars", {}).items():
+                self.agent_exemplar.labels(
+                    op=op, bucket=le, trace=ex["trace"]
+                ).set(ex["dur_us"])
+
+        # Windowed rates: republished wholesale like the counters —
+        # idle series export an explicit 0.0 (a stopped flow must
+        # scrape as zero, not silently vanish between resets).
+        # goodput.* series split into their own labeled family.
+        for name, per_s in timeseries.rates().items():
+            scoped = timeseries.split_goodput(name)
+            if scoped is not None:
+                self.agent_goodput.labels(
+                    scope=scoped[0], name=scoped[1]
+                ).set(per_s)
+            else:
+                self.agent_rate.labels(event=name).set(per_s)
+        for name, value in timeseries.gauges().items():
+            self.agent_gauge.labels(name=name).set(value)
 
     def _chips_for(self, device_id: str):
         """A physical device ID is a chip (accelN) or a sub-slice (sliceM);
@@ -329,45 +379,7 @@ class MetricServer:
                     self.memory_total.labels(**labels).set(hbm.total_bytes)
                     self.memory_used.labels(**labels).set(hbm.used_bytes)
 
-        # Robustness counters are cumulative process state, re-published
-        # wholesale each pass (so the periodic registry reset cannot lose
-        # them the way it drops vanished pods' series).
-        for name, value in counters.snapshot().items():
-            self.agent_events.labels(event=name).set(value)
-
-        # Latency histograms ride the same contract: cumulative process
-        # state, re-published wholesale.  Buckets are exported
-        # Prometheus-style (cumulative over ascending le bounds) so
-        # histogram_quantile-like math works on the scrape.
-        for op, h in histo.snapshot().items():
-            cumulative = 0
-            for le, count in sorted(h["buckets"].items(),
-                                    key=lambda kv: int(kv[0])):
-                cumulative += count
-                self.agent_latency.labels(op=op, bucket=le).set(cumulative)
-            self.agent_latency.labels(op=op, bucket="+Inf").set(h["count"])
-            # Exemplars: one row per bucket that saw a traced sample —
-            # the trace id travels as a label (Prometheus values are
-            # numeric), the value is the worst sample's duration.
-            for le, ex in h.get("exemplars", {}).items():
-                self.agent_exemplar.labels(
-                    op=op, bucket=le, trace=ex["trace"]
-                ).set(ex["dur_us"])
-
-        # Windowed rates: republished wholesale like the counters —
-        # idle series export an explicit 0.0 (a stopped flow must
-        # scrape as zero, not silently vanish between resets).
-        # goodput.* series split into their own labeled family.
-        for name, per_s in timeseries.rates().items():
-            scoped = timeseries.split_goodput(name)
-            if scoped is not None:
-                self.agent_goodput.labels(
-                    scope=scoped[0], name=scoped[1]
-                ).set(per_s)
-            else:
-                self.agent_rate.labels(event=name).set(per_s)
-        for name, value in timeseries.gauges().items():
-            self.agent_gauge.labels(name=name).set(value)
+        self._republish_cumulative()
 
         for chip in self.collector.devices():
             try:

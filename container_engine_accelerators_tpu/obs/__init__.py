@@ -14,13 +14,16 @@ contract with a blocked-import subprocess.
 - ``obs.timeseries``  windowed ring-bucket rates + explicit gauges
                       (goodput, in-flight, SLO status)
 - ``obs.flight``      flight recorder: SIGUSR1 / terminal-failure dumps
+- ``obs.promtext``    the one Prometheus text-exposition parser every
+                      scrape surface (agent_top, fleet telemetry) uses
 """
 
 from container_engine_accelerators_tpu.obs import (
     flight,
     histo,
+    promtext,
     timeseries,
     trace,
 )
 
-__all__ = ["flight", "histo", "timeseries", "trace"]
+__all__ = ["flight", "histo", "promtext", "timeseries", "trace"]

@@ -1,0 +1,619 @@
+"""Process-mode fleet: real OS-process nodes, SIGKILL chaos, supervised
+restart, HTTP-scraped telemetry (fleet/proc.py).
+
+The one-process rig (tests/test_fleet.py) proves the chaos *logic*;
+this file proves it against real process boundaries: a scenario
+``kill`` is a SIGKILL that runs zero lines of worker teardown, recovery
+is a supervisor respawn under a bounded budget, and every telemetry
+number in the report arrived over HTTP from a worker's MetricServer —
+not from this process's registries.
+
+Tier-1 keeps the cheap units plus ONE process-mode smoke scenario; the
+wider multi-process matrix (lane parity, mid-transfer kills, budget
+exhaustion, flight-on-SIGTERM) is marked ``slow`` so the default suite
+stays inside its budget — ``make fleet-proc`` runs everything.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from container_engine_accelerators_tpu.fleet.controller import (
+    DEFAULT_PROC_SCENARIO,
+    run_scenario,
+)
+from container_engine_accelerators_tpu.fleet.proc import (
+    HANG_ENV,
+    ProcHandshakeError,
+    ProcNode,
+)
+from container_engine_accelerators_tpu.fleet.telemetry import (
+    FleetTelemetry,
+    ScrapeError,
+    parse_prometheus_text,
+    scrape_metric_server,
+)
+from container_engine_accelerators_tpu.fleet.topology import NodeSpec
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.parallel import dcn, dcn_pipeline
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    DcnXferError,
+)
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+from tests.mp_runner import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PAYLOAD = bytes(range(256)) * 16  # 4 KiB
+N = len(PAYLOAD)
+PIPE_PAYLOAD = bytes(range(256)) * 64  # 16 KiB = 4 chunks
+PIPE_N = len(PIPE_PAYLOAD)
+PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2)
+
+# One spawn attempt, tiny backoff: failure tests must not sit through
+# the production respawn budget.
+FAST_RESPAWN = RetryPolicy(max_attempts=2, initial_backoff_s=0.05,
+                           max_backoff_s=0.1, deadline_s=20.0)
+
+
+def _spec(name):
+    return NodeSpec(name=name, chips=2, topology="1x2x1")
+
+
+def _node(tmp_path, name, **kw):
+    kw.setdefault("handshake_timeout_s", 60.0)
+    env = dict(os.environ)
+    env.pop("TPU_FAULT_SPEC", None)  # determinism under make chaos
+    kw.setdefault("env", env)
+    return ProcNode(_spec(name), str(tmp_path / name), **kw)
+
+
+def _flow_stat(client, flow):
+    return next(f for f in client.stats()["flows"] if f["flow"] == flow)
+
+
+def _wait_stable_rx(client, flow, expect, settle_s=0.25):
+    dcn.wait_flow_rx(client, flow, expect, timeout_s=10)
+    deadline = time.monotonic() + settle_s
+    while time.monotonic() < deadline:
+        assert _flow_stat(client, flow)["rx_bytes"] == expect
+        time.sleep(0.02)
+
+
+def _scrape_after_collect(port, settle_s=0.8):
+    """Scrape once the worker's collect loop has republished (proc
+    workers collect every 0.25 s)."""
+    time.sleep(settle_s)
+    return scrape_metric_server(port, timeout_s=5.0)
+
+
+# ---------------------------------------------------------------------------
+# ProcNode lifecycle: handshake, transfer, reap hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestProcNodeLifecycle:
+    def test_spawn_transfer_snapshot_and_clean_reap(self, tmp_path):
+        """Two real node processes; the coordinator's production
+        clients drive one serial transfer across them; teardown reaps
+        both (waitpid — no zombies, no orphans on the node's ports)."""
+        a = _node(tmp_path, "na")
+        b = _node(tmp_path, "nb")
+        pa, pb = a.proc, b.proc
+        try:
+            assert a.pid != os.getpid() and a.pid != b.pid
+            snap = a.snapshot()
+            assert snap["proc"] is True
+            assert snap["healthy"] == snap["total"] == 2
+            assert a.all_healthy()
+
+            b.client.register_flow("f", bytes=N)
+            a.client.register_flow("f", bytes=N)
+            a.client.put("f", PAYLOAD)
+            dcn.wait_flow_rx(a.client, "f", N, timeout_s=10)
+            a.client.send("f", "127.0.0.1", b.daemon.data_port, N)
+            dcn.wait_flow_rx(b.client, "f", N, timeout_s=10)
+            assert b.client.read("f", N) == PAYLOAD
+        finally:
+            a.close()
+            b.close()
+        # Reaped: returncode recorded (waitpid ran), nothing lingering.
+        assert pa.returncode is not None
+        assert pb.returncode is not None
+        with pytest.raises(ProcessLookupError):
+            os.kill(pa.pid, 0)
+
+    def test_chip_fault_and_recovery_cross_process(self, tmp_path):
+        """The fault schedule's chip actions ride the RPC pipe into
+        the worker's real health checker and come back in snapshots."""
+        a = _node(tmp_path, "na")
+        try:
+            a.inject_chip_fault("accel0")
+            assert a.device_health()["accel0"] == "Unhealthy"
+            assert not a.all_healthy()
+            assert a.force_recover() == 1
+            assert a.all_healthy()
+        finally:
+            a.close()
+
+    def test_stray_stdout_lines_tolerated(self, tmp_path):
+        """Stray stdout that happens to be valid JSON but not a dict
+        (a bare `null`, a number) is skipped by both halves of the
+        pipe protocol — the coordinator's RPC reader and the worker's
+        request loop — instead of crashing on `.get`."""
+        a = _node(tmp_path, "ns")
+        try:
+            # Coordinator side: scalar lines ahead of the real answer.
+            a._q.put("null\n")
+            a._q.put("42\n")
+            a._q.put('"stray"\n')
+            assert a.pump_health() >= 0
+            # Worker side: scalar request lines are noise, the RPC
+            # after them still answers.
+            a.proc.stdin.write("null\n17\n")
+            a.proc.stdin.flush()
+            snap = a.snapshot()
+            assert snap["healthy"] == snap["total"] == 2
+        finally:
+            a.close()
+
+    def test_handshake_timeout_raises_and_reaps(self, tmp_path):
+        """A worker that hangs before reporting ready is killed,
+        reaped, and surfaced as ProcHandshakeError — never a hang."""
+        env = dict(os.environ, **{HANG_ENV: "1"})
+        t0 = time.monotonic()
+        with pytest.raises(ProcHandshakeError, match="no handshake"):
+            ProcNode(_spec("nh"), str(tmp_path / "nh"), env=env,
+                     handshake_timeout_s=2.0)
+        assert time.monotonic() - t0 < 30
+
+
+class TestProcHandshakeCli:
+    def test_fleet_sim_exits_2_when_worker_never_handshakes(
+            self, tmp_path, monkeypatch, capsys):
+        """cmd/fleet_sim.py --proc against a hanging worker exits
+        nonzero with a clear message instead of hanging CI."""
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "fleet_sim", os.path.join(REPO, "cmd", "fleet_sim.py"))
+        fs = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fs)
+        path = str(tmp_path / "hang.json")
+        with open(path, "w") as f:
+            json.dump({"name": "hang", "proc": True, "nodes": 1,
+                       "rounds": 1, "handshake_timeout_s": 2.0,
+                       "faults": []}, f)
+        monkeypatch.setenv(HANG_ENV, "1")
+        rc = fs.main(["--scenario", path])
+        assert rc == 2
+        assert "fleet boot failed" in capsys.readouterr().err
+
+
+class TestFaultDegradation:
+    def test_fault_on_dark_node_degrades_not_crashes(self):
+        """A chip fault aimed at a node whose worker is down (killed
+        earlier in the schedule) must degrade to a skipped round-log
+        entry, not unwind the scenario — same rule as link faults in
+        proc mode."""
+        from container_engine_accelerators_tpu.fleet.controller import (
+            FleetController,
+        )
+
+        class _DarkNode:
+            name = "n0"
+
+            def inject_chip_fault(self, chip, code):
+                raise OSError("node n0 worker is down")
+
+        ctl = FleetController({"proc": True, "nodes": 1, "rounds": 1})
+        ctl.nodes["n0"] = _DarkNode()
+        record = ctl._apply_fault(
+            1, {"action": "chip_fault", "node": "n0"})
+        assert record["applied"] == 0
+        assert "down" in record["skipped"]
+
+    def test_refused_restart_recorded_as_skipped(self):
+        """A restart the supervisor refuses (permanently down, budget
+        spent) must show up in the round log as skipped — the report
+        cannot claim a respawn that never happened."""
+        from container_engine_accelerators_tpu.fleet.controller import (
+            FleetController,
+        )
+
+        class _SpentNode:
+            name = "n0"
+
+            def restart_daemon(self):
+                return False
+
+        ctl = FleetController({"proc": True, "nodes": 1, "rounds": 1})
+        ctl.nodes["n0"] = _SpentNode()
+        record = ctl._apply_fault(2, {"action": "restart", "node": "n0"})
+        assert record["applied"] == 0
+        assert "refused" in record["skipped"]
+
+
+# ---------------------------------------------------------------------------
+# Scrape resilience: timeouts, stale verdicts, SLO stale-skip
+# ---------------------------------------------------------------------------
+
+
+class _FakeNode:
+    def __init__(self, port, down=False):
+        self.metrics_port = port
+        self.down = down
+
+
+class TestScrapeResilience:
+    def test_parse_prometheus_text(self):
+        s = parse_prometheus_text(
+            '# HELP agent_goodput landed bytes\n'
+            '# TYPE agent_goodput gauge\n'
+            'agent_goodput{scope="node",name="n0"} 1234.5\n'
+            'agent_gauge{name="xferd.active_flows"} 2.0\n'
+            'agent_events{event="dcn.frames.deduped"} 3.0\n'
+            'not a sample line\n'
+        )
+        assert s.value("agent_goodput", scope="node", name="n0") \
+            == pytest.approx(1234.5)
+        assert s.value("agent_gauge", name="xferd.active_flows") == 2.0
+        assert s.value("agent_events", event="dcn.frames.deduped") == 3.0
+        assert s.value("agent_events", event="nope") == 0.0
+        assert s.value("no_such_family") == 0.0
+
+    def test_unreachable_node_degrades_to_stale_not_raise(self):
+        """A scrape against a port nobody listens on: timeout + one
+        retry, then a `stale: true` round entry — the round completes,
+        the counter records the degradation."""
+        dead = free_port()  # bound-then-released: nothing listens
+        with pytest.raises(ScrapeError):
+            scrape_metric_server(dead, timeout_s=0.5)
+        t = FleetTelemetry({"nx": _FakeNode(dead)}, None, None,
+                           scrape=True, scrape_timeout_s=0.5)
+        s0 = counters.get("fleet.scrape.stale")
+        sample = t.sample_round(0)
+        assert sample["nodes"]["nx"] == {
+            "goodput_bps": 0.0, "down": False, "stale": True}
+        assert counters.get("fleet.scrape.stale") == s0 + 1
+
+    def test_down_node_marks_stale_without_scraping(self):
+        t = FleetTelemetry({"nd": _FakeNode(1, down=True)}, None, None,
+                           scrape=True)
+        sample = t.sample_round(0)
+        assert sample["nodes"]["nd"]["stale"] is True
+        assert sample["nodes"]["nd"]["down"] is True
+
+    def test_slo_goodput_skips_stale_windows(self):
+        """The floor judges the fleet while it was observable: stale
+        entries leave their round's sum, all-stale rounds drop — a
+        killed node's dark window cannot average the goodput to zero."""
+        t = FleetTelemetry({}, None, {"min_goodput_bps": 120.0},
+                           scrape=True)
+        t.history = [
+            {"round": 0, "nodes": {
+                "n0": {"goodput_bps": 100.0, "stale": False},
+                "n1": {"goodput_bps": 50.0, "stale": False}},
+             "links_goodput_bps": {}},
+            {"round": 1, "nodes": {  # n1 dark: entry skipped
+                "n0": {"goodput_bps": 150.0, "stale": False},
+                "n1": {"goodput_bps": 0.0, "stale": True}},
+             "links_goodput_bps": {}},
+            {"round": 2, "nodes": {  # whole round dark: dropped
+                "n0": {"goodput_bps": 0.0, "stale": True},
+                "n1": {"goodput_bps": 0.0, "stale": True}},
+             "links_goodput_bps": {}},
+        ]
+        section = t.evaluate({})
+        assert section["measured"]["min_goodput_bps"] \
+            == pytest.approx(150.0)  # (150 + 150) / 2
+        assert section["measured"]["stale_entries_skipped"] == 3
+        assert section["ok"] is True
+
+    def test_restart_aware_counter_accumulation(self):
+        """Worker counters reset to zero on respawn; the aggregator
+        sums increments, so a restart never loses (or double-counts)
+        the dedup evidence."""
+        t = FleetTelemetry({}, None, None, scrape=True)
+        t._accumulate("n0", "frames", 10.0)
+        t._accumulate("n0", "frames", 14.0)   # +4
+        t._accumulate("n0", "frames", 3.0)    # respawn: fresh process, +3
+        t._accumulate("n0", "frames", 5.0)    # +2
+        assert t._accum_total("frames") == pytest.approx(19.0)
+
+    def test_incarnation_keyed_accumulation_sees_fast_respawn(self):
+        """A respawned worker whose counter climbs PAST the dead
+        incarnation's last scraped value before the next scrape looks
+        monotonic to the decrease heuristic; the incarnation key (the
+        coordinator's spawn count) still detects the reset, so no
+        frames are silently dropped from the SLO denominators."""
+        t = FleetTelemetry({}, None, None, scrape=True)
+        t._accumulate("n0", "frames", 10.0, gen=1)  # +10
+        t._accumulate("n0", "frames", 14.0, gen=1)  # +4
+        t._accumulate("n0", "frames", 20.0, gen=2)  # respawn, past 14: +20
+        t._accumulate("n0", "frames", 22.0, gen=2)  # +2
+        assert t._accum_total("frames") == pytest.approx(36.0)
+
+    def test_same_incarnation_decrease_is_dropped_as_misread(self):
+        """The supervisor bumps the generation on every respawn, so a
+        same-gen decrease can only be a misread (e.g. the scrape raced
+        the exporter's periodic registry reset and saw the family
+        empty).  The sample is dropped — folding the zero in would
+        double-count the pre-reset total on the next fresh scrape."""
+        t = FleetTelemetry({}, None, None, scrape=True)
+        t._accumulate("n0", "frames", 10.0, gen=1)  # +10
+        t._accumulate("n0", "frames", 0.0, gen=1)   # misread: dropped
+        t._accumulate("n0", "frames", 14.0, gen=1)  # +4, not +14
+        assert t._accum_total("frames") == pytest.approx(14.0)
+
+    def test_label_value_unescape_is_single_pass(self):
+        """`\\\\n` in the exposition is an escaped backslash followed by
+        a literal n — sequential replaces would corrupt it into a
+        newline; the single-pass unescape must not."""
+        s = parse_prometheus_text(
+            'agent_events{event="a\\\\nb"} 1.0\n'
+            'agent_events{event="q\\"t\\\\\\"u"} 2.0\n'
+            'agent_events{event="real\\nnewline"} 3.0\n'
+        )
+        assert s.value("agent_events", event="a\\nb") == 1.0
+        assert s.value("agent_events", event='q"t\\"u') == 2.0
+        assert s.value("agent_events", event="real\nnewline") == 3.0
+
+
+# ---------------------------------------------------------------------------
+# THE process-mode smoke: SIGKILL mid-scenario, supervised restart,
+# report populated from HTTP scrapes (tier-1's one full scenario)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestProcScenarioSmoke:
+    def test_sigkill_scenario_converges_with_scraped_telemetry(self):
+        """The acceptance scenario: a pipelined process-mode fleet,
+        one node SIGKILLed mid-scenario (multi-chunk transfers in
+        flight around it), respawned by the supervisor two rounds
+        later — the fleet converges, the restarted node re-registers
+        and serves traffic, and the goodput/SLO sections are populated
+        from HTTP scrapes with the dark rounds marked stale."""
+        r0 = counters.get("fleet.node.restarts")
+        scenario = dict(DEFAULT_PROC_SCENARIO,
+                        slo={"min_goodput_bps": 1.0,
+                             "max_dedup_ratio": 1.0})
+        report = run_scenario(scenario)
+        assert report["proc"] is True
+        assert report["converged"], report["rounds"][-1]
+
+        # The kill was real and the supervisor brought the node back.
+        n1 = report["nodes"]["n1"]
+        assert n1["daemon_generation"] == 2
+        assert n1["restarts"] == 1 and not n1["down"]
+        assert counters.get("fleet.node.restarts") == r0 + 1
+        # Its legs were skipped while dark, and ran again after.
+        down_legs = [leg for leg in report["rounds"][1]["legs"]
+                     if "skipped" in leg]
+        assert down_legs, report["rounds"][1]
+        assert all(leg["ok"] for leg in report["rounds"][-1]["legs"])
+        # The chip fault recovered through the worker's own checker.
+        assert report["nodes"]["n2"]["healthy"] \
+            == report["nodes"]["n2"]["total"]
+
+        # Telemetry came over HTTP: the dead node's dark rounds are
+        # stale (not zeros averaged into the SLO), live entries carry
+        # scraped flow accounting, and there is no in-process link
+        # registry behind any of it.
+        rounds = report["telemetry"]["rounds"]
+        assert [s["round"] for s in rounds] == list(range(5))
+        assert any(s["nodes"]["n1"].get("stale") for s in rounds)
+        live = [s["nodes"]["n0"] for s in rounds
+                if not s["nodes"]["n0"].get("stale")]
+        assert live and all("transferred" in e for e in live)
+        assert all(s["links_goodput_bps"] == {} for s in rounds)
+
+        slo = report["slo"]
+        assert slo["ok"], slo
+        assert slo["measured"]["min_goodput_bps"] > 0
+        assert slo["measured"]["stale_entries_skipped"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# The wider process matrix (make fleet-proc; marked slow for tier-1)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestProcScenarios:
+    def test_sigkill_scenario_socket_lane_parity(self):
+        """The same SIGKILL scenario pinned to the socket lane
+        (`shm: false`): the lane moves bytes, never authority, so the
+        process-kill story must hold on both."""
+        report = run_scenario(dict(DEFAULT_PROC_SCENARIO,
+                                   name="proc-sigkill-socket",
+                                   shm=False))
+        assert report["converged"], report["rounds"][-1]
+        assert report["nodes"]["n1"]["daemon_generation"] == 2
+
+    def test_restart_budget_exhaustion_marks_non_converged(
+            self, tmp_path):
+        """Satellite: a spent restart budget is a permanently-down
+        node and a non-converged scenario (fleet_sim exit 2) — not an
+        infinite respawn loop."""
+        b0 = counters.get("fleet.node.budget_exhausted")
+        report = run_scenario({
+            "name": "budget-exhausted", "proc": True, "nodes": 2,
+            "racks": 1, "chips": 2, "topology": "1x2x1", "rounds": 3,
+            "payload_bytes": 2048, "restart_budget": 0,
+            "faults": [
+                {"round": 0, "action": "kill", "node": "n1", "for": 1},
+            ],
+        }, workdir=str(tmp_path))
+        assert not report["converged"]
+        n1 = report["nodes"]["n1"]
+        assert n1["permanently_down"] and n1["down"]
+        assert n1["restarts"] == 0
+        assert counters.get("fleet.node.budget_exhausted") == b0 + 1
+
+    def test_restart_on_live_node_reaps_old_worker(self, tmp_path):
+        """A rolling-restart `restart` on a LIVE node kills and reaps
+        the old worker before spawning its replacement — no orphan
+        holding the node root, its UDS path, or a metrics port."""
+        a = _node(tmp_path, "nr")
+        old_proc, old_pid = a.proc, a.pid
+        try:
+            a.restart_daemon()
+            assert a.pid != old_pid
+            assert old_proc.returncode is not None  # waitpid ran
+            with pytest.raises(ProcessLookupError):
+                os.kill(old_pid, 0)
+            assert a.restarts == 1 and not a.down
+            assert a.snapshot()["daemon_generation"] == 2
+            assert a.all_healthy()
+        finally:
+            a.close()
+
+    def test_receiver_sigkill_mid_transfer_exactly_once(self, tmp_path):
+        """Kill -9 the receiving node process with a pipelined
+        transfer outstanding: the send fails loudly, the supervisor
+        respawns the node, and the caller-level retry lands a
+        byte-exact payload exactly once into the fresh daemon."""
+        a = _node(tmp_path, "na")
+        b = _node(tmp_path, "nb")
+        try:
+            b.client.register_flow("rk", bytes=PIPE_N)
+            a.client.register_flow("rk", bytes=PIPE_N)
+            b.kill_daemon()
+            with pytest.raises(DcnXferError, match="unconfirmed"):
+                dcn_pipeline.send_pipelined(
+                    a.client, "rk", PIPE_PAYLOAD, "127.0.0.1",
+                    b.daemon.data_port, PIPE_CFG, timeout_s=3)
+            b.restart_daemon()
+            assert b.snapshot()["daemon_generation"] == 2
+            b.client.ping()  # reconnect + flow replay re-registers rk
+            res = dcn_pipeline.send_pipelined(
+                a.client, "rk", PIPE_PAYLOAD, "127.0.0.1",
+                b.daemon.data_port, PIPE_CFG, timeout_s=10)
+            assert res["rounds"] == 1
+            _wait_stable_rx(b.client, "rk", PIPE_N)
+            assert dcn_pipeline.read_pipelined(
+                b.client, "rk", PIPE_N, PIPE_CFG) == PIPE_PAYLOAD
+        finally:
+            a.close()
+            b.close()
+
+    def test_shm_crash_cleanup_and_socket_downgrade(self, tmp_path):
+        """Satellite: SIGKILL a node whose flow staged through the shm
+        lane — the dead incarnation's segment files linger on disk
+        (no teardown ran), the restarted daemon wipes them on start,
+        and a capability-less respawn downgrades the peer's client to
+        the socket lane on the SAME flow with exactly-once
+        accounting."""
+        cfg = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                          shm=True)
+        a = _node(tmp_path, "na")
+        b = _node(tmp_path, "nb")
+        try:
+            b.client.register_flow("dg", bytes=PIPE_N)
+            a.client.register_flow("dg", bytes=PIPE_N)
+            res = dcn_pipeline.send_pipelined(
+                a.client, "dg", PIPE_PAYLOAD, "127.0.0.1",
+                b.daemon.data_port, cfg, timeout_s=10)
+            assert res["lane"] == "shm"
+            assert dcn_pipeline.read_pipelined(
+                b.client, "dg", PIPE_N, cfg) == PIPE_PAYLOAD
+
+            a.kill_daemon()  # SIGKILL: zero teardown lines run
+            assert os.listdir(a.shm_dir)  # crash-lingering segments
+
+            # Respawn without the capability: wipe-on-start removes
+            # the dead incarnation's files, and lane selection (a
+            # reconnect re-probes the handshake) downgrades.
+            a.restart_daemon(extra_env={"TPU_DCN_SHM": "0"})
+            assert not os.path.isdir(a.shm_dir) \
+                or not os.listdir(a.shm_dir)
+            a.client.ping()  # reconnect + flow replay + re-probe
+            res = dcn_pipeline.send_pipelined(
+                a.client, "dg", PIPE_PAYLOAD[::-1], "127.0.0.1",
+                b.daemon.data_port, cfg, timeout_s=10)
+            assert res["lane"] == "socket"
+            _wait_stable_rx(b.client, "dg", 2 * PIPE_N)  # exactly once
+            assert dcn_pipeline.read_pipelined(
+                b.client, "dg", PIPE_N, cfg) == PIPE_PAYLOAD[::-1]
+        finally:
+            a.close()
+            b.close()
+
+    def test_lost_response_replay_dedups_with_scraped_evidence(
+            self, tmp_path):
+        """Kill-mid-send, lost-response edition, across real process
+        boundaries: the sender worker's daemon streams a chunk but the
+        answer dies with the connection; the retry round re-sends the
+        SAME seqs and the receiver WORKER's dedup window drops the
+        replay — proven from its scraped counters, not this process's
+        registries."""
+        a = _node(tmp_path, "na")
+        b = _node(tmp_path, "nb")
+        try:
+            b.client.register_flow("pk", bytes=PIPE_N)
+            a.client.register_flow("pk", bytes=PIPE_N)
+            a.drop_response_once("send")
+            res = dcn_pipeline.send_pipelined(
+                a.client, "pk", PIPE_PAYLOAD, "127.0.0.1",
+                b.daemon.data_port, PIPE_CFG, timeout_s=10)
+            assert res["rounds"] >= 2  # the lost answer forced a retry
+            _wait_stable_rx(b.client, "pk", PIPE_N)
+            s = _scrape_after_collect(b.metrics_port)
+            assert s.value("agent_events",
+                           event="dcn.frames.deduped") == 1.0
+            assert s.value("agent_events",
+                           event="xferd.frames.landed") == 4.0
+            assert dcn_pipeline.read_pipelined(
+                b.client, "pk", PIPE_N, PIPE_CFG) == PIPE_PAYLOAD
+        finally:
+            a.close()
+            b.close()
+
+    def test_sigterm_dumps_flight_recorder_before_exit(self, tmp_path):
+        """Satellite: the supervisor's SIGTERM makes a worker dump its
+        flight recorder (what it was DOING) before dying — the
+        evidence outlives the process."""
+        with tempfile.TemporaryFile(mode="w+") as err:
+            a = ProcNode(_spec("na"), str(tmp_path / "na"), stderr=err,
+                         env=dict(os.environ))
+            try:
+                a.proc.send_signal(signal.SIGTERM)
+                a.proc.wait(timeout=15)
+                assert a.proc.returncode == 0  # clean exit, post-dump
+                err.seek(0)
+                stderr = err.read()
+            finally:
+                a.close()
+        assert "TPU_FLIGHT_RECORDER" in stderr
+        blob = json.loads(
+            next(l for l in stderr.splitlines()
+                 if l.startswith("TPU_FLIGHT_RECORDER "))
+            .split(" ", 1)[1])
+        assert "SIGTERM" in blob["reason"]
+        assert blob["pid"] == a.pid
+
+    def test_fleet_sim_cli_proc_scenario(self):
+        """`make fleet-proc`'s CLI leg in miniature: --proc runs the
+        built-in SIGKILL scenario, exits 0, and the JSON report says
+        process mode."""
+        env = dict(os.environ)
+        env.pop("TPU_FAULT_SPEC", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "cmd", "fleet_sim.py"),
+             "--proc", "--rounds", "5"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["proc"] is True and report["converged"]
+        assert report["nodes"]["n1"]["daemon_generation"] == 2

@@ -251,6 +251,26 @@ def test_agent_events_end_to_end_scrape(tmp_path):
         server.stop()
 
 
+def test_reset_republishes_cumulative_state_immediately(tmp_path):
+    """The registry has no scrape-wide lock: a GET landing between
+    `_reset`'s clears and the next collection pass must still see the
+    agent families — `_reset` itself republishes the cumulative state,
+    so the empty window never exists."""
+    counters.inc("reset.race.marker", 4)
+    registry = CollectorRegistry()
+    server = MetricServer(
+        collector=MockCollector({}),
+        registry=registry,
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+    )
+    server.collect_once()
+    assert registry.get_sample_value(
+        "agent_events", {"event": "reset.race.marker"}) == 4
+    server._reset()  # no collect_once after: the reset alone must republish
+    assert registry.get_sample_value(
+        "agent_events", {"event": "reset.race.marker"}) == 4
+
+
 def test_port_conflict_at_boot_is_retried(tmp_path):
     """ROADMAP satellite: a squatted port at boot must cost backoff
     rounds, not the DaemonSet pod — the server comes up as soon as the
