@@ -126,10 +126,41 @@ dcnbench:
 	$(PY) cmd/dcn_bench.py --compare \
 	    --sizes 65536,1048576,4194304 --iters 3
 
+# Invariant lint gate (analysis/lint.py rule registry via
+# cmd/agent_lint.py): exit 0 clean, 1 findings, 2 internal error.
+# Inline suppressions must name their rule (# lint: disable=<rule>).
+.PHONY: lint
+lint:
+	$(PY) cmd/agent_lint.py
+
+# Race gate — the `go test -race` analog for the Python surface
+# (ref Makefile:20-36 runs the race detector on every unit suite).
+# The DCN pipeline, fleet (in-process + multi-process), chaos, and obs
+# suites run with the lockwatch shim armed (TPU_LOCKWATCH=1 patches
+# the lock allocators at package import; worker subprocesses inherit
+# it), every process appends its lock-order graph + findings to one
+# JSONL report, and the checker fails on any lock-order inversion or
+# un-annotated blocking call under a lock.  Deliberate
+# serialize-a-stream locks (NRI trunk mux, PyXferd peer streams) are
+# annotated with lockwatch.blocking_ok and land in `allowed`.
+RACE_REPORT := /tmp/tpu_lockwatch_report.jsonl
+
+.PHONY: race
+race:
+	rm -f $(RACE_REPORT)
+	TPU_LOCKWATCH=1 TPU_LOCKWATCH_REPORT=$(RACE_REPORT) \
+	    $(PY) -m pytest tests/test_dcn_pipeline.py tests/test_fleet.py \
+	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
+	    -q -m "not slow" -p no:randomly
+	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
+	    --check $(RACE_REPORT)
+
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests
 	bash build/check_boilerplate.sh
 	bash build/check_shell.sh
+	$(MAKE) lint
+	$(MAKE) race
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to
 # BENCH_TPU_LOG.jsonl). Each stage is independent; failures don't stop
@@ -170,44 +201,90 @@ watch-hw:
 watch-hw-stop:
 	-kill $$(cat .hw_watcher.pid) 2>/dev/null && rm -f .hw_watcher.pid
 
-# Sanitizer build + test of the native daemon — the `go test -race`
-# analog for our C++ surface (ref: Makefile:20-22 runs the unit suite
-# under the race detector on every CI run).
+# Sanitizer builds of the native surface — the `go test -race` analog
+# for our C++ binaries (ref: Makefile:20-22 runs the unit suite under
+# the race detector on every CI run).  Every native binary gets an
+# ASan+UBSan and a TSan build; `make sanitize` builds all ten.
+# dcnxferd additionally runs its unit suite under each sanitizer
+# (test-asan / test-tsan) — it is the one with a protocol test suite;
+# the rest are compile-and-instrument gates until theirs grow.
+ASAN_FLAGS := -std=c++17 -O1 -g -Wall -Wextra \
+    -fsanitize=address,undefined -fno-omit-frame-pointer
+TSAN_FLAGS := -std=c++17 -O1 -g -Wall -Wextra \
+    -fsanitize=thread -fno-omit-frame-pointer
+
 ASAN_BUILD := native/dcnxferd/build-asan
+TSAN_BUILD := native/dcnxferd/build-tsan
 
-.PHONY: native-asan test-asan
+.PHONY: native-asan native-tsan test-asan test-tsan sanitize
 
-native-asan: $(ASAN_BUILD)/dcnxferd
+native-asan: $(ASAN_BUILD)/dcnxferd \
+	native/tpushim/build-asan/libtpushim.so \
+	native/dcnfastsock/build-asan/libdcnfastsock.so \
+	native/dcncollperf/build-asan/dcn_collectives_perf \
+	native/tokpack/build-asan/tokpack
+
+native-tsan: $(TSAN_BUILD)/dcnxferd \
+	native/tpushim/build-tsan/libtpushim.so \
+	native/dcnfastsock/build-tsan/libdcnfastsock.so \
+	native/dcncollperf/build-tsan/dcn_collectives_perf \
+	native/tokpack/build-tsan/tokpack
+
+sanitize: native-asan native-tsan
 
 $(ASAN_BUILD)/dcnxferd: native/dcnxferd/dcnxferd.cc
 	mkdir -p $(ASAN_BUILD)
-	g++ -std=c++17 -O1 -g -Wall -Wextra \
-	    -fsanitize=address,undefined -fno-omit-frame-pointer \
-	    -o $(ASAN_BUILD)/dcnxferd native/dcnxferd/dcnxferd.cc
-
-test-asan: native-asan
-	DCNXFERD_BIN=$(ASAN_BUILD)/dcnxferd \
-	    $(PY) -m pytest tests/test_dcnxferd.py -x -q
-
-# TSan build + test — the race half of the `go test -race` analog.
-# dcnxferd is a single-threaded poll loop TODAY; the gate costs one
-# rebuild and guards the day that changes (the reference runs -race
-# unconditionally, Makefile:20-22).  The genuinely threaded Python
-# components get deliberate stress tests instead
-# (tests/test_concurrency_stress.py).
-TSAN_BUILD := native/dcnxferd/build-tsan
-
-.PHONY: native-tsan test-tsan
-
-native-tsan: $(TSAN_BUILD)/dcnxferd
+	g++ $(ASAN_FLAGS) -o $@ native/dcnxferd/dcnxferd.cc
 
 $(TSAN_BUILD)/dcnxferd: native/dcnxferd/dcnxferd.cc
 	mkdir -p $(TSAN_BUILD)
-	g++ -std=c++17 -O1 -g -Wall -Wextra \
-	    -fsanitize=thread -fno-omit-frame-pointer \
-	    -o $(TSAN_BUILD)/dcnxferd native/dcnxferd/dcnxferd.cc
+	g++ $(TSAN_FLAGS) -o $@ native/dcnxferd/dcnxferd.cc
 
-test-tsan: native-tsan
+native/tpushim/build-asan/libtpushim.so: native/tpushim/tpushim.cc \
+		native/tpushim/tpushim.h
+	mkdir -p $(dir $@)
+	g++ $(ASAN_FLAGS) -fPIC -shared -o $@ native/tpushim/tpushim.cc
+
+native/tpushim/build-tsan/libtpushim.so: native/tpushim/tpushim.cc \
+		native/tpushim/tpushim.h
+	mkdir -p $(dir $@)
+	g++ $(TSAN_FLAGS) -fPIC -shared -o $@ native/tpushim/tpushim.cc
+
+native/dcnfastsock/build-asan/libdcnfastsock.so: \
+		native/dcnfastsock/dcnfastsock.cc
+	mkdir -p $(dir $@)
+	g++ $(ASAN_FLAGS) -fPIC -shared -o $@ \
+	    native/dcnfastsock/dcnfastsock.cc -ldl
+
+native/dcnfastsock/build-tsan/libdcnfastsock.so: \
+		native/dcnfastsock/dcnfastsock.cc
+	mkdir -p $(dir $@)
+	g++ $(TSAN_FLAGS) -fPIC -shared -o $@ \
+	    native/dcnfastsock/dcnfastsock.cc -ldl
+
+native/dcncollperf/build-asan/dcn_collectives_perf: \
+		native/dcncollperf/dcn_collectives_perf.cc
+	mkdir -p $(dir $@)
+	g++ $(ASAN_FLAGS) -o $@ native/dcncollperf/dcn_collectives_perf.cc
+
+native/dcncollperf/build-tsan/dcn_collectives_perf: \
+		native/dcncollperf/dcn_collectives_perf.cc
+	mkdir -p $(dir $@)
+	g++ $(TSAN_FLAGS) -o $@ native/dcncollperf/dcn_collectives_perf.cc
+
+native/tokpack/build-asan/tokpack: native/tokpack/tokpack.cc
+	mkdir -p $(dir $@)
+	g++ $(ASAN_FLAGS) -o $@ native/tokpack/tokpack.cc
+
+native/tokpack/build-tsan/tokpack: native/tokpack/tokpack.cc
+	mkdir -p $(dir $@)
+	g++ $(TSAN_FLAGS) -o $@ native/tokpack/tokpack.cc
+
+test-asan: $(ASAN_BUILD)/dcnxferd
+	DCNXFERD_BIN=$(ASAN_BUILD)/dcnxferd \
+	    $(PY) -m pytest tests/test_dcnxferd.py -x -q
+
+test-tsan: $(TSAN_BUILD)/dcnxferd
 	DCNXFERD_BIN=$(TSAN_BUILD)/dcnxferd \
 	    $(PY) -m pytest tests/test_dcnxferd.py -x -q
 
@@ -248,4 +325,5 @@ proto:
 
 clean:
 	rm -rf $(NATIVE_BUILD) $(DCNXFERD_BUILD) $(DCNFASTSOCK_BUILD) \
-	    $(DCNCOLLPERF_BUILD) $(ASAN_BUILD) $(TSAN_BUILD) $(TOKPACK_BUILD)
+	    $(DCNCOLLPERF_BUILD) $(ASAN_BUILD) $(TSAN_BUILD) $(TOKPACK_BUILD) \
+	    native/*/build-asan native/*/build-tsan

@@ -243,13 +243,16 @@ def _demo_server():
     counters.inc("dcn.reconnect.success", 3)
     counters.inc("dcn.frames.deduped")
     timeseries.record("xferd.rx.bytes", 6 << 20)
-    timeseries.record("goodput.link.n0->n1", 4 << 20)
-    timeseries.record("goodput.flow.demo.ring", 2 << 20)
+    # Concrete demo instances of the documented goodput.<scope>.<name>
+    # / slo.<key>.* families (README metrics tables) — the names here
+    # are sample data, not new families.
+    timeseries.record("goodput.link.n0->n1", 4 << 20)  # lint: disable=undocumented-metric
+    timeseries.record("goodput.flow.demo.ring", 2 << 20)  # lint: disable=undocumented-metric
     timeseries.gauge("dcn.chunks.inflight", 3)
     timeseries.gauge("dcn.stripes.active", 2)
     timeseries.gauge("dcn.stripes.configured", 2)
-    timeseries.gauge("slo.min_goodput_bps.ok", 1)
-    timeseries.gauge("slo.min_goodput_bps.value", 4 << 20)
+    timeseries.gauge("slo.min_goodput_bps.ok", 1)  # lint: disable=undocumented-metric
+    timeseries.gauge("slo.min_goodput_bps.value", 4 << 20)  # lint: disable=undocumented-metric
 
     server = MetricServer(
         collector=_NoChips(), registry=CollectorRegistry(), port=0,

@@ -56,6 +56,7 @@ from container_engine_accelerators_tpu.parallel import (  # noqa: E402
     dcn_pipeline,
 )
 from container_engine_accelerators_tpu.parallel.dcn_client import (  # noqa: E402
+    DcnXferError,
     ResilientDcnXferClient,
 )
 
@@ -184,8 +185,8 @@ class BenchRig:
             for client in (self.ca, self.cb):
                 try:
                     client.release_flow(flow)
-                except Exception:
-                    pass
+                except (DcnXferError, OSError):
+                    pass  # bench teardown: next cell gets fresh flows
 
 
 def run_sweep(sizes, iters, cfg, sink, table=sys.stderr):

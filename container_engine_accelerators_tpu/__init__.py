@@ -32,3 +32,17 @@ This package provides the TPU-native equivalent of every component:
 __version__ = "0.1.0"
 
 TPU_RESOURCE_NAME = "google.com/tpu"
+
+# Concurrency shim (analysis/lockwatch.py): when TPU_LOCKWATCH=1, patch
+# the lock allocators BEFORE any submodule constructs its locks — this
+# import hook is what lets `make race` instrument every production
+# module (and every fleet worker subprocess, which inherits the env)
+# with zero code changes.  Stdlib-only at import; a no-op otherwise.
+import os as _os
+
+if _os.environ.get("TPU_LOCKWATCH") == "1":
+    from container_engine_accelerators_tpu.analysis import (
+        lockwatch as _lockwatch,
+    )
+
+    _lockwatch.install()

@@ -1,0 +1,27 @@
+"""Concurrency-correctness toolchain: the stack's ``-race`` analog.
+
+The reference repo runs Go's race detector on every unit suite
+(ref Makefile:20-36, ``test = unit suite with race detection``); this
+package is the Python port's equivalent, grown after the pipelined DCN
+data plane, PyXferd, the fleet coordinator, and the metric server
+crossed fifteen thread-spawn sites and eighteen lock sites with no
+tooling watching them:
+
+- ``lockwatch`` — a dynamic lock-order race detector: instrumented
+  ``threading.Lock``/``RLock`` wrappers (monkey-patch shim, activated
+  by ``TPU_LOCKWATCH=1`` — production modules need no code changes)
+  that record per-thread acquisition stacks, build a cross-thread
+  lock-order graph, and report order cycles (potential deadlock /
+  ABBA inversion) plus blocking calls made while holding a lock
+  (socket IO, ``subprocess`` waits, long sleeps).  ``make race`` runs
+  the DCN/fleet/obs suites under it and gates on zero findings.
+
+- ``lint`` — an AST invariant engine enforcing the project rules
+  previous PRs learned the hard way: hardened sends only
+  (``utils/netio``), injectable clocks in clock-sensitive modules,
+  no bare/broad-swallowed excepts, explicit ``daemon=`` decisions on
+  every thread, no fire-and-forget non-daemon spawns, and no
+  undocumented counter/gauge/histogram/series names.  ``make lint``
+  (``cmd/agent_lint.py``) gates on zero findings; inline
+  ``# lint: disable=<rule>`` suppressions must name their rule.
+"""

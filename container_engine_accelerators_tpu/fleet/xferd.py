@@ -103,6 +103,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from container_engine_accelerators_tpu.analysis import lockwatch
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import timeseries, trace
 from container_engine_accelerators_tpu.parallel import dcn_shm
@@ -297,7 +298,12 @@ class _PeerConn:
         self.sock: Optional[socket.socket] = None
 
     def send_frame(self, host: str, port: int, parts) -> None:
-        with self.lock:
+        # Serializing the whole frame under the lock IS the contract
+        # (concurrent stripes interleaving bytes would corrupt the
+        # stream) — a deliberate blocking-under-lock, annotated so
+        # `make race` books it under `allowed` instead of failing.
+        with self.lock, lockwatch.blocking_ok(
+                "xferd.peer: frames on one stream must not interleave"):
             if self.sock is None:
                 s = socket.create_connection((host, port), timeout=30)
                 _set_nodelay(s)
@@ -480,7 +486,8 @@ class PyXferd:
                         pass
                     break
                 try:
-                    conn.sendall((json.dumps(resp) + "\n").encode())
+                    netio.sendall(conn,
+                                  (json.dumps(resp) + "\n").encode())
                 except OSError:
                     break
         finally:

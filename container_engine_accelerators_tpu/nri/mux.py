@@ -13,6 +13,9 @@ import struct
 import threading
 from typing import Dict
 
+from container_engine_accelerators_tpu.analysis import lockwatch
+from container_engine_accelerators_tpu.utils import netio
+
 HEADER_LEN = 8
 MAX_PAYLOAD = 1 << 24
 
@@ -76,7 +79,16 @@ class Mux:
             raise ValueError(f"mux payload {len(data)} exceeds maximum")
         frame = struct.pack(">II", conn_id, len(data)) + data
         with self._write_lock:
-            self._sock.sendall(frame)
+            # Holding the write lock across the whole frame IS the
+            # framing guarantee (two logical conns interleaving bytes
+            # would desynchronize the trunk) — a deliberate
+            # blocking-under-lock, annotated so `make race` counts it
+            # under `allowed`, and a hardened send: containerd trunks
+            # carry multi-MiB UpdateContainers payloads, and a short
+            # write would break every frame after it.
+            with lockwatch.blocking_ok(
+                    "nri.mux: trunk frames must not interleave"):
+                netio.sendall(self._sock, frame)
 
     def start_reader(self) -> threading.Thread:
         """Demultiplex trunk frames into logical conns until socket EOF."""

@@ -118,7 +118,8 @@ class DcnXferClient:
                 if ctx is not None:
                     req.setdefault("trace", ctx["trace"])
                     req.setdefault("span", ctx["span"])
-                self._sock.sendall((json.dumps(req) + "\n").encode())
+                netio.sendall(self._sock,
+                              (json.dumps(req) + "\n").encode())
                 line = self._rfile.readline()
             except (socket.timeout, OSError) as e:
                 # After a timeout the buffered reader may hold a partial

@@ -211,7 +211,7 @@ def fetch_range(host: str, port: int, flow: str, offset: int,
         sock = socket.create_connection((host, port), timeout=timeout_s)
         _set_nodelay(sock)
     try:
-        sock.sendall(req)
+        netio.sendall(sock, req)
         hdr = _recv_exact(sock, 8)
         avail = struct.unpack("<Q", hdr)[0]
         return _recv_exact(sock, avail)

@@ -135,8 +135,8 @@ class NativeTpuLib(TpuLib):
     def __del__(self):  # best-effort
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # lint: disable=swallowed-exception
+            pass  # finalizers must never raise (interpreter teardown)
 
     # -- enumeration ---------------------------------------------------------
 

@@ -46,6 +46,50 @@ if "xla_force_host_platform_device_count" not in _flags:
 import pytest  # noqa: E402
 
 
+# Long-lived harness threads the leak gate must tolerate: pytest's own
+# machinery, concurrent.futures pools parked by design (jax/XLA host
+# callbacks), and foreign C threads surfacing as Dummy-*.  Everything
+# the stack itself spawns is daemon= by decision (enforced by the
+# thread-daemon lint rule), so a NON-daemon survivor here is a test
+# bug: a worker someone forgot to join.
+_THREAD_ALLOWLIST_PREFIXES = (
+    "pytest",
+    "Dummy-",
+    "ThreadPoolExecutor",
+    "asyncio_",
+)
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_gate():
+    """Fail any test that leaves a new non-daemon thread alive after
+    teardown (with a short join grace for workers mid-wind-down).
+    Daemon threads get a pass — they cannot wedge interpreter
+    shutdown, and the suite's servers/daemons all use them."""
+    import threading
+    import time as _time
+
+    before = set(threading.enumerate())
+    yield
+
+    def _leaked():
+        return [
+            t for t in threading.enumerate()
+            if t not in before and t.is_alive() and not t.daemon
+            and not t.name.startswith(_THREAD_ALLOWLIST_PREFIXES)
+        ]
+
+    deadline = _time.monotonic() + 2.0
+    while _leaked() and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    left = _leaked()
+    assert not left, (
+        f"test leaked non-daemon thread(s): "
+        f"{sorted(t.name for t in left)} — join them in teardown (or "
+        f"mark an intentionally long-lived harness thread daemon=True)"
+    )
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_protocol(item):
     """Per-test deadman switch.

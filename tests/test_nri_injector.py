@@ -342,3 +342,31 @@ def test_mux_rejects_oversized_frame():
         conn.read_exact(1)
     a.close()
     b.close()
+
+
+def test_mux_large_frame_survives_the_trunk():
+    """Multi-MiB trunk frames arrive complete and uncorrupted: the mux
+    write path rides netio.sendall (capped per-syscall, short-write
+    proof) — this rig's loopback stack truncates very large
+    single-syscall sends, and one short write on the trunk would
+    desynchronize every frame after it (the PR 6 lesson, now pinned
+    here and enforced repo-wide by the raw-socket-send lint rule)."""
+    a, b = socket.socketpair()
+    tx, rx = nri_mux.Mux(a), nri_mux.Mux(b)
+    conn_tx = tx.open(1)
+    conn_rx = rx.open(1)
+    rx.start_reader()
+    payload = bytes(range(256)) * (4 << 12)  # 4 MiB, patterned
+    trailer = b"after-the-big-one"
+    writer = threading.Thread(
+        target=lambda: (conn_tx.write(payload), conn_tx.write(trailer)),
+        daemon=True)
+    writer.start()
+    got = conn_rx.read_exact(len(payload))
+    assert got == payload  # complete AND byte-exact
+    # Framing stayed synchronized: the next frame reads clean too.
+    assert conn_rx.read_exact(len(trailer)) == trailer
+    writer.join(timeout=30)
+    assert not writer.is_alive()
+    for s in (a, b):
+        s.close()
