@@ -115,6 +115,26 @@ fleet-proc:
 	$(PY) -m pytest tests/test_fleet_proc.py -q -p no:randomly
 	$(PY) cmd/fleet_sim.py --proc > /dev/null
 
+# Serving-under-chaos gate: the ServingFrontend (admission control,
+# batching, hedged retries, per-node breakers) over the fleet rig —
+# the full serving suite (hedge exactly-once, breaker state machine,
+# link-shim semantics, the scenario matrix), then the three headline
+# scenarios by CLI: a node SIGKILLed mid-load (in-process), a rack
+# partition degrading and healing, and a proc-mode run where link
+# faults ride the PyXferd link shim over the worker RPC plus a real
+# SIGKILL.  Exit codes gate: 2 = not converged / lost requests,
+# 3 = converged but a serving SLO (min_qps / max_error_ratio /
+# p99_e2e_ms) breached.  Finally the sustained-QPS trajectory series.
+.PHONY: fleet-serve
+fleet-serve:
+	$(PY) -m pytest tests/test_serving.py -q -p no:randomly
+	$(PY) cmd/fleet_sim.py --workload serving > /dev/null
+	$(PY) cmd/fleet_sim.py \
+	    --scenario scenarios/serving_rack_partition.json > /dev/null
+	$(PY) cmd/fleet_sim.py \
+	    --scenario scenarios/serving_proc_linkfault.json > /dev/null
+	$(PY) cmd/bench_serving.py --fleet --fleet-seconds 2 > /dev/null
+
 # DCN data-plane gate: the serial / pipelined-socket / shm microbench
 # on the loopback rig, with a memcpy reference series in the same
 # JSONL.  --compare exits non-zero if the pipelined lane falls below
@@ -151,6 +171,7 @@ race:
 	TPU_LOCKWATCH=1 TPU_LOCKWATCH_REPORT=$(RACE_REPORT) \
 	    $(PY) -m pytest tests/test_dcn_pipeline.py tests/test_fleet.py \
 	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
+	    tests/test_serving.py \
 	    -q -m "not slow" -p no:randomly
 	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
 	    --check $(RACE_REPORT)
@@ -161,6 +182,7 @@ presubmit:
 	bash build/check_shell.sh
 	$(MAKE) lint
 	$(MAKE) race
+	$(MAKE) fleet-serve
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to
 # BENCH_TPU_LOG.jsonl). Each stage is independent; failures don't stop

@@ -45,7 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from container_engine_accelerators_tpu.obs import promtext  # noqa: E402
 
 FAMILIES = ("agent_rate", "agent_goodput", "agent_gauge",
-            "agent_latency", "agent_exemplar")
+            "agent_latency", "agent_exemplar", "agent_events")
 
 
 def parse_args(argv=None):
@@ -146,8 +146,36 @@ def digest(fams: dict) -> dict:
                 continue
         gauges.append((name, v))
     gauges.sort()
+
+    # The serving workload's one-glance panel: live QPS/shed rates,
+    # cumulative hedge/breaker evidence, queue depth — present only
+    # when the scraped node actually serves.
+    rate_by = dict(rates)
+    gauge_by = dict(gauges)
+    event_by = {lb.get("event", "?"): v
+                for lb, v in fams["agent_events"]}
+    serving = None
+    if any(k.startswith("serving.")
+           for k in (*rate_by, *gauge_by, *event_by)):
+        serving = {
+            "qps": rate_by.get("serving.ok", 0.0),
+            "shed_per_s": rate_by.get("serving.shed", 0.0),
+            "queue_depth": gauge_by.get("serving.queue.depth", 0.0),
+            "inflight": gauge_by.get("serving.inflight", 0.0),
+            "breaker_open": gauge_by.get("serving.breaker.open_nodes",
+                                         0.0),
+            "ok_total": event_by.get("serving.ok", 0.0),
+            "errors_total": event_by.get("serving.errors", 0.0),
+            "shed_total": event_by.get("serving.shed", 0.0),
+            "hedge": {
+                "fired": event_by.get("serving.hedge.fired", 0.0),
+                "won": event_by.get("serving.hedge.won", 0.0),
+                "wasted": event_by.get("serving.hedge.wasted", 0.0),
+            },
+        }
     return {"rates": rates, "goodput": goodput,
-            "latency": latency, "gauges": gauges, "slos": slos}
+            "latency": latency, "gauges": gauges, "slos": slos,
+            "serving": serving}
 
 
 # -- render ------------------------------------------------------------------
@@ -173,6 +201,28 @@ def render(model: dict, source: str, top_n: int = 10) -> str:
             ok = entry.get("ok", 0.0) >= 1.0
             lines.append(f"  {key:<24} {entry.get('value', 0.0):>14.3f} "
                          f"{'ok' if ok else '** BREACH **'}")
+
+    serving = model.get("serving")
+    if serving:
+        h = serving["hedge"]
+        lines.append("")
+        lines.append("serving:")
+        lines.append(f"  {'qps (windowed)':<24} {serving['qps']:>14.1f}")
+        lines.append(f"  {'shed/s':<24} "
+                     f"{serving['shed_per_s']:>14.2f}")
+        lines.append(f"  {'queue depth':<24} "
+                     f"{serving['queue_depth']:>14.0f}")
+        lines.append(f"  {'batches in flight':<24} "
+                     f"{serving['inflight']:>14.0f}")
+        lines.append(f"  {'breakers open':<24} "
+                     f"{serving['breaker_open']:>14.0f}")
+        lines.append(f"  {'ok / errors / shed':<24} "
+                     f"{serving['ok_total']:>6.0f} / "
+                     f"{serving['errors_total']:.0f} / "
+                     f"{serving['shed_total']:.0f}")
+        lines.append(f"  {'hedge fired/won/wasted':<24} "
+                     f"{h['fired']:>6.0f} / {h['won']:.0f} / "
+                     f"{h['wasted']:.0f}")
 
     goodput = [g for g in model["goodput"]][:top_n]
     if goodput:
@@ -253,6 +303,19 @@ def _demo_server():
     timeseries.gauge("dcn.stripes.configured", 2)
     timeseries.gauge("slo.min_goodput_bps.ok", 1)  # lint: disable=undocumented-metric
     timeseries.gauge("slo.min_goodput_bps.value", 4 << 20)  # lint: disable=undocumented-metric
+    # The serving workload's panel (serving/frontend.py families).
+    counters.inc("serving.requests", 40)
+    counters.inc("serving.ok", 38)
+    counters.inc("serving.errors", 1)
+    counters.inc("serving.shed", 1)
+    counters.inc("serving.hedge.fired", 3)
+    counters.inc("serving.hedge.won", 1)
+    counters.inc("serving.hedge.wasted", 2)
+    timeseries.gauge("serving.queue.depth", 4)
+    timeseries.gauge("serving.inflight", 2)
+    timeseries.gauge("serving.breaker.open_nodes", 1)
+    timeseries.gauge("slo.min_qps.ok", 1)  # lint: disable=undocumented-metric
+    timeseries.gauge("slo.min_qps.value", 38.0)  # lint: disable=undocumented-metric
 
     server = MetricServer(
         collector=_NoChips(), registry=CollectorRegistry(), port=0,

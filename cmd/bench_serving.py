@@ -35,6 +35,19 @@ matmul tiling (see models/batching.py).
 
 Run CPU (committed evidence; launch with the TPU harness env unset —
 tests/conftest.py) or on-chip via the watcher stage list.
+
+**Fleet mode** (``--fleet``): the production-scale trajectory metric.
+Instead of one model engine, boots an in-process fleet (emulated
+nodes, real daemons + resilient clients) with a ServingFrontend on
+top (admission control, batching, hedged retries, breakers —
+serving/frontend.py) and drives a closed-loop request load for
+``--fleet-seconds``.  Emits one JSONL record per second window (the
+sustained-QPS series) plus the headline line::
+
+  {"metric": "serving_fleet_sustained_qps", "value": <req/s>, ...}
+
+Fleet mode is jax-free — it measures the serving stack, not the
+model math — so it runs in the barest CI container.
 """
 
 import argparse
@@ -48,6 +61,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet serving throughput: drive a "
+                        "ServingFrontend over an in-process emulated "
+                        "fleet and record sustained QPS (jax-free)")
+    p.add_argument("--fleet-nodes", type=int, default=3)
+    p.add_argument("--fleet-seconds", type=float, default=3.0)
+    p.add_argument("--fleet-payload", type=int, default=4096,
+                   help="per-request payload bytes (the shard read)")
+    p.add_argument("--fleet-inflight", type=int, default=32,
+                   help="closed-loop concurrency: requests kept "
+                        "outstanding")
+    p.add_argument("--fleet-batch", type=int, default=8,
+                   help="frontend max_batch")
+    p.add_argument("--fleet-min-qps", type=float, default=0.0,
+                   help="exit non-zero when sustained QPS lands below "
+                        "this floor (the regression gate)")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--requests", type=int, default=12)
     p.add_argument("--prompt-lens", default="8,24,48",
@@ -96,8 +125,132 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def fleet_main(args) -> int:
+    """--fleet: sustained QPS through the serving frontend over an
+    in-process emulated fleet (no jax, no model — the serving stack
+    is the thing under test)."""
+    from collections import deque
+
+    from container_engine_accelerators_tpu.fleet.controller import (
+        FleetController,
+    )
+    from container_engine_accelerators_tpu.serving.frontend import (
+        RequestShed,
+    )
+
+    scenario = {
+        "name": "bench-serving-fleet",
+        "workload": "serving",
+        "nodes": args.fleet_nodes,
+        "racks": 1,
+        "chips": 2,
+        "topology": "1x2x1",
+        "rounds": 0,
+        "payload_bytes": args.fleet_payload,
+        "serving": {
+            "max_batch": args.fleet_batch,
+            "max_wait_ms": 2.0,
+            "admission_capacity": max(64, 2 * args.fleet_inflight),
+        },
+    }
+    ctl = FleetController(scenario).boot()
+    try:
+        fe = ctl.frontend
+        pending: "deque" = deque()
+        ok = errors = shed = submitted = 0
+        t0 = time.monotonic()
+        next_mark = t0 + 1.0
+        ok_at_mark = 0
+        windows = []
+        deadline = t0 + max(0.5, args.fleet_seconds)
+        payload_of = lambda i: bytes([i % 256]) * args.fleet_payload  # noqa: E731
+        while time.monotonic() < deadline:
+            while len(pending) < args.fleet_inflight:
+                p = payload_of(submitted)
+                try:
+                    pending.append((fe.submit(p), p))
+                    submitted += 1
+                except RequestShed:
+                    shed += 1
+                    break
+            head, _ = pending[0]
+            head.wait(0.02)
+            # Reap EVERY completed request, not just the head: hedged
+            # and failed-over batches resolve out of order, and a
+            # stuck head-of-line batch must not pin finished requests
+            # in `pending` (they count against the inflight cap, so
+            # head-only reaping would stall the refill loop and
+            # understate sustained QPS).
+            for _ in range(len(pending)):
+                req, payload = pending.popleft()
+                if not req.done():
+                    pending.append((req, payload))
+                    continue
+                if req.error is None and req.result == payload:
+                    ok += 1
+                else:
+                    errors += 1
+            now = time.monotonic()
+            if now >= next_mark:
+                windows.append({
+                    "mode": "fleet-serving",
+                    "window_s": round(now - t0, 1),
+                    "qps": ok - ok_at_mark,
+                    "inflight": len(pending),
+                })
+                ok_at_mark = ok
+                next_mark = now + 1.0
+        # Drain: every outstanding request must terminate (the
+        # zero-lost invariant the chaos gates pin).
+        drain_by = time.monotonic() + 30.0
+        while pending and time.monotonic() < drain_by:
+            req, payload = pending.popleft()
+            if not req.wait(max(0.0, drain_by - time.monotonic())):
+                errors += 1
+                continue
+            if req.error is None and req.result == payload:
+                ok += 1
+            else:
+                errors += 1
+        elapsed = time.monotonic() - t0
+        qps = ok / max(elapsed, 1e-9)
+        for w in windows:
+            print(json.dumps(w))
+        result = {
+            "metric": "serving_fleet_sustained_qps",
+            "value": round(qps, 2),
+            "unit": f"req/s ({args.fleet_nodes} nodes, "
+                    f"{args.fleet_payload} B shard reads, closed loop "
+                    f"x{args.fleet_inflight})",
+            "ok": ok,
+            "errors": errors,
+            "shed": shed,
+            "elapsed_s": round(elapsed, 2),
+            "nodes": args.fleet_nodes,
+            "payload_bytes": args.fleet_payload,
+            "inflight": args.fleet_inflight,
+            "max_batch": args.fleet_batch,
+        }
+        print(json.dumps(result))
+        print(f"bench_serving --fleet: {qps:.1f} req/s sustained "
+              f"({ok} ok, {errors} errors, {shed} shed over "
+              f"{elapsed:.1f}s)", file=sys.stderr)
+        if errors or not ok:
+            return 1
+        if args.fleet_min_qps and qps < args.fleet_min_qps:
+            print(f"bench_serving --fleet: {qps:.1f} req/s below the "
+                  f"--fleet-min-qps floor {args.fleet_min_qps:g}",
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        ctl.close()
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.fleet:
+        return fleet_main(args)
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -48,6 +48,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from container_engine_accelerators_tpu.fleet.controller import (  # noqa: E402
     DEFAULT_PROC_SCENARIO,
     DEFAULT_SCENARIO,
+    DEFAULT_SERVING_SCENARIO,
     load_scenario,
     run_scenario,
 )
@@ -94,6 +95,14 @@ def parse_args(argv=None):
                         "Without --scenario this runs the built-in "
                         "SIGKILL scenario; a worker that never "
                         "completes its handshake exits 2, not a hang")
+    p.add_argument("--workload", choices=("ring", "serving"),
+                   default=None,
+                   help="round workload: 'ring' transfer legs "
+                        "(default), or 'serving' — a ServingFrontend "
+                        "spraying batched/hedged requests across the "
+                        "fleet (admission control, per-node breakers, "
+                        "serving SLOs; without --scenario this runs "
+                        "the built-in node-kill serving scenario)")
     p.add_argument("--metrics", action="store_true",
                    help="start a per-node MetricServer (ephemeral ports)")
     p.add_argument("--slo", action="append", default=[],
@@ -130,6 +139,16 @@ def _print_report(report, file=sys.stderr):
                   f"{'y' if s['up'] else 'N':>3} {s['frames']:>7} "
                   f"{s['bytes']:>9} {s['drops']:>6} {s['dups']:>5} "
                   f"{s['blocked']:>8}", file=file)
+    if report.get("workload") == "serving" and report["rounds"]:
+        print(f"\n{'round':>5} {'accepted':>9} {'ok':>5} {'errors':>7} "
+              f"{'shed':>5} {'lost':>5}", file=file)
+        for rnd in report["rounds"]:
+            for leg in rnd["legs"]:
+                if leg.get("workload") != "serving":
+                    continue
+                print(f"{rnd['round']:>5} {leg['accepted']:>9} "
+                      f"{leg['ok_requests']:>5} {leg['errors']:>7} "
+                      f"{leg['shed']:>5} {leg['lost']:>5}", file=file)
     if report["agent_events_delta"]:
         print(f"\nagent events (delta): "
               f"{report['agent_events_delta']}", file=file)
@@ -145,12 +164,19 @@ def _print_report(report, file=sys.stderr):
 
 def main(argv=None):
     args = parse_args(argv)
-    scenario = dict(
-        load_scenario(args.scenario) if args.scenario
-        else (DEFAULT_PROC_SCENARIO if args.proc else DEFAULT_SCENARIO)
-    )
+    if args.scenario:
+        builtin = load_scenario(args.scenario)
+    elif args.workload == "serving":
+        builtin = DEFAULT_SERVING_SCENARIO
+    elif args.proc:
+        builtin = DEFAULT_PROC_SCENARIO
+    else:
+        builtin = DEFAULT_SCENARIO
+    scenario = dict(builtin)
     if args.proc:
         scenario["proc"] = True
+    if args.workload:
+        scenario["workload"] = args.workload
     for key, value in (("nodes", args.nodes), ("racks", args.racks),
                        ("rounds", args.rounds),
                        ("payload_bytes", args.payload_bytes),

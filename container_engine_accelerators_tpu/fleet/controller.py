@@ -73,6 +73,11 @@ from container_engine_accelerators_tpu.parallel import dcn, dcn_pipeline
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnXferError,
 )
+from container_engine_accelerators_tpu.serving.frontend import (
+    RequestShed,
+    ServingConfig,
+    ServingFrontend,
+)
 from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
 log = logging.getLogger(__name__)
@@ -116,6 +121,38 @@ DEFAULT_PROC_SCENARIO = {
          "chip": "accel0"},
         {"round": 3, "action": "chip_recover", "node": "n2"},
     ],
+}
+
+
+# The serving headline (`--workload serving`): a ServingFrontend
+# spraying batched requests across the fleet while a node is SIGKILLed
+# mid-load — hedged retries + the per-node breaker steer traffic away
+# from the corpse, the supervisor (in-process: the `for:` inverse)
+# brings it back, and the serving SLOs gate the exit code.
+DEFAULT_SERVING_SCENARIO = {
+    "name": "serving-node-kill",
+    "workload": "serving",
+    "nodes": 3,
+    "racks": 1,
+    "chips": 2,
+    "topology": "1x2x1",
+    "rounds": 5,
+    "payload_bytes": 2048,
+    "serving": {
+        "requests_per_round": 16,
+        "max_batch": 4,
+        "max_wait_ms": 4.0,
+        "hedge_after_ms": 500.0,
+        "breaker_cooldown_s": 0.5,
+    },
+    "faults": [
+        {"round": 1, "action": "kill", "node": "n1", "for": 2},
+    ],
+    "slo": {
+        "min_qps": 1.0,
+        "max_error_ratio": 0.5,
+        "p99_e2e_ms": 30000,
+    },
 }
 
 
@@ -187,6 +224,11 @@ class FleetController:
             deadline_s=float(self.scenario.get("leg_deadline_s", 8.0)),
         )
         self.land_timeout_s = float(self.scenario.get("land_timeout_s", 2.0))
+        # Workload: "ring" (the classic transfer legs) or "serving"
+        # (a ServingFrontend spraying batched/hedged requests across
+        # the fleet — serving/frontend.py).
+        self.workload = str(self.scenario.get("workload", "ring"))
+        self.frontend: Optional[ServingFrontend] = None
         # round -> list of deferred inverse faults ("for: K" entries)
         self._deferred: Dict[int, List[dict]] = {}
         self._booted = False
@@ -230,6 +272,11 @@ class FleetController:
             self.nodes, self.links, self.scenario.get("slo"),
             scrape=self.proc_mode,
         )
+        if self.workload == "serving":
+            self.frontend = ServingFrontend(
+                self.nodes,
+                ServingConfig.from_scenario(self.scenario.get("serving")),
+            ).start()
         self._booted = True
         log.info("fleet booted: %d node(s) in %d rack(s)%s",
                  len(self.nodes),
@@ -238,6 +285,9 @@ class FleetController:
         return self
 
     def close(self) -> None:
+        if self.frontend is not None:
+            self.frontend.close()
+            self.frontend = None
         for node in self.nodes.values():
             node.close()
 
@@ -248,23 +298,23 @@ class FleetController:
         record = dict(entry)
         record["round"] = rnd
         if "link" in entry:
-            if self.proc_mode:
-                # The delivery fabric cannot interpose on another
-                # process's TCP stack; degrade, don't crash (the
-                # TPU_FAULT_SPEC rule).
-                log.error("link faults need the in-process fabric; "
-                          "skipping %r in proc mode", entry["link"])
-                record["link"] = str(entry["link"])  # JSON-clean log
-                record["applied"] = 0
-                record["skipped"] = "proc mode"
-                return record
             fault = (entry["link"] if isinstance(entry["link"], LinkFault)
                      else parse_link_fault(entry["link"]))
             if fault is None:
+                record["link"] = str(entry["link"])  # JSON-clean log
                 record["applied"] = 0
                 return record
             record["link"] = fault.spec()  # JSON-clean round log
-            record["applied"] = len(self.links.apply(fault))
+            if self.proc_mode:
+                # The delivery fabric cannot interpose on another
+                # process's TCP stack — instead the fault is armed in
+                # each source WORKER's daemon over the RPC pipe
+                # (PyXferd's netem-like link shim): same selectors,
+                # same actions, applied in the send path.
+                record["applied"] = self._apply_proc_link_fault(
+                    fault, record)
+            else:
+                record["applied"] = len(self.links.apply(fault))
             lifetime = int(entry.get("for", 0))
             inverse = fault.inverse()
             if lifetime > 0 and inverse is not None:
@@ -314,6 +364,30 @@ class FleetController:
             return record
         record["applied"] = 1
         return record
+
+    def _apply_proc_link_fault(self, fault: LinkFault,
+                               record: dict) -> int:
+        """Arm one parsed link fault across a process-mode fleet: the
+        selectors resolve to directed node pairs (the link table's own
+        resolution), and each pair becomes a shim entry in the SOURCE
+        worker's daemon keyed by the destination's current data port.
+        A dark source worker degrades that pair (recorded), never the
+        schedule; a destination respawn resets its inbound shim state
+        (fresh port — the same reset its flows get)."""
+        applied = 0
+        skipped = []
+        for src, dst in self.links.pairs_for(fault):
+            sn, dn = self.nodes.get(src), self.nodes.get(dst)
+            if sn is None or dn is None:
+                continue
+            try:
+                applied += sn.apply_link_fault(
+                    dn.daemon.data_port, fault.action, fault.param)
+            except OSError as e:
+                skipped.append(f"{src}->{dst}: {e}")
+        if skipped:
+            record["skipped"] = "; ".join(skipped)
+        return applied
 
     # -- workload ------------------------------------------------------------
 
@@ -393,6 +467,54 @@ class FleetController:
                     except (DcnXferError, OSError):
                         pass
 
+    def _serving_round(self, rnd: int, per_node_ok: Dict[str, int],
+                       per_node_failed: Dict[str, int]) -> dict:
+        """One serving round: spray ``requests_per_round`` requests at
+        the frontend, wait for every one to TERMINATE (result, error,
+        or shed — a request silently lost fails the round outright),
+        and fold the frontend's per-node dispatch deltas into the
+        report's per-node accounting.  The round-log entry keeps the
+        same ``ok``-bool convergence contract as a ring leg."""
+        serving = self.scenario.get("serving") or {}
+        n = int(serving.get("requests_per_round", 16))
+        wait_s = float(serving.get("round_deadline_s", 20.0))
+        stats0 = {name: dict(st)
+                  for name, st in self.frontend.node_stats.items()}
+        reqs = []
+        shed = 0
+        entry = {"workload": "serving", "requests": n}
+        with trace.span("fleet.serving_round", round=rnd, requests=n):
+            for i in range(n):
+                payload = bytes([(rnd * 31 + i) % 256]) \
+                    * self.payload_bytes
+                try:
+                    reqs.append((self.frontend.submit(payload),
+                                 payload))
+                except RequestShed:
+                    shed += 1
+            ok = errors = lost = 0
+            deadline = time.monotonic() + wait_s
+            for req, payload in reqs:
+                if not req.wait(max(0.0,
+                                    deadline - time.monotonic())):
+                    lost += 1  # never terminated: the worst verdict
+                    continue
+                if req.error is None and req.result == payload:
+                    ok += 1
+                else:
+                    errors += 1
+        for name, st in self.frontend.node_stats.items():
+            per_node_ok[name] += st["ok"] - stats0[name]["ok"]
+            per_node_failed[name] += (st["failed"]
+                                      - stats0[name]["failed"])
+        entry.update(
+            accepted=len(reqs), shed=shed, ok_requests=ok,
+            errors=errors, lost=lost,
+            ok=bool(reqs) and lost == 0 and errors == 0
+            and ok == len(reqs),
+        )
+        return entry
+
     def _ring(self) -> List[tuple]:
         names = list(self.nodes)
         n = len(names)
@@ -419,18 +541,22 @@ class FleetController:
                         fired.append(self._apply_fault(rnd, entry))
                 legs = []
                 with trace.span("fleet.round", round=rnd):
-                    for src, dst in self._ring():
-                        if src.down or dst.down:
-                            legs.append({"src": src.name,
-                                         "dst": dst.name,
-                                         "skipped": "node down"})
-                            continue
-                        leg = self._leg(rnd, src, dst)
-                        legs.append(leg)
-                        if leg["ok"]:
-                            per_node_ok[src.name] += 1
-                        else:
-                            per_node_failed[src.name] += 1
+                    if self.frontend is not None:
+                        legs.append(self._serving_round(
+                            rnd, per_node_ok, per_node_failed))
+                    else:
+                        for src, dst in self._ring():
+                            if src.down or dst.down:
+                                legs.append({"src": src.name,
+                                             "dst": dst.name,
+                                             "skipped": "node down"})
+                                continue
+                            leg = self._leg(rnd, src, dst)
+                            legs.append(leg)
+                            if leg["ok"]:
+                                per_node_ok[src.name] += 1
+                            else:
+                                per_node_failed[src.name] += 1
                     for node in self.nodes.values():
                         node.recover()
                 # Scrape every node's registry while the round's
@@ -447,6 +573,15 @@ class FleetController:
             leg.get("ok", False) for leg in final_legs
             if "skipped" not in leg
         ) and bool(final_legs)
+        # The serving zero-lost invariant gates the WHOLE run, not
+        # just the final round: mid-chaos rounds may ERROR requests
+        # (bounded budgets spent — the contract allows it), but a
+        # request that never terminated is a correctness failure no
+        # amount of later convergence buys back.
+        serving_lost = sum(
+            leg.get("lost", 0)
+            for entry in round_log for leg in entry["legs"]
+            if leg.get("workload") == "serving")
         nodes_report = {}
         all_up_healthy = True
         for name, node in self.nodes.items():
@@ -487,9 +622,21 @@ class FleetController:
             if op.startswith(("fleet.", "xferd.", "dcn."))
         }
         links_report = self.links.report()
+        report_extra = {}
+        if self.frontend is not None:
+            report_extra["serving"] = {
+                "breakers": self.frontend.breaker.snapshot(),
+                "node_stats": {
+                    name: dict(st) for name, st
+                    in self.frontend.node_stats.items()
+                },
+                "lost_requests": serving_lost,
+            }
         return {
             "scenario": self.scenario.get("name", "fleet"),
             "proc": self.proc_mode,
+            "workload": self.workload,
+            **report_extra,
             "nodes": nodes_report,
             "links": links_report,
             "rounds": round_log,
@@ -498,7 +645,8 @@ class FleetController:
             "telemetry": {"rounds": self.telemetry.history},
             "slo": self.telemetry.evaluate(links_report),
             "converged": (survivors_converged and all_up_healthy
-                          and none_permanently_down),
+                          and none_permanently_down
+                          and serving_lost == 0),
         }
 
     # -- coordinator env -----------------------------------------------------

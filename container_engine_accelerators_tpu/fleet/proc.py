@@ -343,6 +343,17 @@ class ProcNode:
         """Arm the worker daemon's lost-response hook (chaos tests)."""
         self._rpc("drop_response", dop=op, times=times)
 
+    def apply_link_fault(self, port: int, action: str,
+                         param: float = 0.0,
+                         host: str = "127.0.0.1") -> int:
+        """Arm the worker daemon's outbound link shim toward a peer's
+        data port — the proc-mode stand-in for the in-process link
+        table (netem-like partition/latency/drop in PyXferd's send
+        path, driven over this RPC)."""
+        return int(self._rpc("link_fault", port=int(port),
+                             action=action, param=float(param),
+                             host=host).get("applied", 0))
+
     def device_health(self) -> Dict[str, str]:
         return dict(self.snapshot().get("devices", {}))
 
@@ -515,6 +526,11 @@ def _serve(node, out) -> None:
             elif op == "drop_response":
                 node.daemon.drop_response_once(
                     req["dop"], int(req.get("times", 1)))
+            elif op == "link_fault":
+                resp["applied"] = node.daemon.set_link_fault(
+                    req.get("host", "127.0.0.1"), int(req["port"]),
+                    req.get("action", ""),
+                    float(req.get("param", 0.0)))
             elif op == "shutdown":
                 _emit(out, resp)
                 return

@@ -74,11 +74,25 @@ SLO_KEYS = {
     "max_retransmit_ratio": ("ceiling",
                              "(link drops + deduped replays) / frames"),
     "max_dedup_ratio": ("ceiling", "deduped replays / frames"),
+    # Serving SLOs (workload: serving — serving/frontend.py).  The
+    # frontend lives in the COORDINATOR process in both fleet modes,
+    # so these are judged from this process's registries either way;
+    # only the byte-level goodput/ratio inputs flip to HTTP scrapes.
+    "p99_e2e_ms": ("ceiling",
+                   "p99 of serving end-to-end request latency (ms)"),
+    "min_qps": ("floor", "completed (ok) serving requests per second"),
+    "max_error_ratio": ("ceiling",
+                        "errored serving requests / terminated"),
 }
 
 # The latency histogram the p99 ceiling reads; one fleet-sim leg with
 # its retries included (fleet/controller.py stamps it).
 LEG_OP = "fleet.leg"
+# The serving end-to-end histogram (submit -> delivery, per request).
+E2E_OP = "serving.e2e"
+# Coordinator-side serving counters the qps/error SLOs read (delta
+# against the boot baseline, like the leg histogram).
+SERVING_COUNTERS = ("serving.ok", "serving.errors")
 
 
 def parse_slo_spec(raw: Optional[dict]) -> Dict[str, float]:
@@ -171,12 +185,15 @@ class FleetTelemetry:
         # between scrapes, treating a decrease as a fresh process.
         self._accum: Dict[str, Dict[str, float]] = {}
         self._t0 = time.monotonic()
-        # Histograms are process-global and cumulative; the p99 SLO
-        # must judge THIS run only, so snapshot the leg histogram's
-        # buckets at boot and evaluate the delta (the same baseline
-        # discipline FleetController applies to counters).
+        # Histograms are process-global and cumulative; the p99 SLOs
+        # must judge THIS run only, so snapshot their buckets at boot
+        # and evaluate the delta (the same baseline discipline
+        # FleetController applies to counters).
         self._leg0: Dict[str, int] = dict(
             histo.snapshot().get(LEG_OP, {}).get("buckets", {}))
+        self._e2e0: Dict[str, int] = dict(
+            histo.snapshot().get(E2E_OP, {}).get("buckets", {}))
+        self._serving0 = {k: counters.get(k) for k in SERVING_COUNTERS}
 
     # -- per-round scrape ----------------------------------------------------
 
@@ -289,24 +306,27 @@ class FleetTelemetry:
 
     # -- SLO evaluation ------------------------------------------------------
 
+    def _histo_p99_ms(self, op: str, baseline: Dict[str, int]) -> float:
+        """p99 of THIS run's observations of ``op``: current buckets
+        minus the boot baseline (histo.delta_percentile_us)."""
+        p_us = histo.delta_percentile_us(op, baseline, 0.99)
+        return 0.0 if p_us is None else p_us / 1e3
+
     def _leg_p99_ms(self) -> float:
-        """p99 of THIS run's fleet.leg observations: current buckets
-        minus the boot baseline, upper-bound quantile like
-        histo.percentile."""
-        now = histo.snapshot().get(LEG_OP, {}).get("buckets", {})
-        delta = {int(le): n - self._leg0.get(le, 0)
-                 for le, n in now.items()
-                 if n - self._leg0.get(le, 0) > 0}
-        total = sum(delta.values())
-        if not total:
-            return 0.0
-        target = 0.99 * total
-        seen = 0
-        for le in sorted(delta):
-            seen += delta[le]
-            if seen >= target:
-                return le / 1e3
-        return max(delta) / 1e3  # pragma: no cover — q <= 1
+        return self._histo_p99_ms(LEG_OP, self._leg0)
+
+    def _serving_measurements(self, elapsed_s: float) -> dict:
+        """The serving SLO inputs — coordinator-side in BOTH modes:
+        the ServingFrontend runs in the controller process, so its
+        counters and the e2e histogram never need the scrape path."""
+        ok = counters.get("serving.ok") - self._serving0["serving.ok"]
+        errors = (counters.get("serving.errors")
+                  - self._serving0["serving.errors"])
+        return {
+            "p99_e2e_ms": self._histo_p99_ms(E2E_OP, self._e2e0),
+            "min_qps": ok / elapsed_s,
+            "max_error_ratio": errors / max(1, ok + errors),
+        }
 
     def _measurements(self, links_report: Dict[str, dict]) -> dict:
         elapsed_s = max(time.monotonic() - self._t0, 1e-9)
@@ -320,6 +340,7 @@ class FleetTelemetry:
             "min_goodput_bps": delivered_bytes / elapsed_s,
             "max_retransmit_ratio": (drops + dups) / max(1, frames),
             "max_dedup_ratio": dups / max(1, frames),
+            **self._serving_measurements(elapsed_s),
         }
 
     def _measurements_scraped(self) -> dict:
@@ -355,6 +376,7 @@ class FleetTelemetry:
             "max_retransmit_ratio": ratio,
             "max_dedup_ratio": ratio,
             "stale_entries_skipped": stale_entries,
+            **self._serving_measurements(elapsed_s),
         }
 
     def evaluate(self, links_report: Dict[str, dict]) -> dict:

@@ -128,6 +128,11 @@ CHUNK_STAGE_WAIT_S = 5.0
 # a daemon thread is never parked longer than this on one request.
 MAX_WAIT_SLICE_S = 30.0
 
+# Link-shim latency cap, mirroring fleet.links.MAX_INJECT_LATENCY_S
+# (deliberately duplicated — the daemon must stay importable without
+# the link table): a typo'd delay models relative slowness, not WAN.
+LINK_SHIM_MAX_LATENCY_S = 0.25
+
 _MAGIC_V1 = b"DXF1"
 _MAGIC_V2 = b"DXF2"
 _MAGIC_READ = b"DXR1"
@@ -371,6 +376,15 @@ class PyXferd:
         # sever the connection BEFORE responding (a daemon that did the
         # work but whose answer was lost: the replay-dedup scenario).
         self._drop_response: Dict[str, int] = {}
+        # Proc-mode link-fault shim (netem analog): per-destination
+        # (host, port) fault state consulted by the SEND path when
+        # there is no in-process fabric to interpose (net is None).
+        # Armed over the worker RPC by the fleet controller, so
+        # `sel<->sel` link faults work against real OS-process nodes
+        # too.  Keyed by the peer's CURRENT data port: a respawned
+        # peer binds a fresh port and starts with a clean link —
+        # the same reset its flows and dedup windows get.
+        self._link_faults: Dict[Tuple[str, int], dict] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -384,6 +398,9 @@ class PyXferd:
         if self.shm_enabled:
             os.makedirs(self.shm_dir, exist_ok=True)
         self._stopping.clear()
+        # A fresh incarnation starts with clean links, like its flows.
+        with self._lock:
+            self._link_faults.clear()
         srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         srv.bind(self.sock_path)
         srv.listen(16)
@@ -501,6 +518,55 @@ class PyXferd:
         """Arm the lost-response hook for the next ``times`` ``op``
         requests (chaos tests)."""
         self._drop_response[op] = self._drop_response.get(op, 0) + times
+
+    # -- link-fault shim (proc-mode netem analog) ----------------------------
+
+    def set_link_fault(self, host: str, port: int, action: str,
+                       param: float = 0.0) -> int:
+        """Arm one outbound link fault toward ``(host, port)`` —
+        ``partition`` (sends fail like a null route), ``heal`` (clear
+        everything), ``latency`` (per-frame one-way delay, seconds,
+        capped), ``drop`` (eat the next ``param`` frames in flight:
+        the sender believes they left, the peer never sees them).
+        Consulted by the send path only when this daemon has no
+        in-process fabric (``net is None``) — with a fabric the
+        LinkTable is the single fault surface."""
+        key = (host, int(port))
+        with self._lock:
+            st = self._link_faults.get(key)
+            if st is None:
+                st = self._link_faults[key] = {
+                    "up": True, "latency_s": 0.0, "drop_next": 0}
+            if action == "partition":
+                st["up"] = False
+            elif action == "heal":
+                self._link_faults.pop(key, None)
+            elif action == "latency":
+                st["latency_s"] = min(max(float(param), 0.0),
+                                      LINK_SHIM_MAX_LATENCY_S)
+            elif action == "drop":
+                st["drop_next"] += max(1, int(param or 1))
+            else:
+                raise ValueError(f"unknown link-fault action "
+                                 f"{action!r}")
+        log.warning("link shim: %s toward %s:%d armed on node %s",
+                    action, host, port, self.node or "?")
+        return 1
+
+    def _shim_consult(self, host: str, port: int):
+        """One frame's verdict from the shim: (action, delay_s) where
+        action is None / "blocked" / "dropped".  The latency sleep
+        happens in the CALLER, outside the lock."""
+        with self._lock:
+            st = self._link_faults.get((host, int(port)))
+            if st is None:
+                return None, 0.0
+            if not st["up"]:
+                return "blocked", 0.0
+            if st["drop_next"] > 0:
+                st["drop_next"] -= 1
+                return "dropped", st["latency_s"]
+            return None, st["latency_s"]
 
     def _publish_flow_gauges_locked(self) -> None:
         """Flow accounting as gauges (caller holds the lock): what the
@@ -711,6 +777,19 @@ class PyXferd:
                 "tot": int(req.get("total") or 0),
                 "xid": xid,
             }
+        # Proc-mode link shim: when there is no in-process fabric, the
+        # armed per-destination faults interpose here — the one point
+        # every outbound frame passes, like FleetNet.deliver.
+        shim = None
+        if self.net is None:
+            shim, shim_delay_s = self._shim_consult(host, port)
+            if shim == "blocked":
+                counters.inc("fleet.link.blocked")
+                return {"ok": False,
+                        "error": f"send failed: link to {host}:{port} "
+                                 f"partitioned (injected)"}
+            if shim_delay_s > 0:
+                time.sleep(shim_delay_s)
         t0 = time.monotonic()
         with trace.span("xferd.send", histogram="xferd.send", flow=flow,
                         node=self.node, dst=f"{host}:{port}", seq=seq,
@@ -722,7 +801,15 @@ class PyXferd:
                 meta.update(ctx)
             verdict = None
             try:
-                if self.net is not None:
+                if shim == "dropped":
+                    # Loss injection: the sender believes the frame
+                    # left; the peer never sees it.  The verdict lets
+                    # the striped writer retransmit without a timeout,
+                    # exactly like the fleet fabric's answer.
+                    counters.inc("fleet.link.dropped")
+                    verdict = "dropped"
+                    span.annotate(verdict=verdict)
+                elif self.net is not None:
                     # Fleet mode: EVERY frame goes through the link
                     # table — a port the fabric doesn't know (stale
                     # after a peer restart, node down) is a dead link,

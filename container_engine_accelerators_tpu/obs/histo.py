@@ -131,6 +131,31 @@ def percentiles(op: str, qs: Iterable[float]) -> List[Optional[float]]:
     return [percentile(op, q) for q in qs]
 
 
+def delta_percentile_us(op: str, baseline: Dict[str, int],
+                        q: float) -> Optional[float]:
+    """Upper-bound ``q``-quantile in µs of the observations made
+    SINCE ``baseline`` (a ``snapshot()[op]['buckets']`` mapping taken
+    earlier).  The registry is process-global and cumulative, so
+    anything judging one run/lifetime — fleet SLOs, the serving
+    frontend's adaptive hedge deadline — must quantile the delta, not
+    the whole process history.  None when nothing was observed since
+    the baseline."""
+    now = snapshot().get(op, {}).get("buckets", {})
+    delta = {int(le): n - baseline.get(le, 0)
+             for le, n in now.items()
+             if n - baseline.get(le, 0) > 0}
+    total = sum(delta.values())
+    if not total:
+        return None
+    target = q * total
+    seen = 0
+    for le in sorted(delta):
+        seen += delta[le]
+        if seen >= target:
+            return float(le)
+    return float(max(delta))  # pragma: no cover — q <= 1
+
+
 def reset() -> None:
     """Drop every histogram — test isolation only; production
     histograms are cumulative for the agent's life, like counters."""

@@ -1,0 +1,47 @@
+"""Serving under chaos: the fleet-facing request frontend.
+
+Everything below the fleet rig moves *bytes*; this package moves
+*requests*.  ``cmd/serve_lm.py`` / ``cmd/serve_resnet.py`` model the
+per-node model server; what was missing is the layer production puts
+in front of a fleet of them — the layer whose whole job is staying up
+while nodes die:
+
+- ``serving.frontend``  ServingFrontend: a bounded admission queue
+                        with load shedding (reject-over-collapse),
+                        request batching with a max-wait/max-size
+                        cutter, hedged retries (a backup attempt on a
+                        second node after a latency-percentile
+                        deadline, first-response-wins with loser
+                        cancellation and exactly-once result dedup by
+                        request id), and bounded per-attempt failover
+                        — all riding per-node
+                        ``ResilientDcnXferClient`` pools for the
+                        cross-node shard reads, so every DCN fault
+                        the rig can inject exercises this stack too;
+- ``serving.breaker``   NodeBreaker: the per-node circuit breaker —
+                        consecutive failures eject a node from the
+                        dispatch set, a cooldown later one probe
+                        request is let through, success closes the
+                        breaker, failure re-opens it.
+
+The fleet integration (``workload: serving`` scenarios, serving SLOs
+``p99_e2e_ms`` / ``min_qps`` / ``max_error_ratio``, chaos gates) lives
+in ``fleet/controller.py`` + ``fleet/telemetry.py``; run it with
+``python cmd/fleet_sim.py --workload serving`` or ``make fleet-serve``.
+"""
+
+from container_engine_accelerators_tpu.serving.breaker import NodeBreaker
+from container_engine_accelerators_tpu.serving.frontend import (
+    Request,
+    RequestShed,
+    ServingConfig,
+    ServingFrontend,
+)
+
+__all__ = [
+    "NodeBreaker",
+    "Request",
+    "RequestShed",
+    "ServingConfig",
+    "ServingFrontend",
+]

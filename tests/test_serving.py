@@ -1,0 +1,960 @@
+"""Serving under chaos: admission control, batching, hedged retries,
+breakers (serving/), the fleet serving workload, and the proc-mode
+link-fault shim.
+
+Tier-1 keeps the deterministic units (breaker state machine, shed
+accounting, batch cutter, hedge correctness with exactly-once dedup,
+failover, serving SLO evaluation, the standalone link shim) plus ONE
+in-process serving chaos smoke; the scenario matrix (rack partition,
+proc-mode link faults, CLI runs, the fleet bench) is marked ``slow``
+— ``make fleet-serve`` runs everything.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from container_engine_accelerators_tpu.fleet.controller import (
+    DEFAULT_SERVING_SCENARIO,
+    FleetController,
+    run_scenario,
+)
+from container_engine_accelerators_tpu.fleet.telemetry import (
+    FleetTelemetry,
+)
+from container_engine_accelerators_tpu.fleet.xferd import PyXferd
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import histo
+from container_engine_accelerators_tpu.parallel import dcn
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    DcnXferError,
+    ResilientDcnXferClient,
+)
+from container_engine_accelerators_tpu.serving.breaker import NodeBreaker
+from container_engine_accelerators_tpu.serving.frontend import (
+    AttemptCancelled,
+    Request,
+    RequestShed,
+    ServingConfig,
+    ServingFrontend,
+)
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeNode:
+    """The node shape the frontend touches, with no daemon behind it
+    (tests inject a ``transfer=`` fake)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.root = "/nonexistent"
+        self.down = False
+        self.permanently_down = False
+
+
+def _fleet(*names):
+    return {n: _FakeNode(n) for n in names}
+
+
+def _wait_for(cond, timeout_s=5.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# breaker state machine
+# ---------------------------------------------------------------------------
+
+
+class TestNodeBreaker:
+    def test_trips_after_consecutive_failures_only(self):
+        clk = [0.0]
+        b = NodeBreaker(failures=3, cooldown_s=1.0,
+                        clock=lambda: clk[0])
+        o0 = counters.get("serving.breaker.open")
+        b.record_failure("n0")
+        b.record_failure("n0")
+        b.record_success("n0")  # success resets the streak
+        b.record_failure("n0")
+        b.record_failure("n0")
+        assert b.allow("n0")
+        assert b.state("n0") == "closed"
+        b.record_failure("n0")  # third consecutive: trip
+        assert b.state("n0") == "open"
+        assert not b.allow("n0")
+        assert counters.get("serving.breaker.open") == o0 + 1
+
+    def test_cooldown_grants_exactly_one_probe(self):
+        clk = [0.0]
+        b = NodeBreaker(failures=1, cooldown_s=1.0,
+                        clock=lambda: clk[0])
+        b.record_failure("n0")
+        assert not b.allow("n0")  # inside cooldown
+        clk[0] = 1.5
+        p0 = counters.get("serving.breaker.probe")
+        assert b.allow("n0")      # the probe
+        assert not b.allow("n0")  # concurrent caller: rejected
+        assert counters.get("serving.breaker.probe") == p0 + 1
+
+    def test_probe_success_closes(self):
+        clk = [0.0]
+        b = NodeBreaker(failures=1, cooldown_s=1.0,
+                        clock=lambda: clk[0])
+        b.record_failure("n0")
+        clk[0] = 2.0
+        assert b.allow("n0")
+        c0 = counters.get("serving.breaker.close")
+        b.record_success("n0")
+        assert b.state("n0") == "closed"
+        assert b.allow("n0")
+        assert counters.get("serving.breaker.close") == c0 + 1
+
+    def test_probe_failure_reopens_with_fresh_cooldown(self):
+        clk = [0.0]
+        b = NodeBreaker(failures=1, cooldown_s=1.0,
+                        clock=lambda: clk[0])
+        b.record_failure("n0")
+        clk[0] = 2.0
+        assert b.allow("n0")
+        b.record_failure("n0")  # probe failed
+        assert b.state("n0") == "open"
+        assert not b.allow("n0")      # fresh cooldown from t=2.0
+        clk[0] = 2.5
+        assert not b.allow("n0")
+        clk[0] = 3.5
+        assert b.allow("n0")          # next probe
+
+    def test_abandoned_probe_released_not_wedged(self):
+        """A probe whose attempt was cancelled before judging the node
+        (hedge-race loser) gives the slot back — the node must not
+        stay half-open-rejecting forever."""
+        clk = [0.0]
+        b = NodeBreaker(failures=1, cooldown_s=1.0,
+                        clock=lambda: clk[0])
+        b.record_failure("n0")
+        clk[0] = 2.0
+        assert b.allow("n0")
+        assert not b.allow("n0")
+        b.release_probe("n0")
+        assert b.allow("n0")  # a fresh probe, no clock movement needed
+
+
+# ---------------------------------------------------------------------------
+# admission control: shed, depth, nothing lost at close
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_full_queue_sheds_and_counts(self):
+        fe = ServingFrontend(_fleet("n0"), ServingConfig(
+            admission_capacity=2))  # batcher NOT started: queue fills
+        s0 = counters.get("serving.shed")
+        r0 = counters.get("serving.requests")
+        fe.submit(b"a")
+        fe.submit(b"b")
+        with pytest.raises(RequestShed, match="full"):
+            fe.submit(b"c")
+        assert counters.get("serving.shed") == s0 + 1
+        assert counters.get("serving.requests") == r0 + 2
+        fe.close()
+
+    def test_close_terminates_queued_requests_never_lost(self):
+        fe = ServingFrontend(_fleet("n0"), ServingConfig(
+            admission_capacity=4))
+        reqs = [fe.submit(b"x") for _ in range(3)]
+        e0 = counters.get("serving.errors")
+        fe.close()
+        for req in reqs:
+            assert req.wait(0.0)  # already terminated
+            assert req.error == "frontend closed"
+        assert counters.get("serving.errors") == e0 + 3
+
+    def test_submit_after_close_sheds(self):
+        fe = ServingFrontend(_fleet("n0"), ServingConfig())
+        fe.close()
+        with pytest.raises(RequestShed, match="closing"):
+            fe.submit(b"x")
+
+    def test_submit_racing_close_never_loses_the_request(self):
+        """submit() passing its stop check just before close() sets
+        the flag (and drains the queue) must still terminate the
+        straggler request — the exactly-once contract has no holes at
+        shutdown."""
+        fe = ServingFrontend(_fleet("n0"), ServingConfig())
+        orig_put = fe._admit.put_nowait
+
+        def racing_put(item):
+            fe.close()  # close runs FULLY between check and put
+            orig_put(item)
+
+        fe._admit.put_nowait = racing_put
+        e0 = counters.get("serving.errors")
+        req = fe.submit(b"x")
+        assert req.wait(0.0)
+        assert req.error == "frontend closed"
+        assert counters.get("serving.errors") == e0 + 1
+
+    def test_dispatch_backpressure_reaches_admission(self):
+        """With every dispatch slot in flight the cutter must stall,
+        so admitted requests accumulate in the BOUNDED queue and the
+        overflow sheds at submit() — not drain into the executor's
+        unbounded work queue (admission control in name only)."""
+        entered = threading.Event()
+        release = threading.Event()
+
+        def blocking_transfer(batch, node, cancel):
+            entered.set()
+            assert release.wait(10.0)
+            return batch.payload
+
+        fe = ServingFrontend(
+            _fleet("n0"),
+            ServingConfig(admission_capacity=2, max_batch=1,
+                          max_wait_ms=0.0, max_inflight_batches=1,
+                          hedge_after_ms=60000.0,
+                          request_timeout_s=30.0),
+            transfer=blocking_transfer).start()
+        try:
+            s0 = counters.get("serving.shed")
+            first = fe.submit(b"a")
+            _wait_for(entered.is_set, what="first batch dispatched")
+            queued = [fe.submit(b"b"), fe.submit(b"c")]
+            # Give the cutter a beat: with the one slot held it must
+            # NOT drain these two out of the admission queue.
+            time.sleep(0.15)
+            with pytest.raises(RequestShed, match="full"):
+                fe.submit(b"d")
+            assert counters.get("serving.shed") == s0 + 1
+            release.set()
+            for req, want in zip((first, *queued),
+                                 (b"a", b"b", b"c")):
+                assert req.wait(5.0)
+                assert req.error is None and req.result == want
+        finally:
+            release.set()
+            fe.close()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once delivery (the request-id dedup)
+# ---------------------------------------------------------------------------
+
+
+class TestExactlyOnce:
+    def test_first_delivery_wins_second_reports_duplicate(self):
+        req = Request(1, b"p", time.monotonic())
+        assert req._deliver(b"r1", None, "primary") is True
+        assert req._deliver(b"r2", None, "hedge") is False
+        assert req.result == b"r1"
+        assert req.winner == "primary"
+        assert req.error is None
+        # An error can't overwrite a result either.
+        assert req._deliver(None, "boom", "error") is False
+        assert req.error is None
+
+
+# ---------------------------------------------------------------------------
+# batching: size cutter and wait cutter
+# ---------------------------------------------------------------------------
+
+
+class TestBatching:
+    def test_max_batch_cuts_by_size(self):
+        sizes = []
+        lock = threading.Lock()
+
+        def transfer(batch, node, cancel):
+            with lock:
+                sizes.append(len(batch.requests))
+            return batch.payload
+
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=3, max_wait_ms=250.0,
+                          admission_capacity=16),
+            transfer=transfer)
+        reqs = [fe.submit(bytes([i])) for i in range(7)]
+        fe.start()
+        try:
+            _wait_for(lambda: all(r.done() for r in reqs),
+                      what="all requests delivered")
+        finally:
+            fe.close()
+        assert sorted(sizes, reverse=True) == [3, 3, 1]
+        for i, req in enumerate(reqs):
+            assert req.error is None and req.result == bytes([i])
+
+    def test_max_wait_cuts_a_lone_request(self):
+        def transfer(batch, node, cancel):
+            return batch.payload
+
+        fe = ServingFrontend(
+            _fleet("n0"),
+            ServingConfig(max_batch=8, max_wait_ms=50.0),
+            transfer=transfer).start()
+        try:
+            t0 = time.monotonic()
+            req = fe.submit(b"solo")
+            assert req.wait(5.0)
+            elapsed = time.monotonic() - t0
+            assert req.result == b"solo"
+            # Cut by the wait ceiling, not by a full batch: well under
+            # any size-cut path but after the ~50 ms wait window.
+            assert elapsed < 4.0
+        finally:
+            fe.close()
+
+
+# ---------------------------------------------------------------------------
+# hedge correctness: fired/won/wasted, loser cancellation, dedup
+# ---------------------------------------------------------------------------
+
+
+def _counting_transfer(behaviors):
+    """Route attempt k (1-based arrival order) to behaviors[k]; the
+    dispatch order is deterministic — the primary's first attempt is
+    always call 1, the hedge's first is call 2."""
+    calls = [0]
+    lock = threading.Lock()
+
+    def transfer(batch, node, cancel):
+        with lock:
+            calls[0] += 1
+            k = calls[0]
+        return behaviors[min(k, len(behaviors))](batch, node, cancel)
+
+    return transfer, calls
+
+
+class TestHedging:
+    def test_hedge_fires_wins_and_loser_is_cancelled(self):
+        """Primary parks; the hedge deadline passes; the backup lands
+        first; the loser observes its cancel token and aborts without
+        delivering — one result, zero duplicates."""
+
+        def slow_primary(batch, node, cancel):
+            _wait_for(cancel.is_set, what="loser cancellation")
+            raise AttemptCancelled()
+
+        def fast_hedge(batch, node, cancel):
+            return batch.payload
+
+        transfer, calls = _counting_transfer(
+            {1: slow_primary, 2: fast_hedge})
+        f0 = counters.get("serving.hedge.fired")
+        w0 = counters.get("serving.hedge.won")
+        d0 = counters.get("serving.dedup.dropped")
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=1, max_wait_ms=1.0,
+                          hedge_after_ms=40.0),
+            transfer=transfer).start()
+        try:
+            req = fe.submit(b"payload")
+            assert req.wait(10.0)
+            assert req.result == b"payload"
+            assert req.winner == "hedge"
+            _wait_for(lambda: calls[0] >= 2, what="both attempts ran")
+            _wait_for(lambda: counters.get("serving.hedge.won")
+                      == w0 + 1, what="hedge accounting")
+        finally:
+            fe.close()
+        assert counters.get("serving.hedge.fired") == f0 + 1
+        # The loser cancelled BEFORE delivering: nothing to dedup.
+        assert counters.get("serving.dedup.dropped") == d0
+
+    def test_both_land_exactly_one_delivery_dedup_counted(self):
+        """A loser that ignores cancellation and lands anyway: the
+        request-id dedup drops its result — exactly one delivery, and
+        the duplicate is counted."""
+        gate = threading.Event()
+
+        def stubborn_primary(batch, node, cancel):
+            assert gate.wait(10.0)
+            return batch.payload  # lands AFTER the hedge won
+
+        def fast_hedge(batch, node, cancel):
+            return batch.payload
+
+        transfer, calls = _counting_transfer(
+            {1: stubborn_primary, 2: fast_hedge})
+        d0 = counters.get("serving.dedup.dropped")
+        o0 = counters.get("serving.ok")
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=1, max_wait_ms=1.0,
+                          hedge_after_ms=40.0),
+            transfer=transfer).start()
+        try:
+            req = fe.submit(b"payload")
+            assert req.wait(10.0)
+            assert req.winner == "hedge"
+            gate.set()  # now let the loser land
+            _wait_for(lambda: counters.get("serving.dedup.dropped")
+                      == d0 + 1, what="duplicate dropped")
+        finally:
+            fe.close()
+        # Exactly ONE delivery: serving.ok counted the request once.
+        assert counters.get("serving.ok") == o0 + 1
+        assert req.result == b"payload"
+
+    def test_fast_primary_never_hedges(self):
+        def fast(batch, node, cancel):
+            return batch.payload
+
+        f0 = counters.get("serving.hedge.fired")
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=1, max_wait_ms=1.0,
+                          hedge_after_ms=2000.0),
+            transfer=fast).start()
+        try:
+            req = fe.submit(b"x")
+            assert req.wait(5.0) and req.result == b"x"
+        finally:
+            fe.close()
+        assert counters.get("serving.hedge.fired") == f0
+
+    def test_primary_wins_after_hedge_fired_counts_wasted(self):
+        """The hedge fires but the primary lands first: the hedge's
+        work was wasted (and its late result deduped)."""
+        p_gate = threading.Event()
+        h_gate = threading.Event()
+
+        def primary(batch, node, cancel):
+            assert p_gate.wait(10.0)
+            return batch.payload
+
+        def hedge(batch, node, cancel):
+            assert h_gate.wait(10.0)
+            return batch.payload
+
+        transfer, calls = _counting_transfer({1: primary, 2: hedge})
+        w0 = counters.get("serving.hedge.wasted")
+        d0 = counters.get("serving.dedup.dropped")
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=1, max_wait_ms=1.0,
+                          hedge_after_ms=40.0),
+            transfer=transfer).start()
+        try:
+            req = fe.submit(b"payload")
+            _wait_for(lambda: calls[0] >= 2, what="hedge dispatched")
+            p_gate.set()  # primary lands first
+            assert req.wait(10.0)
+            assert req.winner == "primary"
+            _wait_for(lambda: counters.get("serving.hedge.wasted")
+                      == w0 + 1, what="wasted accounting")
+            h_gate.set()  # let the hedge land late -> dedup
+            _wait_for(lambda: counters.get("serving.dedup.dropped")
+                      == d0 + 1, what="late hedge deduped")
+        finally:
+            fe.close()
+
+
+# ---------------------------------------------------------------------------
+# failover + breaker integration
+# ---------------------------------------------------------------------------
+
+
+class TestHedgeDeadlineBaseline:
+    def test_adaptive_deadline_ignores_prior_runs_in_the_process(self):
+        """The histogram registry is process-global: attempt
+        latencies from an EARLIER run must not drag a fresh
+        frontend's adaptive hedge deadline to its cap (hedging
+        silently disabled)."""
+        histo.observe("serving.attempt", 8.0)  # stale slow tail
+        fe = ServingFrontend(_fleet("n0"), ServingConfig(
+            hedge_after_ms=None, hedge_floor_ms=50.0,
+            request_timeout_s=10.0))
+        try:
+            # No observations SINCE construction: the floor, not the
+            # stale 8 s tail.
+            assert fe._hedge_deadline_s() == pytest.approx(0.05)
+            histo.observe("serving.attempt", 2.0)  # this frontend's
+            assert fe._hedge_deadline_s() > 1.0
+        finally:
+            fe.close()
+
+
+class TestFailover:
+    def test_unexpected_transfer_exception_errors_never_loses(self):
+        """An exception type the attempt sequence doesn't anticipate
+        re-raises out of the dispatch wait — the batch must still
+        terminate (errored), never hang its requests forever."""
+        def exploding(batch, node, cancel):
+            raise ValueError("boom")
+
+        fe = ServingFrontend(_fleet("n0"), ServingConfig(
+            max_batch=1, max_wait_ms=0.0, attempts=1,
+            hedge_after_ms=60000.0, request_timeout_s=5.0),
+            transfer=exploding).start()
+        try:
+            req = fe.submit(b"x")
+            assert req.wait(5.0), "request never terminated (lost)"
+            assert req.error is not None
+            assert "boom" in req.error
+            # The verdict reached the breaker (a half-open probe hit
+            # by an unanticipated exception must re-open, not leak
+            # its slot and wedge the node out of dispatch forever).
+            assert fe.breaker.snapshot()["n0"]["fails"] >= 1
+        finally:
+            fe.close()
+
+
+    def test_failing_node_ejected_and_requests_fail_over(self):
+        def transfer(batch, node, cancel):
+            if node.name == "n0":
+                raise DcnXferError("n0 is a black hole")
+            return batch.payload
+
+        o0 = counters.get("serving.breaker.open")
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=1, max_wait_ms=1.0, attempts=2,
+                          breaker_failures=2, breaker_cooldown_s=60.0,
+                          hedge_after_ms=5000.0),
+            transfer=transfer).start()
+        try:
+            reqs = [fe.submit(bytes([i])) for i in range(6)]
+            for i, req in enumerate(reqs):
+                assert req.wait(10.0)
+                assert req.error is None and req.result == bytes([i])
+        finally:
+            fe.close()
+        # Every request succeeded (failover), the black hole tripped
+        # its breaker, and the report says who did the work.
+        assert fe.breaker.state("n0") == "open"
+        assert counters.get("serving.breaker.open") == o0 + 1
+        assert fe.node_stats["n0"]["failed"] >= 2
+        assert fe.node_stats["n1"]["ok"] == 6
+
+    def test_all_attempts_failing_terminates_with_error(self):
+        def transfer(batch, node, cancel):
+            raise DcnXferError("everything is broken")
+
+        e0 = counters.get("serving.errors")
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=1, max_wait_ms=1.0, attempts=2,
+                          hedge_attempts=1, hedge_after_ms=50.0,
+                          request_timeout_s=5.0,
+                          breaker_failures=100),
+            transfer=transfer).start()
+        try:
+            req = fe.submit(b"x")
+            assert req.wait(15.0), "request must terminate, not hang"
+            assert req.result is None
+            assert "broken" in req.error
+        finally:
+            fe.close()
+        assert counters.get("serving.errors") == e0 + 1
+
+
+# ---------------------------------------------------------------------------
+# serving SLO evaluation (fleet/telemetry.py)
+# ---------------------------------------------------------------------------
+
+
+class TestServingSlos:
+    def test_serving_measurements_are_run_deltas(self):
+        counters.inc("serving.ok", 5)  # pre-run traffic: baselined out
+        t = FleetTelemetry({}, None,
+                           {"max_error_ratio": 0.4, "min_qps": 0.001},
+                           scrape=False)
+        counters.inc("serving.ok", 6)
+        counters.inc("serving.errors", 2)
+        histo.observe("serving.e2e", 0.05)
+        section = t.evaluate({})
+        measured = section["measured"]
+        assert measured["max_error_ratio"] == pytest.approx(0.25)
+        assert measured["min_qps"] > 0
+        assert measured["p99_e2e_ms"] >= 50.0
+        assert section["ok"] is True
+
+    def test_error_ratio_breach_fails_the_section(self):
+        t = FleetTelemetry({}, None, {"max_error_ratio": 0.1},
+                           scrape=False)
+        counters.inc("serving.ok", 1)
+        counters.inc("serving.errors", 9)
+        section = t.evaluate({})
+        assert section["ok"] is False
+        assert section["checks"][0]["slo"] == "max_error_ratio"
+        assert section["measured"]["max_error_ratio"] \
+            == pytest.approx(0.9)
+
+    def test_scrape_mode_carries_serving_measurements_too(self):
+        t = FleetTelemetry({}, None, {"min_qps": 0.001}, scrape=True)
+        counters.inc("serving.ok", 3)
+        section = t.evaluate({})
+        assert section["measured"]["min_qps"] > 0
+        assert section["ok"] is True
+
+
+class TestServingConvergenceGate:
+    def test_lost_request_in_any_round_fails_convergence(self):
+        """The zero-lost invariant gates the WHOLE run: a request
+        lost in a mid-chaos round must fail convergence (exit 2) even
+        when every later round is clean — mid-run ERRORS are allowed,
+        mid-run losses never."""
+        scenario = dict(DEFAULT_SERVING_SCENARIO,
+                        nodes=2, rounds=0, faults=[])
+        ctl = FleetController(scenario).boot()
+        try:
+            per_ok = {n: 0 for n in ctl.nodes}
+            per_failed = {n: 0 for n in ctl.nodes}
+
+            def leg(lost, errors=0):
+                n_ok = 4 - lost - errors
+                return {"workload": "serving", "requests": 4,
+                        "accepted": 4, "shed": 0,
+                        "ok_requests": n_ok, "errors": errors,
+                        "lost": lost,
+                        "ok": lost == 0 and errors == 0}
+
+            lossy_log = [
+                {"round": 0, "faults": [], "legs": [leg(lost=1)]},
+                {"round": 1, "faults": [], "legs": [leg(lost=0)]},
+            ]
+            report = ctl._report(lossy_log, dict(per_ok),
+                                 dict(per_failed))
+            assert report["serving"]["lost_requests"] == 1
+            assert report["converged"] is False
+            # Errors in a chaos round are the allowed degradation:
+            # same shape, errored instead of lost, converges.
+            errored_log = [
+                {"round": 0, "faults": [],
+                 "legs": [leg(lost=0, errors=2)]},
+                {"round": 1, "faults": [], "legs": [leg(lost=0)]},
+            ]
+            report = ctl._report(errored_log, dict(per_ok),
+                                 dict(per_failed))
+            assert report["serving"]["lost_requests"] == 0
+            assert report["converged"] is True
+        finally:
+            ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# the proc-mode link-fault shim (PyXferd send path)
+# ---------------------------------------------------------------------------
+
+
+class _ShimRig:
+    """Two standalone daemons (net=None — the proc-mode shape) and
+    production clients, for shim semantics tests."""
+
+    def __init__(self, tmp_path):
+        retry = RetryPolicy(max_attempts=3, initial_backoff_s=0.01,
+                            max_backoff_s=0.05, deadline_s=3.0)
+        self.a = PyXferd(str(tmp_path / "a"), node="a").start()
+        self.b = PyXferd(str(tmp_path / "b"), node="b").start()
+        self.ca = ResilientDcnXferClient(str(tmp_path / "a"),
+                                         retry=retry)
+        self.cb = ResilientDcnXferClient(str(tmp_path / "b"),
+                                         retry=retry)
+
+    def close(self):
+        for c in (self.ca, self.cb):
+            try:
+                c.close()
+            except OSError:
+                pass
+        self.a.stop()
+        self.b.stop()
+
+
+PAYLOAD = bytes(range(256)) * 8  # 2 KiB
+
+
+class TestLinkShim:
+    def test_partition_blocks_then_heal_restores(self, tmp_path):
+        rig = _ShimRig(tmp_path)
+        try:
+            rig.cb.register_flow("f", bytes=len(PAYLOAD))
+            rig.ca.register_flow("f", bytes=len(PAYLOAD))
+            rig.ca.put("f", PAYLOAD)
+            dcn.wait_flow_rx(rig.ca, "f", len(PAYLOAD), timeout_s=5)
+            b0 = counters.get("fleet.link.blocked")
+            rig.a.set_link_fault("127.0.0.1", rig.b.data_port,
+                                 "partition")
+            with pytest.raises(DcnXferError, match="partitioned"):
+                rig.ca.send("f", "127.0.0.1", rig.b.data_port,
+                            len(PAYLOAD))
+            assert counters.get("fleet.link.blocked") > b0
+            rig.a.set_link_fault("127.0.0.1", rig.b.data_port, "heal")
+            rig.ca.send("f", "127.0.0.1", rig.b.data_port,
+                        len(PAYLOAD))
+            dcn.wait_flow_rx(rig.cb, "f", len(PAYLOAD), timeout_s=5)
+            assert rig.cb.read("f", len(PAYLOAD)) == PAYLOAD
+        finally:
+            rig.close()
+
+    def test_drop_eats_frames_in_flight_retransmit_lands(self, tmp_path):
+        rig = _ShimRig(tmp_path)
+        try:
+            rig.cb.register_flow("f", bytes=len(PAYLOAD))
+            rig.ca.register_flow("f", bytes=len(PAYLOAD))
+            rig.ca.put("f", PAYLOAD)
+            dcn.wait_flow_rx(rig.ca, "f", len(PAYLOAD), timeout_s=5)
+            d0 = counters.get("fleet.link.dropped")
+            rig.a.set_link_fault("127.0.0.1", rig.b.data_port,
+                                 "drop", 1)
+            # The sender believes the frame left (netem loss)...
+            rig.ca.send("f", "127.0.0.1", rig.b.data_port,
+                        len(PAYLOAD))
+            assert counters.get("fleet.link.dropped") == d0 + 1
+            time.sleep(0.1)
+            stat = next(f for f in rig.cb.stats()["flows"]
+                        if f["flow"] == "f")
+            assert stat["rx_bytes"] == 0  # ...the peer never saw it
+            # The retransmit (budget spent) passes.
+            rig.ca.send("f", "127.0.0.1", rig.b.data_port,
+                        len(PAYLOAD))
+            dcn.wait_flow_rx(rig.cb, "f", len(PAYLOAD), timeout_s=5)
+            assert rig.cb.read("f", len(PAYLOAD)) == PAYLOAD
+        finally:
+            rig.close()
+
+    def test_latency_delays_the_send_path(self, tmp_path):
+        rig = _ShimRig(tmp_path)
+        try:
+            rig.cb.register_flow("f", bytes=len(PAYLOAD))
+            rig.ca.register_flow("f", bytes=len(PAYLOAD))
+            rig.ca.put("f", PAYLOAD)
+            dcn.wait_flow_rx(rig.ca, "f", len(PAYLOAD), timeout_s=5)
+            rig.a.set_link_fault("127.0.0.1", rig.b.data_port,
+                                 "latency", 0.08)
+            t0 = time.monotonic()
+            rig.ca.send("f", "127.0.0.1", rig.b.data_port,
+                        len(PAYLOAD))
+            assert time.monotonic() - t0 >= 0.07
+            dcn.wait_flow_rx(rig.cb, "f", len(PAYLOAD), timeout_s=5)
+            assert rig.cb.read("f", len(PAYLOAD)) == PAYLOAD
+        finally:
+            rig.close()
+
+    def test_latency_capped_and_unknown_action_rejected(self, tmp_path):
+        rig = _ShimRig(tmp_path)
+        try:
+            rig.a.set_link_fault("127.0.0.1", rig.b.data_port,
+                                 "latency", 999.0)
+            with rig.a._lock:
+                st = rig.a._link_faults[("127.0.0.1",
+                                         rig.b.data_port)]
+                assert st["latency_s"] <= 0.25
+            with pytest.raises(ValueError, match="unknown"):
+                rig.a.set_link_fault("127.0.0.1", rig.b.data_port,
+                                     "explode")
+        finally:
+            rig.close()
+
+    def test_restart_clears_armed_faults(self, tmp_path):
+        rig = _ShimRig(tmp_path)
+        try:
+            rig.a.set_link_fault("127.0.0.1", rig.b.data_port,
+                                 "partition")
+            rig.a.stop(crash=True)
+            rig.a.start()
+            with rig.a._lock:
+                assert rig.a._link_faults == {}
+        finally:
+            rig.close()
+
+
+# ---------------------------------------------------------------------------
+# agent_top: the serving panel
+# ---------------------------------------------------------------------------
+
+
+class TestAgentTopServingPanel:
+    def _load(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "agent_top", os.path.join(REPO, "cmd", "agent_top.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_digest_and_render_surface_serving(self):
+        top = self._load()
+        fams = {name: [] for name in top.FAMILIES}
+        fams["agent_rate"] = [({"event": "serving.ok"}, 42.0),
+                              ({"event": "serving.shed"}, 1.5)]
+        fams["agent_gauge"] = [
+            ({"name": "serving.queue.depth"}, 7.0),
+            ({"name": "serving.inflight"}, 2.0),
+            ({"name": "serving.breaker.open_nodes"}, 1.0),
+            ({"name": "slo.min_qps.ok"}, 0.0),
+            ({"name": "slo.min_qps.value"}, 42.0),
+        ]
+        fams["agent_events"] = [
+            ({"event": "serving.ok"}, 940.0),
+            ({"event": "serving.errors"}, 3.0),
+            ({"event": "serving.hedge.fired"}, 11.0),
+            ({"event": "serving.hedge.won"}, 4.0),
+            ({"event": "serving.hedge.wasted"}, 7.0),
+        ]
+        model = top.digest(fams)
+        s = model["serving"]
+        assert s["qps"] == 42.0
+        assert s["queue_depth"] == 7.0
+        assert s["breaker_open"] == 1.0
+        assert s["hedge"] == {"fired": 11.0, "won": 4.0,
+                              "wasted": 7.0}
+        screen = top.render(model, "test")
+        assert "serving:" in screen
+        assert "hedge fired/won/wasted" in screen
+        assert "** BREACH **" in screen  # slo.min_qps.ok = 0
+
+    def test_digest_without_serving_families_has_no_panel(self):
+        top = self._load()
+        fams = {name: [] for name in top.FAMILIES}
+        fams["agent_rate"] = [({"event": "dcn.tx.bytes"}, 10.0)]
+        model = top.digest(fams)
+        assert model["serving"] is None
+        assert "serving:" not in top.render(model, "test")
+
+
+# ---------------------------------------------------------------------------
+# THE serving chaos smoke (tier-1's one full scenario)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestServingScenarioSmoke:
+    def test_node_kill_mid_load_zero_lost_zero_dup(self):
+        """The acceptance scenario in miniature: a serving fleet, one
+        node killed mid-load and restarted, every round's requests
+        terminate exactly once (no lost, no dup — the per-request
+        dedup + termination guarantee), QPS stays above the floor,
+        and the SLO section gates."""
+        report = run_scenario(DEFAULT_SERVING_SCENARIO)
+        assert report["workload"] == "serving"
+        assert report["converged"], report["rounds"][-1]
+        for rnd in report["rounds"]:
+            for leg in rnd["legs"]:
+                assert leg["lost"] == 0, rnd
+                assert leg["accepted"] == leg["ok_requests"] \
+                    + leg["errors"], rnd
+        final = report["rounds"][-1]["legs"][0]
+        assert final["ok"] and final["errors"] == 0
+        # The kill was real: n1 went down and came back.
+        assert report["nodes"]["n1"]["daemon_generation"] == 2
+        slo = report["slo"]
+        assert slo["ok"], slo
+        assert slo["measured"]["min_qps"] > 1.0
+        assert "serving" in report  # breakers + per-node dispatch
+
+
+# ---------------------------------------------------------------------------
+# the scenario matrix + CLI + bench (make fleet-serve; slow for tier-1)
+# ---------------------------------------------------------------------------
+
+
+def _clean_env():
+    env = dict(os.environ)
+    env.pop("TPU_FAULT_SPEC", None)
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestServingScenarios:
+    def test_rack_partition_degrades_then_recovers(self):
+        """Mid-partition rounds may error (every shard read is
+        cross-rack by construction here) but nothing is lost; after
+        the heal the fleet recovers and the run converges under its
+        SLOs."""
+        import copy
+
+        from container_engine_accelerators_tpu.fleet.controller import (
+            load_scenario,
+        )
+
+        scenario = copy.deepcopy(load_scenario(os.path.join(
+            REPO, "scenarios", "serving_rack_partition.json")))
+        report = run_scenario(scenario)
+        assert report["converged"], report["rounds"][-1]
+        assert all(leg["lost"] == 0
+                   for rnd in report["rounds"]
+                   for leg in rnd["legs"])
+        # The partition really degraded service...
+        assert any(leg["errors"] > 0
+                   for rnd in report["rounds"]
+                   for leg in rnd["legs"])
+        # ...and the final round fully recovered.
+        assert report["rounds"][-1]["legs"][0]["ok"]
+        assert report["slo"]["ok"], report["slo"]
+
+    def test_proc_linkfault_serving_scenario(self):
+        """The link-shim satellite's gate: a proc:true serving
+        scenario with drop + latency link faults (armed in the
+        workers' daemons over the RPC pipe) AND a SIGKILL — converges
+        with zero lost requests."""
+        from container_engine_accelerators_tpu.fleet.controller import (
+            load_scenario,
+        )
+
+        scenario = load_scenario(os.path.join(
+            REPO, "scenarios", "serving_proc_linkfault.json"))
+        report = run_scenario(scenario)
+        assert report["proc"] is True
+        assert report["converged"], report["rounds"][-1]
+        assert all(leg["lost"] == 0
+                   for rnd in report["rounds"]
+                   for leg in rnd["legs"])
+        # The link faults were armed, not logged-and-skipped.
+        fired = [f for rnd in report["rounds"] for f in rnd["faults"]
+                 if "link" in f]
+        assert fired and all(f["applied"] > 0 for f in fired)
+        assert report["nodes"]["n1"]["daemon_generation"] == 2
+
+    def test_fleet_sim_cli_serving_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "cmd", "fleet_sim.py"),
+             "--workload", "serving"],
+            capture_output=True, text=True, timeout=300,
+            env=_clean_env(), cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        report = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert report["workload"] == "serving"
+        assert report["converged"] and report["slo"]["ok"]
+
+    def test_fleet_sim_cli_serving_slo_breach_exits_3(self):
+        """A converged serving run that misses an honest floor must
+        exit 3 — the SLO verdict gates the run, not just a table."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "cmd", "fleet_sim.py"),
+             "--workload", "serving", "--slo", "min_qps=1000000"],
+            capture_output=True, text=True, timeout=300,
+            env=_clean_env(), cwd=REPO)
+        assert proc.returncode == 3, proc.stderr[-2000:]
+
+    def test_bench_serving_fleet_emits_qps_series(self):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "cmd", "bench_serving.py"),
+             "--fleet", "--fleet-seconds", "2"],
+            capture_output=True, text=True, timeout=300,
+            env=_clean_env(), cwd=REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        lines = [json.loads(l) for l in proc.stdout.splitlines() if l]
+        windows = [l for l in lines if l.get("mode") == "fleet-serving"]
+        head = [l for l in lines
+                if l.get("metric") == "serving_fleet_sustained_qps"]
+        assert windows, "per-second QPS series missing"
+        assert len(head) == 1
+        assert head[0]["value"] > 0 and head[0]["errors"] == 0
