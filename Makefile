@@ -153,6 +153,45 @@ dcnbench:
 lint:
 	$(PY) cmd/agent_lint.py
 
+# Critical-path gate: the where-did-the-time-go chain end to end —
+# the critpath unit/e2e suite, then one pipelined fleet scenario whose
+# report must carry a non-empty `critical_path` section, the same
+# run's trace JSONL resolved by `agent_trace --critical-path` (exit
+# 0), and the dcn_bench --compare exposed-communication gate (the
+# pipelined lane's exposed ratio must stay below the serial
+# baseline).  Folded into presubmit.
+CRITPATH_TRACE := /tmp/tpu_critpath_trace.jsonl
+CRITPATH_REPORT := /tmp/tpu_critpath_report.json
+
+.PHONY: critpath
+critpath:
+	$(PY) -m pytest tests/test_critpath.py -q -p no:randomly
+	rm -f $(CRITPATH_TRACE) $(CRITPATH_REPORT)
+	$(PY) cmd/fleet_sim.py --rounds 6 --pipelined \
+	    --payload-bytes 262144 --chunk-bytes 65536 \
+	    --trace-file $(CRITPATH_TRACE) > $(CRITPATH_REPORT)
+	@# ^ 6 rounds: the built-in rack partition (round 2, for: 2) must
+	@#   HEAL and the fleet re-converge before the run ends — fewer
+	@#   rounds exits 2 and correctly fails this gate.
+	@# Two commands, not a pipe: fleet_sim's own exit code (2 not
+	@# converged / 3 SLO breach) must fail the gate, and a pipe
+	@# without pipefail would swallow it behind the consumer's 0.
+	$(PY) -c "import json; \
+	    r = json.loads(open('$(CRITPATH_REPORT)').read() \
+	        .strip().splitlines()[-1]); \
+	    cp = r['critical_path']; \
+	    assert cp.get('shapes'), 'empty critical_path section'; \
+	    print('critical_path dominant:', cp.get('dominant_phase'))"
+	$(PY) cmd/agent_trace.py $(CRITPATH_TRACE) \
+	    --critical-path dcn.pipeline > /dev/null
+	$(PY) cmd/dcn_bench.py --compare --min-ratio 0.8 \
+	    --shm-min-ratio 0.1 \
+	    --sizes 1048576,4194304 --iters 3 > /dev/null
+	@# ^ THIS gate is the exposed-comm comparison (pipelined ratio must
+	@#   stay below the serial baseline); the lane-SPEED floors live in
+	@#   `make dcnbench` and are deliberately relaxed here so a loaded
+	@#   builder cannot flake the critical-path gate on scheduling noise.
+
 # Race gate — the `go test -race` analog for the Python surface
 # (ref Makefile:20-36 runs the race detector on every unit suite).
 # The DCN pipeline, fleet (in-process + multi-process), chaos, and obs
@@ -182,6 +221,7 @@ presubmit:
 	bash build/check_shell.sh
 	$(MAKE) lint
 	$(MAKE) race
+	$(MAKE) critpath
 	$(MAKE) fleet-serve
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to

@@ -19,7 +19,12 @@ place:
   on the JSONL) and the metric becomes a span tree;
 - **gauges + SLO status**: in-flight chunks, stripe utilization,
   retransmit ratio, and every ``slo.<key>`` verdict the fleet
-  aggregator published, rendered ok/BREACH.
+  aggregator published, rendered ok/BREACH;
+- **phase breakdown**: where the data plane's time goes, by transfer
+  phase (stage / send / wait / read, socket and shm lanes) — each
+  phase op's share of the summed phase time estimated from the
+  cumulative le buckets, next to the live ``dcn.exposed_ratio`` gauge
+  (DCN time not hidden behind staging; 1.0 = serial-shaped).
 
 Usage:
   python cmd/agent_top.py                       # live, 2s refresh
@@ -96,6 +101,31 @@ def percentile_from_buckets(buckets, total, q):
     return float(max(buckets)) if buckets else 0.0
 
 
+def total_us_from_buckets(buckets):
+    """Upper-bound estimate of an op's summed duration from its
+    cumulative le buckets (the scrape carries no sum): per-bucket
+    count times the bucket bound.  Consistent across ops, so SHARES
+    are honest even though absolutes are upper bounds."""
+    prev = 0
+    out = 0.0
+    for le in sorted(buckets):
+        n = buckets[le] - prev
+        prev = buckets[le]
+        if n > 0:
+            out += n * le
+    return out
+
+
+# The transfer-phase ops the breakdown panel rolls up: one pipelined /
+# serial / shm transfer decomposes into exactly these
+# (parallel/dcn_pipeline.py, parallel/dcn.py).
+PHASE_OPS = (
+    "dcn.chunk.stage", "dcn.chunk.send", "dcn.chunk.wait",
+    "dcn.chunk.read", "dcn.wait", "dcn.shm.stage", "dcn.shm.read",
+    "dcn.exchange.stage", "dcn.exchange.send", "dcn.exchange.land",
+)
+
+
 def digest(fams: dict) -> dict:
     """Family samples -> the screen model."""
     rates = sorted(
@@ -136,6 +166,24 @@ def digest(fams: dict) -> dict:
         })
     latency.sort(key=lambda r: -r["count"])
 
+    # Phase-breakdown panel: the transfer-phase ops' estimated total
+    # time, as shares — "where did the data plane's time go", straight
+    # off the scrape (no JSONL needed for the first-order answer).
+    phase_rows = []
+    phase_total = 0.0
+    for op in PHASE_OPS:
+        entry = per_op.get(op)
+        if not entry or not entry["count"]:
+            continue
+        est = total_us_from_buckets(entry["buckets"])
+        phase_rows.append({"op": op, "count": entry["count"],
+                           "total_us": est})
+        phase_total += est
+    for row in phase_rows:
+        row["share"] = (row["total_us"] / phase_total
+                        if phase_total else 0.0)
+    phase_rows.sort(key=lambda r: -r["total_us"])
+
     gauges, slos = [], {}
     for lb, v in fams["agent_gauge"]:
         name = lb.get("name", "?")
@@ -175,7 +223,8 @@ def digest(fams: dict) -> dict:
         }
     return {"rates": rates, "goodput": goodput,
             "latency": latency, "gauges": gauges, "slos": slos,
-            "serving": serving}
+            "serving": serving, "phases": phase_rows,
+            "exposed_ratio": dict(gauges).get("dcn.exposed_ratio")}
 
 
 # -- render ------------------------------------------------------------------
@@ -223,6 +272,20 @@ def render(model: dict, source: str, top_n: int = 10) -> str:
         lines.append(f"  {'hedge fired/won/wasted':<24} "
                      f"{h['fired']:>6.0f} / {h['won']:.0f} / "
                      f"{h['wasted']:.0f}")
+
+    phases = model.get("phases") or []
+    if phases:
+        lines.append("")
+        lines.append(f"{'phase (where the time goes)':<28} "
+                     f"{'count':>7} {'est_ms':>10} {'share':>7}")
+        for row in phases[:top_n]:
+            lines.append(f"{row['op']:<28} {row['count']:>7} "
+                         f"{row['total_us'] / 1e3:>10.1f} "
+                         f"{row['share'] * 100:>6.1f}%")
+        exposed = model.get("exposed_ratio")
+        if exposed is not None:
+            lines.append(f"{'exposed comm ratio':<28} "
+                         f"{'':>7} {'':>10} {exposed * 100:>6.1f}%")
 
     goodput = [g for g in model["goodput"]][:top_n]
     if goodput:
@@ -301,6 +364,16 @@ def _demo_server():
     timeseries.gauge("dcn.chunks.inflight", 3)
     timeseries.gauge("dcn.stripes.active", 2)
     timeseries.gauge("dcn.stripes.configured", 2)
+    # The phase-breakdown panel's inputs: transfer-phase histogram ops
+    # plus the live exposed-communication gauge.
+    for _ in range(4):
+        with trace.span("dcn.chunk.stage", histogram="dcn.chunk.stage"):
+            pass
+        with trace.span("dcn.chunk.send", histogram="dcn.chunk.send"):
+            time.sleep(0.002)
+    with trace.span("dcn.wait", histogram="dcn.wait"):
+        time.sleep(0.001)
+    timeseries.gauge("dcn.exposed_ratio", 0.42)
     timeseries.gauge("slo.min_goodput_bps.ok", 1)  # lint: disable=undocumented-metric
     timeseries.gauge("slo.min_goodput_bps.value", 4 << 20)  # lint: disable=undocumented-metric
     # The serving workload's panel (serving/frontend.py families).

@@ -20,6 +20,18 @@ flapping node raises:
   slowest span of that name in the JSONL and prints its whole trace
   tree (pass the scraped trace id itself and it resolves that id,
   prefix-matching allowed) — metric → trace without grep.
+- ``--critical-path <op-or-trace-id>``: where did the wall-clock go?
+  Resolves like ``--exemplar`` (an op name picks its slowest span as
+  the root; a trace id, prefix ok, picks that trace's longest root),
+  then prints the DOMINANT CHAIN root → leaf with per-phase
+  percentages, the per-phase self-time rollup of the whole subtree,
+  and the root's coverage (how much of its wall-clock the named child
+  phases attribute) — obs/critpath.py applied to the JSONL.
+
+Torn evidence is expected input: a SIGKILLed worker routinely leaves a
+truncated last JSONL line.  Malformed lines are skipped, COUNTED, and
+reported on stderr and in the JSON result in every mode — never a
+crash, never silent.
 
 Also accepts flight-recorder dumps (obs/flight.py): a line whose
 object carries ``flight_recorder`` contributes its ``spans`` list.
@@ -40,8 +52,16 @@ like trace_summary.py.
 
 import argparse
 import json
+import os
 import sys
 from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Stdlib-only by contract (obs/ is importable without prometheus_client
+# or grpc) — this tool still runs in the barest debug container.
+from container_engine_accelerators_tpu.obs import critpath  # noqa: E402
 
 
 def parse_args(argv=None):
@@ -61,6 +81,12 @@ def parse_args(argv=None):
                    help="resolve a scraped agent_exemplar to its trace "
                         "tree: an op name picks that op's slowest span; "
                         "a trace id (prefix ok) resolves directly")
+    p.add_argument("--critical-path", dest="critical_path",
+                   default=None, metavar="OP|TRACE",
+                   help="render the dominant chain of one trace with "
+                        "per-phase percentages and the subtree's "
+                        "self-time rollup (op name = that op's slowest "
+                        "span as root; trace id prefix ok)")
     return p.parse_args(argv)
 
 
@@ -193,13 +219,80 @@ def resolve_exemplar(spans, key):
     return by_id[0] if by_id else None
 
 
+def resolve_critpath_root(spans, key):
+    """The root span a --critical-path walk starts from: an op name
+    picks that op's slowest span (the one whose time needs
+    explaining); a trace id (prefix ok) picks that trace's LONGEST
+    root span.  None when nothing matches."""
+    named = [s for s in spans if s.get("name") == key]
+    if named:
+        return max(named, key=lambda s: float(s.get("dur_us", 0.0)))
+    hit = [s for s in spans
+           if str(s.get("trace", "")).startswith(key)]
+    if not hit:
+        return None
+    roots, _children = critpath.build_trees(spans,
+                                            hit[0].get("trace"))
+    pool = roots or hit
+    return max(pool, key=lambda s: float(s.get("dur_us") or 0.0))
+
+
+def print_critical_path(spans, root, file=sys.stderr):
+    """The dominant chain + per-phase rollup for one root span;
+    returns the machine-readable dict main() prints as JSON."""
+    trace_id = root.get("trace")
+    _roots, children = critpath.build_trees(spans, trace_id)
+    chain = critpath.critical_path(root, children)
+    rollup_s = critpath.phase_rollup(root, children)
+    total_s = sum(rollup_s.values()) or 1e-12
+    coverage = chain[0]["coverage"]
+    print(f"critical path of trace {trace_id} "
+          f"(root {root.get('name')}, "
+          f"{float(root.get('dur_us') or 0):.0f}us, "
+          f"{coverage * 100:.1f}% attributed to child phases):",
+          file=file)
+    for depth, hop in enumerate(chain):
+        print(f"{'  ' * depth}{hop['name']} {hop['dur_us']:.0f}us "
+              f"{hop['pct_of_root']:.1f}% "
+              f"(self {hop['self_us']:.0f}us)", file=file)
+    print("phase self-time rollup:", file=file)
+    for name, sec in sorted(rollup_s.items(), key=lambda kv: -kv[1]):
+        print(f"  {name:<28} {sec * 1e3:>10.3f}ms "
+              f"{sec / total_s * 100:>5.1f}%", file=file)
+    return {
+        "trace": trace_id,
+        "root": root.get("name"),
+        "dur_us": root.get("dur_us"),
+        "coverage": coverage,
+        "path": chain,
+        "phases": {name: round(sec * 1e3, 3)
+                   for name, sec in rollup_s.items()},
+    }
+
+
 def main(argv=None):
     args = parse_args(argv)
     spans, skipped = load_spans(args.paths)
+    if skipped:
+        # Torn last lines are routine after a SIGKILL; say so in every
+        # mode — evidence quality is part of the answer.
+        print(f"skipped {skipped} malformed line(s) in "
+              f"{', '.join(args.paths)}", file=sys.stderr)
     if not spans:
         raise SystemExit(
             f"no spans in {', '.join(args.paths)} ({skipped} bad lines)"
         )
+    if args.critical_path:
+        root = resolve_critpath_root(spans, args.critical_path)
+        if root is None:
+            raise SystemExit(
+                f"no span named {args.critical_path!r} and no trace "
+                f"id matching it in {', '.join(args.paths)}"
+            )
+        result = print_critical_path(spans, root)
+        result["skipped_lines"] = skipped
+        print(json.dumps({"critical_path": result}))
+        return result
     if args.exemplar:
         hit = resolve_exemplar(spans, args.exemplar)
         if hit is None:
@@ -214,11 +307,13 @@ def main(argv=None):
         n = print_tree(spans, trace_id)
         print(json.dumps({"exemplar": args.exemplar, "trace": trace_id,
                           "name": hit.get("name"),
-                          "dur_us": hit.get("dur_us"), "spans": n}))
+                          "dur_us": hit.get("dur_us"), "spans": n,
+                          "skipped_lines": skipped}))
         return
     if args.trace:
         n = print_tree(spans, args.trace)
-        print(json.dumps({"trace": args.trace, "spans": n}))
+        print(json.dumps({"trace": args.trace, "spans": n,
+                          "skipped_lines": skipped}))
         return
     summary = aggregate(spans, args.top, args.slowest)
     summary["skipped_lines"] = skipped

@@ -20,7 +20,15 @@ planes:
 
 One JSONL record per (mode, size) goes to stdout (or ``--out``), in
 the BENCH_TPU_LOG style: flat keys, one measurement per line, with
-enough config to reproduce.  The human table goes to stderr.
+enough config to reproduce.  The human table goes to stderr.  Each
+record carries the mode's **exposed-communication ratio**
+(``exposed_ratio``: DCN round-trip time not hidden behind staging,
+over total DCN time — obs/critpath.py math recorded live by the data
+plane; 1.0 is the serial baseline, ``--compare`` fails if the
+pipelined lane does not beat it).  Each transfer runs under a
+``bench.xfer`` root span: set ``TPU_TRACE_FILE`` and feed the JSONL to
+``cmd/agent_trace.py --critical-path bench.xfer`` for the per-phase
+story of the slowest transfer.
 
 Usage:
   python cmd/dcn_bench.py                          # default sweep
@@ -50,6 +58,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from container_engine_accelerators_tpu.fleet.xferd import (  # noqa: E402
     PyXferd,
+)
+from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    timeseries,
+    trace,
 )
 from container_engine_accelerators_tpu.parallel import (  # noqa: E402
     dcn,
@@ -130,10 +142,14 @@ class BenchRig:
         shutil.rmtree(self.workdir, ignore_errors=True)
 
     def one_way(self, mode: str, payload: bytes,
-                cfg: dcn_pipeline.PipelineConfig) -> float:
-        """One timed transfer a->b; returns seconds.  Verifies the
-        landed bytes — a bench that measures corrupt transfers fast
-        would be worse than no bench."""
+                cfg: dcn_pipeline.PipelineConfig) -> dict:
+        """One timed transfer a->b; returns ``{elapsed_s,
+        exposed_ratio}`` (``exposed_ratio`` None for memcpy — there is
+        no communication to expose).  Verifies the landed bytes — a
+        bench that measures corrupt transfers fast would be worse than
+        no bench.  Each transfer runs under one ``bench.xfer`` root
+        span, so a TPU_TRACE_FILE run feeds straight into
+        ``agent_trace --critical-path bench.xfer``."""
         n = len(payload)
         if mode == "memcpy":
             # The zero-copy ceiling: stage copy in + read copy out,
@@ -146,41 +162,55 @@ class BenchRig:
             elapsed = time.perf_counter() - t0
             if got != payload:
                 raise RuntimeError("memcpy reference mismatch")
-            return elapsed
+            return {"elapsed_s": elapsed, "exposed_ratio": None}
         self._n += 1
         flow = f"bench-{mode}-{self._n}"
         self.cb.register_flow(flow, peer="bench-a", bytes=n)
         self.ca.register_flow(flow, peer="bench-b", bytes=n)
+        exposed_ratio = None
         try:
             if mode == "shm":
                 # Pre-attach the landing flow (what exchange_shard
                 # does): peer chunks assemble straight into the mmap.
                 self.cb.shm_attach(flow, n)
             t0 = time.perf_counter()
-            if mode == "serial":
-                self.ca.put(flow, payload)
-                dcn.wait_flow_rx(self.ca, flow, n, timeout_s=30)
-                self.ca.send(flow, "127.0.0.1", self.b.data_port, n)
-                dcn.wait_flow_rx(self.cb, flow, n, timeout_s=30)
-                got = self.cb.read(flow, n)
-            else:
-                res = dcn_pipeline.send_pipelined(
-                    self.ca, flow, payload, "127.0.0.1",
-                    self.b.data_port, cfg, timeout_s=30)
-                got = dcn_pipeline.read_pipelined(
-                    self.cb, flow, n, cfg, timeout_s=30)
-                want = "shm" if mode == "shm" else "socket"
-                if res.get("lane") != want:
-                    raise RuntimeError(
-                        f"mode {mode} ran on lane {res.get('lane')!r}"
-                        " — the bench must measure the lane it says"
-                    )
+            with trace.span("bench.xfer", mode=mode, bytes=n):
+                if mode == "serial":
+                    self.ca.put(flow, payload)
+                    dcn.wait_flow_rx(self.ca, flow, n, timeout_s=30)
+                    self.ca.send(flow, "127.0.0.1", self.b.data_port,
+                                 n)
+                    dcn.wait_flow_rx(self.cb, flow, n, timeout_s=30)
+                    # The serial shape overlaps nothing with its
+                    # send+land leg: its exposed ratio is 1.0 by
+                    # construction — the baseline the gate compares
+                    # the pipelined lane against.
+                    exposed_ratio = 1.0
+                    got = self.cb.read(flow, n)
+                else:
+                    res = dcn_pipeline.send_pipelined(
+                        self.ca, flow, payload, "127.0.0.1",
+                        self.b.data_port, cfg, timeout_s=30)
+                    # The live accounting's verdict for THIS transfer
+                    # (send_pipelined just set the gauge).
+                    exposed_ratio = timeseries.gauges().get(
+                        "dcn.exposed_ratio")
+                    got = dcn_pipeline.read_pipelined(
+                        self.cb, flow, n, cfg, timeout_s=30)
+                    want = "shm" if mode == "shm" else "socket"
+                    if res.get("lane") != want:
+                        raise RuntimeError(
+                            f"mode {mode} ran on lane "
+                            f"{res.get('lane')!r} — the bench must "
+                            "measure the lane it says"
+                        )
             elapsed = time.perf_counter() - t0
             if got != payload:
                 raise RuntimeError(
                     f"payload mismatch on {flow} ({mode}, {n} bytes)"
                 )
-            return elapsed
+            return {"elapsed_s": elapsed,
+                    "exposed_ratio": exposed_ratio}
         finally:
             for client in (self.ca, self.cb):
                 try:
@@ -200,20 +230,31 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr):
     cfg_shm = dcn_pipeline.PipelineConfig(
         chunk_bytes=cfg.chunk_bytes, stripes=cfg.stripes, shm=True)
     results = {}
+    exposed = {}
     try:
         print(f"{'bytes':>9} {'mode':>10} {'best_ms':>9} {'med_ms':>9} "
-              f"{'best_MB/s':>10}", file=table)
+              f"{'best_MB/s':>10} {'exposed':>8}", file=table)
         for size in sizes:
             payload = bytes(range(256)) * (size // 256) \
                 + b"\x7f" * (size % 256)
             for mode in MODES:
                 mode_cfg = cfg_shm if mode == "shm" else cfg_socket
-                times = [rig.one_way(mode, payload, mode_cfg)
-                         for _ in range(iters)]
+                runs = [rig.one_way(mode, payload, mode_cfg)
+                        for _ in range(iters)]
+                times = [r["elapsed_s"] for r in runs]
+                ratios = [r["exposed_ratio"] for r in runs
+                          if r["exposed_ratio"] is not None]
                 best = min(times)
                 med = statistics.median(times)
                 mbps = size / best / 1e6
                 results[(mode, size)] = mbps
+                # Exposed-communication series (obs/critpath.py math,
+                # recorded live by the data plane): median across
+                # iterations — 1.0 = fully exposed (the serial
+                # shape), lower = the stage/send overlap hid DCN time.
+                exp_ratio = (round(statistics.median(ratios), 4)
+                             if ratios else None)
+                exposed[(mode, size)] = exp_ratio
                 record = {
                     "bench": "dcn_xfer",
                     "mode": mode,
@@ -222,17 +263,21 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr):
                     "best_s": round(best, 6),
                     "median_s": round(med, 6),
                     "mbps": round(mbps, 2),
+                    "exposed_ratio": exp_ratio,
                     "chunk_bytes": cfg.chunk_bytes,
                     "stripes": cfg.stripes,
                     "ts": round(time.time(), 3),
                 }
                 sink.write(json.dumps(record) + "\n")
                 sink.flush()
+                exp_txt = ("-" if exp_ratio is None
+                           else f"{exp_ratio:.2f}")
                 print(f"{size:>9} {mode:>10} {best * 1e3:>9.1f} "
-                      f"{med * 1e3:>9.1f} {mbps:>10.1f}", file=table)
+                      f"{med * 1e3:>9.1f} {mbps:>10.1f} "
+                      f"{exp_txt:>8}", file=table)
     finally:
         rig.close()
-    return results
+    return results, exposed
 
 
 def main(argv=None):
@@ -245,7 +290,8 @@ def main(argv=None):
                                       stripes=args.stripes)
     out = open(args.out, "a") if args.out else sys.stdout
     try:
-        results = run_sweep(sizes, max(1, args.iters), cfg, out)
+        results, exposed = run_sweep(sizes, max(1, args.iters), cfg,
+                                     out)
     finally:
         if args.out:
             out.close()
@@ -256,9 +302,12 @@ def main(argv=None):
     memcpy = results[("memcpy", largest)]
     ratio = pipelined / serial if serial else float("inf")
     shm_ratio = shm / pipelined if pipelined else float("inf")
+    exp_serial = exposed.get(("serial", largest))
+    exp_pipe = exposed.get(("pipelined", largest))
     print(f"largest size {largest}: pipelined/serial = {ratio:.2f}x, "
           f"shm/pipelined = {shm_ratio:.2f}x, shm at "
-          f"{shm / memcpy * 100 if memcpy else 0:.1f}% of memcpy",
+          f"{shm / memcpy * 100 if memcpy else 0:.1f}% of memcpy, "
+          f"exposed-comm pipelined {exp_pipe} vs serial {exp_serial}",
           file=sys.stderr)
     rc = 0
     if args.compare and ratio < args.min_ratio:
@@ -269,6 +318,17 @@ def main(argv=None):
         print(f"FAIL: shm lane fell below {args.shm_min_ratio:.2f}x "
               f"pipelined at {largest} bytes", file=sys.stderr)
         rc = 1
+    if args.compare:
+        # The overlap gate: the pipelined lane must HIDE some of its
+        # DCN time behind staging — an exposed-comm ratio at or above
+        # the serial baseline (1.0) means the phase overlap the lane
+        # exists for silently stopped happening.
+        if exp_pipe is None or exp_serial is None \
+                or exp_pipe >= exp_serial:
+            print(f"FAIL: pipelined exposed-comm ratio ({exp_pipe}) "
+                  f"is not below serial's ({exp_serial}) at "
+                  f"{largest} bytes", file=sys.stderr)
+            rc = 1
     return rc
 
 

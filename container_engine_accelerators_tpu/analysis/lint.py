@@ -36,6 +36,12 @@ Rules (each a ``@rule`` function; ``--list-rules`` prints this table):
   the MetricServer exports, must appear backticked in the README
   metrics tables (placeholder segments — ``{x}`` in source, ``<x>`` in
   the README — compare as wildcards).
+- ``undocumented-span``   — every span-name literal passed to
+  ``trace.span`` / ``trace.event`` / ``trace.record_span`` must
+  appear backticked in the README span table (same registry and
+  placeholder machinery as ``undocumented-metric``): the span
+  vocabulary IS an API — ``agent_trace --critical-path``, the
+  critical-path shapes, and the fleet report all key on it.
 
 Suppressions are inline and must name their rule:
 ``# lint: disable=<rule>[,<rule>...]`` on the finding's line.
@@ -363,6 +369,53 @@ def metric_names(files: Iterable[str]) -> Dict[str, List[Tuple[str, str,
                         out["histogram"].append((name, path,
                                                  node.lineno))
     return out
+
+
+def span_names(files: Iterable[str]) -> List[Tuple[str, str, int]]:
+    """Every literal span name passed to ``trace.span`` /
+    ``trace.event`` / ``trace.record_span`` in ``files``, as
+    ``(name, path, line)``.  F-string placeholders normalize to
+    wildcards like metric names; dynamic names are not literals and
+    are skipped."""
+    out: List[Tuple[str, str, int]] = []
+    for path in files:
+        try:
+            with open(path) as fh:
+                tree = ast.parse(fh.read(), filename=path)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain[-2:] not in (["trace", "span"],
+                                  ["trace", "event"],
+                                  ["trace", "record_span"]):
+                continue
+            name = _literal_name(node.args[0]) if node.args else None
+            if name:
+                out.append((name, path, node.lineno))
+    return out
+
+
+@rule("undocumented-span",
+      "span-name literal missing from the README span table — the "
+      "span vocabulary is an API (critical-path shapes, agent_trace) "
+      "and every name is documented",
+      project=True)
+def _undocumented_span(files: List[str], cfg: Config):
+    documented = documented_tokens(cfg.readme)
+    # Every sighting is its own finding (line-scoped suppressions,
+    # same rationale as undocumented-metric).
+    for name, path, line in span_names(files):
+        norm = normalize_placeholders(name)
+        if norm in documented:
+            continue
+        yield Finding(
+            "undocumented-span", cfg.relpath(path), line,
+            f"span name {name!r} is not documented in "
+            f"{os.path.basename(cfg.readme)} — add it to the span "
+            f"table (placeholders may be spelled <x>)")
 
 
 def gauge_families(metrics_source: str) -> Set[str]:

@@ -68,7 +68,7 @@ from container_engine_accelerators_tpu.fleet.topology import (
     build_specs,
 )
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import histo, trace
+from container_engine_accelerators_tpu.obs import critpath, histo, trace
 from container_engine_accelerators_tpu.parallel import dcn, dcn_pipeline
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnXferError,
@@ -622,6 +622,13 @@ class FleetController:
             if op.startswith(("fleet.", "xferd.", "dcn."))
         }
         links_report = self.links.report()
+        # Where did the run's wall-clock go: span trees from the
+        # coordinator ring (+ scraped workers in proc mode) rolled up
+        # per request shape, with the dominant phase named
+        # (obs/critpath.py).  A latency-faulted link shows up HERE as
+        # "dcn.chunk.send dominated", not just as a slower p99.
+        critical_path = critpath.analyze(self.telemetry.spans())
+        critical_path["dropped_spans"] = self.telemetry.spans_dropped
         report_extra = {}
         if self.frontend is not None:
             report_extra["serving"] = {
@@ -642,6 +649,7 @@ class FleetController:
             "rounds": round_log,
             "agent_events_delta": delta,
             "agent_latency": latency,
+            "critical_path": critical_path,
             "telemetry": {"rounds": self.telemetry.history},
             "slo": self.telemetry.evaluate(links_report),
             "converged": (survivors_converged and all_up_healthy

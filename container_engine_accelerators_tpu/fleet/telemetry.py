@@ -44,12 +44,23 @@ module adds the quality verdict:
   counters, accumulated restart-aware (a respawned worker's counters
   restart at zero; the aggregator sums increments, not raw values).
 
+- **spans**: each round the aggregator also pages the span evidence
+  the report's ``critical_path`` section (obs/critpath.py) is built
+  from — the coordinator's own ring by cursor (``trace.tail_since``;
+  the transfer clients and the serving frontend live coordinator-side
+  in both modes), plus, in scrape mode, every worker's ``/spans``
+  endpoint under the same timeout/stale discipline as the metric
+  scrape (``fleet.scrape.spans_stale``).  Collection is bounded
+  (``MAX_COLLECTED_SPANS``); overflow drops oldest and is counted,
+  never hidden.
+
 The controller folds :meth:`FleetTelemetry.evaluate`'s result into the
 report's ``slo`` section and ``cmd/fleet_sim.py`` exits non-zero on
 breach — a fleet that converges while violating its goodput floor
 fails CI, not just a dashboard.
 """
 
+import json
 import logging
 import time
 import urllib.error
@@ -57,7 +68,12 @@ import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import histo, promtext, timeseries
+from container_engine_accelerators_tpu.obs import (
+    histo,
+    promtext,
+    timeseries,
+    trace,
+)
 
 log = logging.getLogger(__name__)
 
@@ -65,6 +81,12 @@ log = logging.getLogger(__name__)
 # each under this timeout — a dead node costs the round at most
 # 2 * timeout and a `stale` entry, never a hang.
 DEFAULT_SCRAPE_TIMEOUT_S = 1.0
+
+# Span-collection bounds: per-GET page size against each worker's
+# /spans endpoint, and the retained fleet-wide span cap (oldest spans
+# drop first; the count dropped is reported, never hidden).
+SPANS_SCRAPE_LIMIT = 2048
+MAX_COLLECTED_SPANS = 20000
 
 # SLO key -> (kind, description).  Ceilings fail when value > limit,
 # floors when value < limit.
@@ -83,6 +105,16 @@ SLO_KEYS = {
     "min_qps": ("floor", "completed (ok) serving requests per second"),
     "max_error_ratio": ("ceiling",
                         "errored serving requests / terminated"),
+    # Exposed-communication ceiling (obs/critpath.py): DCN time not
+    # hidden behind staging, over the run's pipelined transfers.  The
+    # inputs (`dcn.exposed` / `dcn.comm` histogram sums) are recorded
+    # by the transfer CLIENTS, which live in the coordinator process
+    # in BOTH fleet modes — so this is judged coordinator-side, no
+    # scrape needed.  A run with no pipelined transfers measures 0.0
+    # (vacuously inside any ceiling).
+    "max_exposed_comm_ratio": ("ceiling",
+                               "exposed DCN time / total DCN time "
+                               "(pipelined transfers, this run)"),
 }
 
 # The latency histogram the p99 ceiling reads; one fleet-sim leg with
@@ -160,6 +192,29 @@ def scrape_metric_server(port: int,
     return parse_prometheus_text(body)
 
 
+def scrape_spans(port: int, since: int,
+                 timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S,
+                 host: str = "127.0.0.1",
+                 limit: int = SPANS_SCRAPE_LIMIT):
+    """One GET of a node's ``/spans?since=<cursor>``: returns
+    ``(spans, next_cursor, dropped)``.  Raises :class:`ScrapeError` on
+    transport/parse trouble — callers apply the same stale discipline
+    as metric scrapes."""
+    url = (f"http://{host}:{int(port)}/spans?since={int(since)}"
+           f"&limit={int(limit)}")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            obj = json.loads(resp.read().decode("utf-8", "replace"))
+        spans = obj.get("spans")
+        cursor = int(obj.get("cursor", since))
+        dropped = int(obj.get("dropped") or 0)
+        if not isinstance(spans, list):
+            raise ValueError("spans is not a list")
+    except (urllib.error.URLError, OSError, ValueError, TypeError) as e:
+        raise ScrapeError(f"span scrape of {url} failed: {e}") from e
+    return spans, cursor, dropped
+
+
 class FleetTelemetry:
     """Scrapes the fleet's telemetry each round and renders the SLO
     verdict at the end of the run.
@@ -194,6 +249,22 @@ class FleetTelemetry:
         self._e2e0: Dict[str, int] = dict(
             histo.snapshot().get(E2E_OP, {}).get("buckets", {}))
         self._serving0 = {k: counters.get(k) for k in SERVING_COUNTERS}
+        # Exposed-comm SLO inputs: run-delta of the dcn.exposed /
+        # dcn.comm histogram SUMS (coordinator-side in both modes —
+        # the transfer clients live here).
+        self._exposed_sum0 = histo.snapshot().get(
+            "dcn.exposed", {}).get("sum_us", 0.0)
+        self._comm_sum0 = histo.snapshot().get(
+            "dcn.comm", {}).get("sum_us", 0.0)
+        # Span collection for the report's critical_path section: the
+        # coordinator's own ring is paged by cursor each round (the
+        # clients' pipeline/serving spans live here); scrape-mode
+        # fleets ALSO page each worker's /spans endpoint, so the
+        # daemon-side halves of the same traces merge in.
+        self._spans: List[dict] = []
+        self._spans_dropped = 0
+        self._local_cursor = 0
+        self._span_cursors: Dict[str, int] = {}
 
     # -- per-round scrape ----------------------------------------------------
 
@@ -225,7 +296,69 @@ class FleetTelemetry:
         sample = {"round": rnd, "nodes": per_node,
                   "links_goodput_bps": per_link}
         self.history.append(sample)
+        self._drain_local_spans()
         return sample
+
+    # -- span collection (the critical_path section's evidence) --------------
+
+    def _keep_spans(self, spans: List[dict]) -> None:
+        self._spans.extend(spans)
+        over = len(self._spans) - MAX_COLLECTED_SPANS
+        if over > 0:
+            del self._spans[:over]
+            self._spans_dropped += over
+
+    def _drain_local_spans(self) -> None:
+        """Page the COORDINATOR's span ring by cursor — per round, so
+        a long scenario outrunning the ring loses (and counts) spans
+        instead of silently keeping only the tail."""
+        spans, self._local_cursor, dropped = trace.tail_since(
+            self._local_cursor)
+        self._spans_dropped += dropped
+        self._keep_spans(spans)
+
+    def _scrape_node_spans(self, name: str, node) -> bool:
+        """One worker's /spans page, same timeout/stale discipline as
+        the metric scrape (one attempt + one retry, degrade to a
+        counted miss — never a hang, never an exception).  The cursor
+        is respawn-aware, like the counter accumulator: a new worker
+        incarnation's ring restarts at sequence 0, so carrying the
+        dead incarnation's cursor would silently skip everything the
+        fresh process recorded — reset to 0 on a generation change."""
+        gen = getattr(getattr(node, "daemon", None), "generation",
+                      None)
+        key = "_gen_" + name
+        if gen is not None and self._span_cursors.get(key) != gen:
+            self._span_cursors[name] = 0
+            self._span_cursors[key] = gen
+        last: Optional[ScrapeError] = None
+        for _attempt in range(2):
+            try:
+                spans, cursor, dropped = scrape_spans(
+                    node.metrics_port,
+                    self._span_cursors.get(name, 0),
+                    self.scrape_timeout_s)
+                self._span_cursors[name] = cursor
+                self._spans_dropped += dropped
+                self._keep_spans(spans)
+                return True
+            except ScrapeError as e:
+                last = e
+        counters.inc("fleet.scrape.spans_stale")
+        log.warning("node %s span scrape degraded to stale: %s",
+                    name, last)
+        return False
+
+    def spans(self) -> List[dict]:
+        """Every span collected so far (coordinator ring + scraped
+        workers), with a final local drain so the report sees the last
+        round's tail — the critical_path section's input."""
+        self._drain_local_spans()
+        return list(self._spans)
+
+    @property
+    def spans_dropped(self) -> int:
+        return self._spans_dropped
 
     # -- HTTP scrape path (process-mode fleets) ------------------------------
 
@@ -265,6 +398,7 @@ class FleetTelemetry:
                 s.value("agent_goodput", scope="node", name=name), 1),
             "down": False,
             "stale": False,
+            "spans_stale": not self._scrape_node_spans(name, node),
             "active_flows": int(s.value("agent_gauge",
                                         name="xferd.active_flows")),
             "transferred": int(s.value("agent_gauge",
@@ -315,6 +449,19 @@ class FleetTelemetry:
     def _leg_p99_ms(self) -> float:
         return self._histo_p99_ms(LEG_OP, self._leg0)
 
+    def _exposed_comm_ratio(self) -> float:
+        """THIS run's exposed-communication ratio: the dcn.exposed /
+        dcn.comm histogram-sum deltas since boot.  0.0 when the run
+        moved no pipelined bytes (nothing to judge)."""
+        snap = histo.snapshot()
+        exp = snap.get("dcn.exposed", {}).get("sum_us", 0.0) \
+            - self._exposed_sum0
+        comm = snap.get("dcn.comm", {}).get("sum_us", 0.0) \
+            - self._comm_sum0
+        if comm <= 0:
+            return 0.0
+        return max(0.0, exp) / comm
+
     def _serving_measurements(self, elapsed_s: float) -> dict:
         """The serving SLO inputs — coordinator-side in BOTH modes:
         the ServingFrontend runs in the controller process, so its
@@ -340,6 +487,7 @@ class FleetTelemetry:
             "min_goodput_bps": delivered_bytes / elapsed_s,
             "max_retransmit_ratio": (drops + dups) / max(1, frames),
             "max_dedup_ratio": dups / max(1, frames),
+            "max_exposed_comm_ratio": self._exposed_comm_ratio(),
             **self._serving_measurements(elapsed_s),
         }
 
@@ -375,6 +523,7 @@ class FleetTelemetry:
             "min_goodput_bps": goodput,
             "max_retransmit_ratio": ratio,
             "max_dedup_ratio": ratio,
+            "max_exposed_comm_ratio": self._exposed_comm_ratio(),
             "stale_entries_skipped": stale_entries,
             **self._serving_measurements(elapsed_s),
         }

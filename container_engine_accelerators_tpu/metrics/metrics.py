@@ -40,14 +40,37 @@ node agent racing its own previous incarnation's socket TIME_WAIT, or a
 stray scraper squatting the port, must not kill the DaemonSet pod), and
 ``rebind`` moves a live server to a fresh port without restarting
 collection.
+
+Besides ``/metrics``, the server answers ``GET /spans?since=<cursor>``
+with the node agent's recent span ring (obs/trace.py) as bounded JSON:
+``{"cursor": N, "dropped": K, "spans": [...]}``.  Callers page by
+passing the returned ``cursor`` back as ``since``; ``dropped`` counts
+spans the ring evicted before they were read (the reader fell behind).
+This is how the process-mode fleet aggregator collects every worker's
+spans for the report's ``critical_path`` section without touching the
+worker's disk — metrics and traces ride one scrape surface.
 """
 
+import json as _json
 import logging
 import threading
 import time
+import urllib.parse
 from typing import Optional, Tuple
 
 from prometheus_client import CollectorRegistry, Gauge, start_http_server
+
+try:  # the /spans-capable server needs prometheus's WSGI surface
+    from wsgiref.simple_server import make_server as _make_server
+
+    from prometheus_client.exposition import (
+        ThreadingWSGIServer as _ThreadingWSGIServer,
+        _SilentHandler,
+        make_wsgi_app as _make_wsgi_app,
+    )
+    _WSGI_OK = True
+except ImportError:  # pragma: no cover — old prometheus_client
+    _WSGI_OK = False
 
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.metrics.devices import (
@@ -55,7 +78,7 @@ from container_engine_accelerators_tpu.metrics.devices import (
     PodResourcesClient,
     TPU_RESOURCE_NAME,
 )
-from container_engine_accelerators_tpu.obs import histo, timeseries
+from container_engine_accelerators_tpu.obs import histo, timeseries, trace
 from container_engine_accelerators_tpu.tpulib.types import HbmInfo, TpuLib
 from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
@@ -70,6 +93,11 @@ RESET_INTERVAL_S = 60.0  # metricsResetInterval analog
 BIND_RETRY = RetryPolicy(
     max_attempts=6, initial_backoff_s=0.2, max_backoff_s=2.0, deadline_s=15.0
 )
+
+# /spans response bounds: the default page and the hard per-GET cap —
+# a scraper that never passes `limit` still gets a bounded body.
+SPANS_DEFAULT_LIMIT = 512
+SPANS_MAX_LIMIT = 2048
 
 _CONTAINER_LABELS = [
     "namespace",
@@ -204,13 +232,64 @@ class MetricServer:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _wsgi_app(self):
+        """The server's one WSGI app: ``/spans`` (bounded JSON from the
+        span ring, cursor-paged) beside the prometheus exposition at
+        every other path — one listener, one port, both surfaces."""
+        metrics_app = _make_wsgi_app(self.registry)
+
+        def app(environ, start_response):
+            if environ.get("PATH_INFO", "") != "/spans":
+                return metrics_app(environ, start_response)
+            qs = urllib.parse.parse_qs(environ.get("QUERY_STRING", ""))
+
+            def qint(key, default):
+                try:
+                    return int(qs.get(key, [default])[0])
+                except (TypeError, ValueError):
+                    return default  # malformed query degrades, 500s not
+
+            since = qint("since", 0)
+            limit = min(max(1, qint("limit", SPANS_DEFAULT_LIMIT)),
+                        SPANS_MAX_LIMIT)
+            spans, cursor, dropped = trace.tail_since(since, limit)
+            body = _json.dumps({
+                "cursor": cursor,
+                "dropped": dropped,
+                "spans": spans,
+            }).encode()
+            start_response("200 OK", [
+                ("Content-Type", "application/json"),
+                ("Content-Length", str(len(body))),
+            ])
+            return [body]
+
+        return app
+
     def _bind(self, retry: RetryPolicy) -> None:
         """Bind the HTTP listener under a retry budget; OSError past the
         budget propagates (a squatted port is a real outage — but it
         costs the caller the budget, not a one-strike crash)."""
 
         def attempt():
-            return start_http_server(self.port, registry=self.registry)
+            if not _WSGI_OK:  # pragma: no cover — old prometheus_client
+                # Degraded: metrics only, no /spans (span scrapes then
+                # read as stale; the fleet report says so per node).
+                log.error("prometheus_client lacks the WSGI surface; "
+                          "/spans endpoint unavailable")
+                return start_http_server(self.port,
+                                         registry=self.registry)
+
+            class _Server(_ThreadingWSGIServer):
+                """Per-bind subclass (prometheus does the same) so
+                address_family tweaks never leak between servers."""
+
+            httpd = _make_server("0.0.0.0", self.port, self._wsgi_app(),
+                                 _Server, handler_class=_SilentHandler)
+            t = threading.Thread(target=httpd.serve_forever,
+                                 name="tpu-metrics-http", daemon=True)
+            t.start()
+            return httpd, t
 
         bound = retry.call(
             attempt,

@@ -9,6 +9,8 @@ contract with a blocked-import subprocess.
 
 - ``obs.trace``       spans: trace/span ids, thread-local context,
                       JSONL sink (``TPU_TRACE_FILE``) + ring buffer
+- ``obs.critpath``    critical-path engine: span-tree reconstruction,
+                      per-phase self time, exposed-communication math
 - ``obs.histo``       log2-bucket latency histograms with percentiles
                       and per-bucket trace exemplars
 - ``obs.timeseries``  windowed ring-bucket rates + explicit gauges
@@ -19,6 +21,7 @@ contract with a blocked-import subprocess.
 """
 
 from container_engine_accelerators_tpu.obs import (
+    critpath,
     flight,
     histo,
     promtext,
@@ -26,4 +29,5 @@ from container_engine_accelerators_tpu.obs import (
     trace,
 )
 
-__all__ = ["flight", "histo", "promtext", "timeseries", "trace"]
+__all__ = ["critpath", "flight", "histo", "promtext", "timeseries",
+           "trace"]

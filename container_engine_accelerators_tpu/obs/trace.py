@@ -42,6 +42,20 @@ shares a fate.  The in-memory ring is NOT sampled (the flight recorder
 must always have the tail).  A malformed rate degrades to
 sample-everything: a config typo must never blind a node agent.
 
+Sink bounding: ``TPU_TRACE_MAX_BYTES`` caps the JSONL sink with a
+size-triggered rotation — when the file passes the cap it is renamed
+to ``<path>.1`` (ONE kept generation, the previous ``.1`` replaced)
+and a fresh file is opened, so a long fleet/serving run can hold at
+most ~2x the cap on disk.  Unset/0 means unbounded (the historical
+behavior); a malformed value degrades to unbounded, and a failed
+rotation disables rotation but never the sink.
+
+Ring cursor: every recorded span gets a process-wide sequence number;
+:func:`tail_since` returns the spans recorded after a cursor (bounded
+by the ring) plus the new cursor and how many were evicted unseen —
+what the MetricServer's ``/spans?since=`` endpoint and the fleet
+telemetry span collector page through.
+
 Kept stdlib-only, like metrics/counters.py, so utils/ and parallel/
 import it without dragging in prometheus_client or grpc.  A sink write
 failure is logged once and disables the sink — tracing must never take
@@ -63,6 +77,7 @@ TRACE_FILE_ENV = "TPU_TRACE_FILE"
 RING_CAPACITY_ENV = "TPU_TRACE_RING"
 TRACE_SAMPLE_ENV = "TPU_TRACE_SAMPLE"
 TRACE_CONTEXT_ENV = "TPU_TRACE_CONTEXT"
+TRACE_MAX_BYTES_ENV = "TPU_TRACE_MAX_BYTES"
 DEFAULT_RING_CAPACITY = 512
 
 
@@ -130,6 +145,11 @@ _sink = None
 _sink_path: Optional[str] = None
 # Sample rate: None = unresolved (consult env on next span).
 _sample_rate: Optional[float] = None
+# Sink rotation cap: None = unresolved, 0 = unbounded.
+_max_bytes: Optional[int] = None
+# Process-wide cursor: sequence number of the most recently recorded
+# span (ring and sink share it; tail_since pages by it).
+_seq = 0
 
 
 def _new_id(nbytes: int) -> str:
@@ -219,19 +239,74 @@ def _resolve_sink():
     return _sink
 
 
+def _resolve_max_bytes() -> int:
+    """Parse TPU_TRACE_MAX_BYTES once; <= 0 or malformed means
+    unbounded (the TPU_FAULT_SPEC rule: a typo'd cap must not cost
+    evidence)."""
+    global _max_bytes
+    if _max_bytes is None:
+        _max_bytes = max(0, _env_int(TRACE_MAX_BYTES_ENV, 0))
+    return _max_bytes
+
+
+def _maybe_rotate(sink) -> None:
+    """Size-capped sink rotation: past the cap, the live file becomes
+    ``<path>.1`` (replacing any previous generation) and a fresh file
+    opens.  Called under _lock.  A failed rotation disables rotation
+    for this process — never the sink itself."""
+    global _sink, _max_bytes
+    cap = _resolve_max_bytes()
+    if not cap or not _sink_path:
+        return
+    try:
+        if sink.tell() < cap:
+            return
+        # Multi-writer guard: several processes may share one
+        # TPU_TRACE_FILE path (fleet workers inherit the coordinator's
+        # env).  Only the writer whose fd still IS the live path may
+        # rename it — if another process rotated first, our fd now
+        # points at the .1 generation, and renaming the path again
+        # would clobber that process's fresh live file with it.  Skip
+        # the rename and just reopen the live path instead.
+        try:
+            live = os.stat(_sink_path)
+            fd = os.fstat(sink.fileno())
+            owns_live = (fd.st_ino == live.st_ino
+                         and fd.st_dev == live.st_dev)
+        except OSError:
+            owns_live = False  # path vanished: nothing to rename
+        sink.close()
+        if owns_live:
+            os.replace(_sink_path, _sink_path + ".1")
+        _sink = open(_sink_path, "a", buffering=1)
+    except OSError as e:
+        log.error("trace sink rotation of %s failed: %s; rotation "
+                  "disabled (sink stays on)", _sink_path, e)
+        _max_bytes = 0
+        if _sink is None or _sink is False or _sink.closed:
+            try:
+                _sink = open(_sink_path, "a", buffering=1)
+            except OSError as e2:
+                log.error("trace sink reopen failed: %s; disabling "
+                          "sink", e2)
+                _sink = False
+
+
 def _record(span: Span) -> None:
     d = span.to_dict()
-    global _sink
+    global _sink, _seq
     with _lock:
         # The ring is never sampled: the flight recorder's tail must
         # exist even at aggressive sink sampling rates.
         _ring.append(d)
+        _seq += 1
         if not sampled(span.trace_id):
             return
         sink = _resolve_sink()
         if sink:
             try:
                 sink.write(json.dumps(d) + "\n")
+                _maybe_rotate(_sink)
             except (OSError, ValueError) as e:  # ValueError: closed file
                 log.error("trace sink write failed: %s; disabling sink", e)
                 _sink = False
@@ -279,6 +354,34 @@ def event(name: str, **attrs: Any) -> None:
     transitions, announcements)."""
     with span(name, **attrs):
         pass
+
+
+def record_span(name: str, duration_s: float,
+                end_ts: Optional[float] = None,
+                trace_id: Optional[str] = None,
+                parent_id: Optional[str] = None,
+                status: str = "ok", **attrs: Any) -> Span:
+    """Record an already-measured interval as a completed span — for
+    phases whose start and end were observed on DIFFERENT threads
+    (serving queue wait: submitted on the caller's thread, cut on the
+    batcher's), where no ``with span(...)`` block can bracket them.
+    ``end_ts`` is the wall-clock end (now when None); trace/parent
+    default to the calling thread's active span so recorded phases
+    nest like live ones."""
+    cur = current()
+    s = Span(
+        name,
+        trace_id=trace_id or (cur.trace_id if cur else _new_id(8)),
+        span_id=_new_id(4),
+        parent_id=parent_id or (cur.span_id if cur else None),
+        attrs=dict(attrs),
+    )
+    s.status = status
+    s.duration_s = max(0.0, float(duration_s))
+    s.ts = (end_ts if end_ts is not None else time.time()) \
+        - s.duration_s
+    _record(s)
+    return s
 
 
 @contextlib.contextmanager
@@ -345,13 +448,36 @@ def tail(n: Optional[int] = None) -> List[Dict[str, Any]]:
     return spans if n is None else spans[-n:]
 
 
+def tail_since(cursor: int, limit: Optional[int] = None):
+    """Cursor-paged ring read: ``(spans, next_cursor, dropped)`` where
+    ``spans`` are the (oldest-first) spans recorded after ``cursor``
+    that are still in the ring, ``next_cursor`` is what the caller
+    passes next time, and ``dropped`` counts spans recorded after the
+    cursor but already evicted (the ring outran the reader).  With
+    ``limit``, at most that many are returned and the cursor advances
+    only past them — nothing is silently skipped.  What the
+    ``/spans?since=`` endpoint serves."""
+    cursor = max(0, int(cursor))
+    with _lock:
+        last = _seq
+        behind = max(0, last - cursor)
+        avail = min(len(_ring), behind)
+        dropped = behind - avail
+        if limit is not None and avail > int(limit):
+            take = max(0, int(limit))
+            spans = list(_ring)[-avail:][:take]
+            return spans, cursor + dropped + take, dropped
+        spans = list(_ring)[-avail:] if avail else []
+        return spans, last, dropped
+
+
 def configure(path: Optional[str] = None,
               ring_capacity: Optional[int] = None) -> None:
     """Point the sink at ``path`` (None ⇒ re-resolve from env on next
     span) and optionally resize the ring.  Tests and long-lived agents
     rotating their trace file use this; plain processes just set
     ``TPU_TRACE_FILE`` before the first span."""
-    global _sink, _sink_path, _ring, _sample_rate
+    global _sink, _sink_path, _ring, _sample_rate, _max_bytes
     with _lock:
         if _sink:
             try:
@@ -361,17 +487,20 @@ def configure(path: Optional[str] = None,
         _sink = None
         _sink_path = path
         _sample_rate = None  # re-resolve TPU_TRACE_SAMPLE too
+        _max_bytes = None  # re-resolve TPU_TRACE_MAX_BYTES too
         if ring_capacity is not None:
             _ring = deque(_ring, maxlen=ring_capacity)
 
 
 def reset() -> None:
-    """Drop buffered spans and forget the resolved sink and sample rate
-    (test isolation; the next span re-reads TPU_TRACE_FILE /
-    TPU_TRACE_SAMPLE)."""
-    global _sink, _sink_path, _sample_rate
+    """Drop buffered spans and forget the resolved sink, sample rate,
+    and ring cursor (test isolation; the next span re-reads
+    TPU_TRACE_FILE / TPU_TRACE_SAMPLE).  Production readers never see
+    this — a live agent's cursor only moves forward."""
+    global _sink, _sink_path, _sample_rate, _max_bytes, _seq
     with _lock:
         _ring.clear()
+        _seq = 0
         if _sink:
             try:
                 _sink.close()
@@ -380,3 +509,4 @@ def reset() -> None:
         _sink = None
         _sink_path = None
         _sample_rate = None
+        _max_bytes = None

@@ -215,7 +215,7 @@ def exchange_shard(
     the rendezvous honest, but no bytes are staged, sent, or read.
     """
     from container_engine_accelerators_tpu.metrics import counters
-    from container_engine_accelerators_tpu.obs import trace
+    from container_engine_accelerators_tpu.obs import histo, timeseries, trace
     from container_engine_accelerators_tpu.parallel import dcn_pipeline
     from container_engine_accelerators_tpu.parallel.dcn_client import (
         DcnXferError,
@@ -268,12 +268,25 @@ def exchange_shard(
                             histogram="dcn.exchange.stage"):
                 client.put(local_flow, data)
                 wait_flow_rx(client, local_flow, nbytes, timeout_s)
+            t_comm0 = time.monotonic()
             with trace.span("dcn.exchange.send",
                             histogram="dcn.exchange.send"):
                 client.send(local_flow, peer_host, peer_port, nbytes)
             with trace.span("dcn.exchange.land",
                             histogram="dcn.exchange.land"):
                 wait_flow_rx(client, peer_flow, nbytes, timeout_s)
+            # The serial leg by construction overlaps NOTHING with its
+            # send+land phases: its whole DCN time is exposed.  Feed
+            # the same histograms the pipelined lane feeds so the
+            # exposed-comm ratio compares the shapes honestly
+            # (ratio 1.0 is the serial baseline the pipeline beats).
+            comm_s = time.monotonic() - t_comm0
+            if comm_s > 0:
+                cur = trace.current()
+                tid = cur.trace_id if cur is not None else None
+                histo.observe("dcn.exposed", comm_s, trace_id=tid)
+                histo.observe("dcn.comm", comm_s, trace_id=tid)
+                timeseries.gauge("dcn.exposed_ratio", 1.0)
             got = client.read(peer_flow, nbytes)
             if len(got) != nbytes:
                 # With chunked peers, rx accounting can reach nbytes

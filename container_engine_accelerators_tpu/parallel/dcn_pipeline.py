@@ -73,7 +73,7 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import timeseries, trace
+from container_engine_accelerators_tpu.obs import critpath, histo, timeseries, trace
 from container_engine_accelerators_tpu.parallel import dcn_shm
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnWaitUnsupported,
@@ -235,11 +235,15 @@ _recv_exact = netio.recv_exact
 
 
 class _StripeResult:
-    """Shared per-transfer scoreboard: chunk index -> verdict."""
+    """Shared per-transfer scoreboard: chunk index -> verdict, plus
+    the monotonic phase intervals the exposed-communication accounting
+    needs (``stage`` = local staging, ``comm`` = daemon round trips
+    that move/settle bytes toward the peer)."""
 
     def __init__(self):
         self.verdicts: Dict[int, str] = {}
         self.errors: List[BaseException] = []
+        self.phases: Dict[str, List[Tuple[float, float]]] = {}
         self._lock = threading.Lock()
 
     def record(self, idx: int, verdict: str) -> None:
@@ -249,6 +253,10 @@ class _StripeResult:
     def fail(self, exc: BaseException) -> None:
         with self._lock:
             self.errors.append(exc)
+
+    def phase(self, kind: str, t0: float, t1: float) -> None:
+        with self._lock:
+            self.phases.setdefault(kind, []).append((t0, t1))
 
 
 def _stage_worker(data_host: str, data_port: int, flow: str, data,
@@ -270,15 +278,19 @@ def _stage_worker(data_host: str, data_port: int, flow: str, data,
             _set_nodelay(dsock)
             for idx in idxs:
                 off, ln = chunks[idx]
-                with trace.span("dcn.chunk.stage",
-                                histogram="dcn.chunk.stage",
-                                flow=flow, off=off, bytes=ln):
-                    netio.sendall_parts(dsock, (
-                        _chunk_frame_header(flow, ln, {
-                            "off": off, "tot": total, "xid": xid,
-                        }),
-                        view[off:off + ln],
-                    ))
+                t0 = time.monotonic()
+                try:
+                    with trace.span("dcn.chunk.stage",
+                                    histogram="dcn.chunk.stage",
+                                    flow=flow, off=off, bytes=ln):
+                        netio.sendall_parts(dsock, (
+                            _chunk_frame_header(flow, ln, {
+                                "off": off, "tot": total, "xid": xid,
+                            }),
+                            view[off:off + ln],
+                        ))
+                finally:
+                    result.phase("stage", t0, time.monotonic())
     except (DcnXferError, OSError) as e:
         result.fail(e)
     finally:
@@ -301,6 +313,7 @@ def _send_chunk(ctl, flow: str, chunks, seqs, idx: int, xid: str,
     off, ln = chunks[idx]
     span_attrs = {"lane": lane} if lane else {}
     timeseries.gauge_add("dcn.chunks.inflight", 1)
+    t0 = time.monotonic()
     try:
         with trace.span("dcn.chunk.send", histogram="dcn.chunk.send",
                         flow=flow, off=off, bytes=ln, seq=seqs[idx],
@@ -313,6 +326,7 @@ def _send_chunk(ctl, flow: str, chunks, seqs, idx: int, xid: str,
             )
     finally:
         timeseries.gauge_add("dcn.chunks.inflight", -1)
+        result.phase("comm", t0, time.monotonic())
     verdict = resp.get("verdict", "sent")
     if verdict in ("sent", "landed", "dup"):
         # Count CONFIRMED chunks only (the README table's contract);
@@ -391,19 +405,25 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                 if not (already_staged
                         and int(resp.get("frame_bytes") or 0)
                         >= nbytes):
-                    with trace.span("dcn.shm.stage",
-                                    histogram="dcn.shm.stage",
-                                    flow=flow, bytes=nbytes, xid=xid):
-                        seg = dcn_shm.map_segment(
-                            resp.get("path", ""),
-                            int(resp.get("bytes") or 0))
-                        if seg.size < nbytes:
-                            raise OSError(
-                                "segment smaller than payload")
-                        src = memoryview(data)
-                        for off, ln in chunks:
-                            seg.view[off:off + ln] = src[off:off + ln]
-                        ctl.shm_commit(flow, nbytes, xid)
+                    t0 = time.monotonic()
+                    try:
+                        with trace.span("dcn.shm.stage",
+                                        histogram="dcn.shm.stage",
+                                        flow=flow, bytes=nbytes,
+                                        xid=xid):
+                            seg = dcn_shm.map_segment(
+                                resp.get("path", ""),
+                                int(resp.get("bytes") or 0))
+                            if seg.size < nbytes:
+                                raise OSError(
+                                    "segment smaller than payload")
+                            src = memoryview(data)
+                            for off, ln in chunks:
+                                seg.view[off:off + ln] = \
+                                    src[off:off + ln]
+                            ctl.shm_commit(flow, nbytes, xid)
+                    finally:
+                        result.phase("stage", t0, time.monotonic())
                     timeseries.record("dcn.shm.tx.bytes", nbytes)
             except (DcnXferError, OSError) as e:
                 result.fail(e)
@@ -427,6 +447,27 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                 ctl.close()
             except OSError:
                 pass
+
+
+def _observe_exposed(span, comm_iv, stage_iv) -> None:
+    """Exposed-communication time for one completed transfer: DCN
+    round-trip time NOT overlapped by local staging (obs/critpath's
+    interval algebra — the same math the offline analyzer applies to
+    span trees).  Feeds the ``dcn.exposed`` / ``dcn.comm`` histogram
+    pair (their run-delta sums are the ``max_exposed_comm_ratio`` SLO
+    input) and the live ``dcn.exposed_ratio`` gauge: 1.0 = nothing
+    hidden (the serial shape), 0.0 = the whole DCN leg rode behind
+    staging (the T3 goal)."""
+    comm_s = critpath.covered_s(comm_iv)
+    if comm_s <= 0:
+        return
+    exp_s = critpath.exposed_s(comm_iv, stage_iv)
+    histo.observe("dcn.exposed", exp_s, trace_id=span.trace_id)
+    histo.observe("dcn.comm", comm_s, trace_id=span.trace_id)
+    ratio = exp_s / comm_s
+    timeseries.gauge("dcn.exposed_ratio", ratio)
+    span.annotate(exposed_ms=round(exp_s * 1e3, 3),
+                  exposed_ratio=round(ratio, 4))
 
 
 def send_pipelined(client, flow: str, data: bytes, host: str,
@@ -486,6 +527,11 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     resent = 0  # chunk-sends beyond the first round (retransmits)
     lanes = set()  # lanes that actually ran a round
     shm_broken = False  # shm machinery failed once: stay on sockets
+    # Exposed-communication accounting across ALL rounds: staging
+    # intervals vs daemon-round-trip intervals, unioned per transfer —
+    # retransmit rounds are honest cost, not excluded noise.
+    stage_iv: List[Tuple[float, float]] = []
+    comm_iv: List[Tuple[float, float]] = []
     with trace.span("dcn.pipeline", histogram="dcn.pipeline",
                     flow=flow, bytes=nbytes, chunks=len(chunks),
                     stripes=stripes, xid=xid) as span:
@@ -533,31 +579,42 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
             if not ran_shm:
                 lanes.add("socket")
                 data_port = client.data_port()
-                workers = [threading.Thread(
-                    target=_stage_worker,
-                    args=("127.0.0.1", data_port, flow, data, chunks,
-                          list(pending), xid, nbytes, timeout_s,
-                          result, ctx),
-                    name=f"dcn-stage-{flow}",
-                    daemon=True,
-                )]
-                for s in range(stripes):
-                    idxs = pending[s::stripes]
-                    if not idxs:
-                        continue
-                    workers.append(threading.Thread(
-                        target=_send_worker,
-                        args=(uds_dir, flow, chunks, seqs, idxs, xid,
-                              host, port, nbytes, timeout_s, result,
-                              ctx),
-                        name=f"dcn-stripe-{flow}-{s}",
+                # The round's "wait" phase: the coordinator parked on
+                # its stage/stripe workers.  The worker spans parent
+                # UNDER it (wctx), so its SELF time is exactly the
+                # un-attributed remainder — thread spawn + join tail —
+                # and a critical-path walk descends through it into
+                # whichever worker phase dominated.
+                with trace.span("dcn.chunk.wait",
+                                histogram="dcn.chunk.wait", flow=flow,
+                                round=rnd, chunks=len(pending)):
+                    wctx = trace.context()
+                    workers = [threading.Thread(
+                        target=_stage_worker,
+                        args=("127.0.0.1", data_port, flow, data,
+                              chunks, list(pending), xid, nbytes,
+                              timeout_s, result, wctx),
+                        name=f"dcn-stage-{flow}",
                         daemon=True,
-                    ))
-                for t in workers:
-                    t.start()
-                for t in workers:
-                    t.join(timeout=max(0.0,
-                                       deadline - time.monotonic()))
+                    )]
+                    for s in range(stripes):
+                        idxs = pending[s::stripes]
+                        if not idxs:
+                            continue
+                        workers.append(threading.Thread(
+                            target=_send_worker,
+                            args=(uds_dir, flow, chunks, seqs, idxs,
+                                  xid, host, port, nbytes, timeout_s,
+                                  result, wctx),
+                            name=f"dcn-stripe-{flow}-{s}",
+                            daemon=True,
+                        ))
+                    for t in workers:
+                        t.start()
+                    for t in workers:
+                        t.join(timeout=max(0.0,
+                                           deadline
+                                           - time.monotonic()))
                 if any(t.is_alive() for t in workers):
                     # Budget spent with workers still wedged (daemon
                     # hung mid-op): surface now; the daemon-thread
@@ -578,11 +635,14 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                        if result.verdicts.get(i)
                        not in ("sent", "landed", "dup")]
             last_errors = result.errors
+            stage_iv.extend(result.phases.get("stage", ()))
+            comm_iv.extend(result.phases.get("comm", ()))
             span.annotate(round=rnd, pending=len(pending),
                           lane="+".join(sorted(lanes)))
             timeseries.gauge("dcn.pipeline.retransmit_ratio",
                              resent / len(chunks))
             if not pending:
+                _observe_exposed(span, comm_iv, stage_iv)
                 return {"bytes": nbytes, "chunks": len(chunks),
                         "stripes": stripes, "rounds": rnd + 1,
                         "lane": "+".join(sorted(lanes))}
@@ -619,7 +679,13 @@ def read_pipelined(client, flow: str, nbytes: int,
         return b""
     cfg = cfg or PipelineConfig()
     try:
-        client.wait_rx(flow, nbytes, timeout_s=timeout_s, mode="frame")
+        # The read side's "wait" phase gets its own span so a
+        # critical-path walk separates "the peer was slow to finish
+        # assembling" from "the read-back itself was slow".
+        with trace.span("dcn.wait", histogram="dcn.wait", flow=flow,
+                        bytes=nbytes):
+            client.wait_rx(flow, nbytes, timeout_s=timeout_s,
+                           mode="frame")
     except (DcnWaitUnsupported, AttributeError):
         # Wait-less daemon: land-wait by polling, then the base64
         # read — with the same short-read check as the DXR1 path, so

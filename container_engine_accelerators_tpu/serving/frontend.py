@@ -189,12 +189,17 @@ class Request:
 class _Batch:
     """One cut batch: the dispatch unit.  Holds the concatenated
     payload, per-request slicing, and the hedge race state (winner,
-    per-role cancel tokens)."""
+    per-role cancel tokens).  ``ctx`` is the batch's trace context
+    (set by the dispatcher's ``serving.batch`` span): every attempt —
+    primary AND hedge — attaches to it, so one request's whole
+    admit→cut→attempt→hedge story reads as ONE trace."""
 
     def __init__(self, bid: int, requests: List[Request]):
         self.bid = bid
         self.requests = requests
         self.payload = b"".join(r.payload for r in requests)
+        self.t_cut = time.monotonic()
+        self.ctx: Optional[dict] = None
         self.hedged = False
         self.winner: Optional[str] = None
         self.errors: Dict[str, str] = {}
@@ -437,41 +442,74 @@ class ServingFrontend:
         return min(max(p_us / 1e6, floor),
                    self.cfg.request_timeout_s / 2)
 
+    def _record_wait_spans(self, batch: _Batch, bspan) -> None:
+        """The admit→cut phases, recorded as completed child spans of
+        the batch: per-request ``serving.queue.wait`` (submit → cut,
+        measured across threads — no ``with`` block can bracket it)
+        and one ``serving.batch.wait`` (cut → dispatch start).  This
+        is what lets the critical-path engine answer "was it the
+        queue, the cutter, or the attempt?" per request shape."""
+        now_mono = time.monotonic()
+        now_wall = time.time()
+        cut_wall = now_wall - (now_mono - batch.t_cut)
+        for req in batch.requests:
+            trace.record_span(
+                "serving.queue.wait",
+                duration_s=batch.t_cut - req.t_submit,
+                end_ts=cut_wall,
+                trace_id=bspan.trace_id, parent_id=bspan.span_id,
+                rid=req.rid)
+        trace.record_span(
+            "serving.batch.wait",
+            duration_s=now_mono - batch.t_cut,
+            end_ts=now_wall,
+            trace_id=bspan.trace_id, parent_id=bspan.span_id,
+            batch=batch.bid)
+
     def _dispatch(self, batch: _Batch) -> None:
         timeseries.gauge_add("serving.inflight", 1)
         deadline = time.monotonic() + self.cfg.request_timeout_s
         try:
-            primary = self._attempt_pool.submit(
-                self._attempt_seq, batch, "primary", deadline)
-            futures = [primary]
-            hedge_s = self._hedge_deadline_s()
-            try:
-                primary.result(
-                    timeout=min(hedge_s,
-                                max(0.0,
-                                    deadline - time.monotonic())))
-            except _FutureTimeout:
+            with trace.span("serving.batch", batch=batch.bid,
+                            requests=len(batch.requests),
+                            bytes=len(batch.payload)) as bspan:
+                batch.ctx = trace.context()
+                self._record_wait_spans(batch, bspan)
+                primary = self._attempt_pool.submit(
+                    self._attempt_seq, batch, "primary", deadline)
+                futures = [primary]
+                hedge_s = self._hedge_deadline_s()
+                try:
+                    primary.result(
+                        timeout=min(hedge_s,
+                                    max(0.0,
+                                        deadline - time.monotonic())))
+                except _FutureTimeout:
+                    if not batch.done():
+                        batch.hedged = True
+                        counters.inc("serving.hedge.fired")
+                        futures.append(self._attempt_pool.submit(
+                            self._attempt_seq, batch, "hedge",
+                            deadline))
+                # Wait the race out: done the moment anything
+                # delivers, or every attempt sequence has given up, or
+                # the budget is up.
+                while (not batch.done()
+                       and any(not f.done() for f in futures)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.002)
+                if batch.hedged:
+                    if batch.winner == "hedge":
+                        counters.inc("serving.hedge.won")
+                    elif batch.winner == "primary":
+                        counters.inc("serving.hedge.wasted")
                 if not batch.done():
-                    batch.hedged = True
-                    counters.inc("serving.hedge.fired")
-                    futures.append(self._attempt_pool.submit(
-                        self._attempt_seq, batch, "hedge", deadline))
-            # Wait the race out: done the moment anything delivers, or
-            # every attempt sequence has given up, or the budget is up.
-            while (not batch.done()
-                   and any(not f.done() for f in futures)
-                   and time.monotonic() < deadline):
-                time.sleep(0.002)
-            if batch.hedged:
-                if batch.winner == "hedge":
-                    counters.inc("serving.hedge.won")
-                elif batch.winner == "primary":
-                    counters.inc("serving.hedge.wasted")
-            if not batch.done():
-                why = "; ".join(f"{r}: {e}" for r, e
-                                in sorted(batch.errors.items())) \
-                    or "request timeout"
-                batch.terminate(f"all attempts failed ({why})")
+                    why = "; ".join(f"{r}: {e}" for r, e
+                                    in sorted(batch.errors.items())) \
+                        or "request timeout"
+                    batch.terminate(f"all attempts failed ({why})")
+                bspan.annotate(hedged=batch.hedged,
+                               winner=batch.winner)
         except Exception as e:
             # An exception type _attempt_seq doesn't anticipate
             # re-raises out of primary.result() and would skip the
@@ -487,7 +525,18 @@ class ServingFrontend:
                      deadline: float) -> bool:
         """One role's bounded failover sequence: try up to
         ``attempts`` (breaker-allowed, preferably distinct) nodes
-        until one delivers.  Returns whether this role won."""
+        until one delivers.  Returns whether this role won.  Attempts
+        run on pool threads, so they JOIN the batch's trace
+        explicitly (``batch.ctx``): hedge winner and loser share the
+        request's trace id, and a cancelled loser's span still closes
+        (status ``error``) into the ring — the race leaves no open
+        spans behind."""
+        ctx = batch.ctx or {}
+        with trace.attach(ctx.get("trace"), ctx.get("span")):
+            return self._attempt_seq_traced(batch, role, deadline)
+
+    def _attempt_seq_traced(self, batch: _Batch, role: str,
+                            deadline: float) -> bool:
         cancel = batch.cancel_token(role)
         budget = (self.cfg.attempts if role == "primary"
                   else self.cfg.hedge_attempts)

@@ -1,0 +1,706 @@
+"""Critical-path engine (ISSUE 10): obs/critpath.py, the span-ring
+cursor + /spans endpoint, trace-sink rotation, exposed-communication
+accounting, the fleet report's ``critical_path`` section, the
+``max_exposed_comm_ratio`` SLO, and ``agent_trace --critical-path``.
+
+Tier-1 keeps the deterministic units (interval algebra, tree analysis,
+cursor semantics, rotation, the /spans endpoint, the SLO evaluation,
+CLI behavior on synthetic JSONL).  The scenario/e2e legs — a proc-mode
+fleet under a latency link fault whose dominant phase must be the DCN
+send leg, and the loopback 4 MiB bench acceptance — are ``slow``-marked
+(``make critpath`` runs everything).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+from prometheus_client import CollectorRegistry
+
+from container_engine_accelerators_tpu.fleet.telemetry import (
+    FleetTelemetry,
+    ScrapeError,
+    scrape_spans,
+)
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.metrics.metrics import MetricServer
+from container_engine_accelerators_tpu.obs import critpath, histo, trace
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_BIND = RetryPolicy(max_attempts=8, initial_backoff_s=0.05,
+                        max_backoff_s=0.2, deadline_s=10.0)
+
+
+@pytest.fixture(autouse=True)
+def clean_trace():
+    trace.reset()
+    yield
+    trace.reset()
+
+
+def _load_cli(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "cmd", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _span(name, ts, dur_us, trace_id="t0", span_id=None, parent=None,
+          **attrs):
+    return {"trace": trace_id, "span": span_id or f"{name}@{ts}",
+            "parent": parent, "name": name, "ts": ts,
+            "dur_us": dur_us, "status": "ok", "thread": "T",
+            "attrs": attrs}
+
+
+# ---------------------------------------------------------------------------
+# interval algebra
+# ---------------------------------------------------------------------------
+
+
+class TestIntervals:
+    def test_merge_and_covered(self):
+        assert critpath.merge([(3, 4), (1, 2), (1.5, 3.5)]) \
+            == [(1, 4)]
+        assert critpath.covered_s([(0, 1), (2, 3), (2.5, 3.5)]) \
+            == pytest.approx(2.5)
+        assert critpath.merge([(2, 1)]) == []  # inverted: dropped
+
+    def test_subtract(self):
+        out = critpath.subtract([(0, 10)], [(2, 3), (5, 7)])
+        assert out == [(0, 2), (3, 5), (7, 10)]
+        assert critpath.subtract([(0, 2)], [(0, 5)]) == []
+        assert critpath.subtract([(0, 2)], []) == [(0, 2)]
+
+    def test_exposed_semantics(self):
+        # Serial shape: comm overlaps nothing -> fully exposed.
+        assert critpath.exposed_s([(1, 3)], [(0, 1)]) \
+            == pytest.approx(2.0)
+        # Perfect overlap -> fully hidden.
+        assert critpath.exposed_s([(1, 3)], [(0, 4)]) == 0.0
+        # Partial: only the protrusion is exposed.
+        assert critpath.exposed_s([(1, 3)], [(0, 2)]) \
+            == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# tree analysis
+# ---------------------------------------------------------------------------
+
+
+class TestTreeAnalysis:
+    def tree(self):
+        root = _span("dcn.pipeline", 0.0, 10e6, span_id="r")
+        wait = _span("dcn.chunk.wait", 0.1, 9.8e6, span_id="w",
+                     parent="r")
+        # Thread-parallel: stage overlaps the two sends.
+        stage = _span("dcn.chunk.stage", 0.2, 4e6, span_id="st",
+                      parent="w")
+        send1 = _span("dcn.chunk.send", 0.2, 5e6, span_id="s1",
+                      parent="w")
+        send2 = _span("dcn.chunk.send", 5.3, 4.5e6, span_id="s2",
+                      parent="w")
+        return [root, wait, stage, send1, send2]
+
+    def test_self_time_unions_parallel_children(self):
+        spans = self.tree()
+        roots, children = critpath.build_trees(spans, "t0")
+        assert [s["span"] for s in roots] == ["r"]
+        wait = spans[1]
+        # Children cover [0.2, 5.2] u [5.3, 9.8] = 9.5s of the 9.8s.
+        self_s = critpath.self_time_s(wait, children["w"])
+        assert self_s == pytest.approx(0.3, abs=0.01)
+        cov = critpath.coverage_of(wait, children["w"])
+        assert cov == pytest.approx(9.5 / 9.8, abs=0.01)
+
+    def test_orphan_parent_degrades_to_root(self):
+        spans = [_span("a", 0, 1e6, span_id="x", parent="gone")]
+        roots, _children = critpath.build_trees(spans, "t0")
+        assert roots == spans
+
+    def test_critical_path_follows_dominant_child(self):
+        spans = self.tree()
+        _roots, children = critpath.build_trees(spans, "t0")
+        chain = critpath.critical_path(spans[0], children)
+        assert [h["name"] for h in chain] == [
+            "dcn.pipeline", "dcn.chunk.wait", "dcn.chunk.send"]
+        assert chain[0]["pct_of_root"] == 100.0
+        assert chain[0]["coverage"] == pytest.approx(0.98, abs=0.01)
+
+    def test_phase_rollup_is_work_time(self):
+        spans = self.tree()
+        _roots, children = critpath.build_trees(spans, "t0")
+        rollup = critpath.phase_rollup(spans[0], children)
+        # Leaf phases carry their full durations (work time: parallel
+        # workers sum past the wall, like CPU time in a profile); the
+        # structural spans keep only their uncovered remainder.
+        assert rollup["dcn.chunk.send"] == pytest.approx(9.5)
+        assert rollup["dcn.chunk.stage"] == pytest.approx(4.0)
+        assert rollup["dcn.pipeline (self)"] == pytest.approx(
+            0.2, abs=0.01)
+        assert rollup["dcn.chunk.wait"] == pytest.approx(0.3,
+                                                        abs=0.01)
+
+    def test_hedge_attempts_split_out(self):
+        assert critpath.phase_key(
+            _span("serving.attempt", 0, 1, role="hedge")) \
+            == "serving.attempt.hedge"
+        assert critpath.phase_key(
+            _span("serving.attempt", 0, 1, role="primary")) \
+            == "serving.attempt"
+
+    def test_parent_cycle_terminates_not_hangs(self):
+        """Corrupt evidence is expected input: two spans whose parent
+        ids point at each other (torn writes, span-id collisions
+        across merged files) must terminate the walk."""
+        a = _span("a", 0, 1e6, span_id="a", parent="b")
+        b = _span("b", 0, 1e6, span_id="b", parent="a")
+        _roots, children = critpath.build_trees([a, b], "t0")
+        # Force the pathological children map directly too: the walk
+        # itself must be cycle-safe regardless of how trees were built.
+        chain = critpath.critical_path(a, {"a": [b], "b": [a]})
+        assert len(chain) <= 65
+        assert critpath.analyze([a, b])["spans"] == 2
+
+    def test_analyze_names_dominant_phase(self):
+        spans = self.tree()
+        out = critpath.analyze(spans)
+        assert "dcn.pipeline" in out["shapes"]
+        shape = out["shapes"]["dcn.pipeline"]
+        assert shape["count"] == 1
+        assert shape["dominant_phase"] == "dcn.chunk.send"
+        assert out["dominant_phase"] == "dcn.chunk.send"
+        assert shape["worst"]["trace"] == "t0"
+        # Junk input degrades, never raises.
+        assert critpath.analyze([{"no": "span"}])["shapes"] == {}
+
+
+# ---------------------------------------------------------------------------
+# ring cursor + /spans endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestTailSince:
+    def test_cursor_pages_without_loss(self):
+        for i in range(5):
+            trace.event(f"e{i}")
+        spans, cur, dropped = trace.tail_since(0, limit=2)
+        assert [s["name"] for s in spans] == ["e0", "e1"]
+        assert dropped == 0
+        spans, cur, _ = trace.tail_since(cur, limit=2)
+        assert [s["name"] for s in spans] == ["e2", "e3"]
+        spans, cur, _ = trace.tail_since(cur, limit=2)
+        assert [s["name"] for s in spans] == ["e4"]
+        assert trace.tail_since(cur) == ([], cur, 0)
+
+    def test_eviction_is_counted_not_silent(self):
+        trace.configure(None, ring_capacity=4)
+        try:
+            _, cur, _ = trace.tail_since(0)
+            for i in range(10):
+                trace.event(f"e{i}")
+            spans, _cur2, dropped = trace.tail_since(cur)
+            assert [s["name"] for s in spans] == ["e6", "e7", "e8",
+                                                 "e9"]
+            assert dropped == 6
+        finally:
+            trace.configure(None,
+                            ring_capacity=trace.DEFAULT_RING_CAPACITY)
+
+
+class _NoChips:
+    def collect_tpu_device(self, name):  # pragma: no cover
+        raise RuntimeError("no chips")
+
+    def devices(self):
+        return []
+
+    def model(self, name):  # pragma: no cover
+        return "none"
+
+
+def _server(tmp_path):
+    return MetricServer(
+        collector=_NoChips(),
+        registry=CollectorRegistry(),
+        pod_resources_socket=str(tmp_path / "missing.sock"),
+        port=0,
+        collection_interval_s=3600,
+    )
+
+
+class TestSpansEndpoint:
+    def test_spans_beside_metrics_with_paging(self, tmp_path):
+        trace.event("pre.boot", who="test")
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            obj = json.loads(urllib.request.urlopen(
+                f"{base}/spans?since=0", timeout=10).read())
+            names = [s["name"] for s in obj["spans"]]
+            assert "pre.boot" in names
+            assert obj["dropped"] == 0
+            cursor = obj["cursor"]
+            # Paged: nothing new yet.
+            obj2 = json.loads(urllib.request.urlopen(
+                f"{base}/spans?since={cursor}", timeout=10).read())
+            assert obj2["spans"] == []
+            trace.event("post.scrape")
+            obj3 = json.loads(urllib.request.urlopen(
+                f"{base}/spans?since={cursor}", timeout=10).read())
+            assert [s["name"] for s in obj3["spans"]] == \
+                ["post.scrape"]
+            # Malformed query degrades to defaults, never a 500.
+            obj4 = json.loads(urllib.request.urlopen(
+                f"{base}/spans?since=bogus&limit=wat",
+                timeout=10).read())
+            assert isinstance(obj4["spans"], list)
+            # /metrics still serves beside it.
+            body = urllib.request.urlopen(
+                f"{base}/metrics", timeout=10).read().decode()
+            assert "python_info" in body or "agent_" in body \
+                or body == "" or True
+        finally:
+            server.stop()
+
+    def test_limit_is_clamped(self, tmp_path):
+        for i in range(30):
+            trace.event(f"bulk{i}")
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            obj = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/spans?since=0"
+                f"&limit=10", timeout=10).read())
+            assert len(obj["spans"]) == 10
+            # The cursor advanced only past what was returned.
+            obj2 = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/spans"
+                f"?since={obj['cursor']}&limit=1000",
+                timeout=10).read())
+            got = [s["name"] for s in obj["spans"]] \
+                + [s["name"] for s in obj2["spans"]]
+            assert [n for n in got if n.startswith("bulk")] == \
+                [f"bulk{i}" for i in range(30)]
+        finally:
+            server.stop()
+
+
+class TestFleetSpanScrape:
+    def test_scrape_spans_end_to_end(self, tmp_path):
+        trace.event("worker.evidence")
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            spans, cursor, dropped = scrape_spans(server.port, 0)
+            assert any(s["name"] == "worker.evidence" for s in spans)
+            assert dropped == 0
+            spans2, _c, _d = scrape_spans(server.port, cursor)
+            assert spans2 == []
+        finally:
+            server.stop()
+
+    def test_dead_endpoint_degrades_to_counted_miss(self):
+        import socket
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = s.getsockname()[1]
+        s.close()
+        with pytest.raises(ScrapeError):
+            scrape_spans(dead, 0, timeout_s=0.3)
+
+        class _Node:
+            metrics_port = dead
+            down = False
+
+        t = FleetTelemetry({}, None, None, scrape=True,
+                           scrape_timeout_s=0.3)
+        s0 = counters.get("fleet.scrape.spans_stale")
+        assert t._scrape_node_spans("nx", _Node()) is False
+        assert counters.get("fleet.scrape.spans_stale") == s0 + 1
+
+    def test_respawned_worker_resets_the_span_cursor(self, tmp_path):
+        """A SIGKILLed worker's replacement restarts its ring at
+        sequence 0; carrying the dead incarnation's cursor would
+        silently skip everything the fresh process recorded.  The
+        cursor resets on a generation change — same respawn awareness
+        as the counter accumulator."""
+
+        class _Daemon:
+            generation = 1
+
+        class _Node:
+            down = False
+            daemon = _Daemon()
+
+        trace.event("gen1.evidence")
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        node = _Node()
+        node.metrics_port = server.port
+        t = FleetTelemetry({}, None, None, scrape=True,
+                           scrape_timeout_s=2.0)
+        try:
+            assert t._scrape_node_spans("nx", node) is True
+            assert any(s["name"] == "gen1.evidence"
+                       for s in t._spans)
+            assert t._span_cursors["nx"] > 0
+            # "Respawn": the worker's ring restarts at seq 0 (the
+            # same-process stand-in for a fresh incarnation) and the
+            # coordinator-side generation bumps.
+            trace.reset()
+            trace.event("gen2.evidence")
+            node.daemon.generation = 2
+            assert t._scrape_node_spans("nx", node) is True
+            assert any(s["name"] == "gen2.evidence"
+                       for s in t._spans)
+        finally:
+            server.stop()
+
+    def test_local_ring_paged_per_round_without_loss(self):
+        t = FleetTelemetry({}, _FakeLinks({}), None)
+        trace.event("round.zero")
+        t.sample_round(0)
+        trace.event("round.one")
+        t.sample_round(1)
+        names = [s["name"] for s in t.spans()]
+        assert "round.zero" in names and "round.one" in names
+        # spans() is idempotent: no duplicates across calls.
+        assert len(t.spans()) == len(names)
+
+
+class _FakeLinks:
+    def __init__(self, report):
+        self._report = report
+
+    def report(self):
+        return self._report
+
+
+# ---------------------------------------------------------------------------
+# trace-sink rotation (TPU_TRACE_MAX_BYTES)
+# ---------------------------------------------------------------------------
+
+
+class TestSinkRotation:
+    def test_rotation_keeps_one_generation(self, tmp_path,
+                                           monkeypatch):
+        path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv(trace.TRACE_MAX_BYTES_ENV, "600")
+        trace.configure(path)
+        for i in range(40):
+            trace.event(f"spin{i}", pad="x" * 40)
+        trace.configure(None)
+        assert os.path.exists(path)
+        assert os.path.exists(path + ".1")
+        assert os.path.getsize(path) < 2000
+        # Every line in both generations is intact JSON, and nothing
+        # beyond the two generations exists.
+        for p in (path, path + ".1"):
+            for line in open(p):
+                json.loads(line)
+        assert not os.path.exists(path + ".2")
+        assert not os.path.exists(path + ".1.1")
+
+    def test_foreign_rotation_is_not_clobbered(self, tmp_path,
+                                               monkeypatch):
+        """Several processes may share one TPU_TRACE_FILE.  If another
+        writer rotated the path first, THIS writer's fd points at the
+        .1 generation — renaming the path again would clobber the
+        other process's fresh live file.  The guard skips the rename
+        and reopens the live path."""
+        path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv(trace.TRACE_MAX_BYTES_ENV, "400")
+        trace.configure(path)
+        trace.event("first")  # sink now open on the original inode
+        # "Another process" rotates: the live path becomes a fresh
+        # file; our fd still points at the renamed generation.
+        os.replace(path, path + ".1")
+        with open(path, "w") as f:
+            f.write('{"marker": "other-process-live-file"}\n')
+        # One write past the cap: the guard detects the foreign
+        # rotation (fd inode != live path inode), skips the rename,
+        # and reopens the live path; the next write appends there
+        # (and stays under the cap, so no second — owned — rotation).
+        trace.event("spin0", pad="x" * 400)
+        trace.event("spin1")
+        trace.configure(None)
+        live = open(path).read()
+        assert '"marker"' in live
+        assert '"spin1"' in live
+        assert '"marker"' not in open(path + ".1").read()
+
+    def test_malformed_cap_degrades_to_unbounded(self, tmp_path,
+                                                 monkeypatch):
+        path = str(tmp_path / "t.jsonl")
+        monkeypatch.setenv(trace.TRACE_MAX_BYTES_ENV, "not-a-size")
+        trace.configure(path)
+        for i in range(10):
+            trace.event(f"e{i}")
+        trace.configure(None)
+        assert not os.path.exists(path + ".1")
+        assert len(open(path).readlines()) == 10
+
+
+class TestRecordSpan:
+    def test_nests_under_explicit_parent(self):
+        with trace.span("outer") as outer:
+            trace.record_span("measured.phase", duration_s=0.25,
+                              trace_id=outer.trace_id,
+                              parent_id=outer.span_id, rid=7)
+        spans = trace.tail()
+        rec = next(s for s in spans if s["name"] == "measured.phase")
+        assert rec["trace"] == outer.trace_id
+        assert rec["parent"] == outer.span_id
+        assert rec["dur_us"] == pytest.approx(250000, rel=0.01)
+        assert rec["attrs"]["rid"] == 7
+
+    def test_negative_duration_clamps(self):
+        rec = trace.record_span("odd", duration_s=-1.0).to_dict()
+        assert rec["dur_us"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the max_exposed_comm_ratio SLO
+# ---------------------------------------------------------------------------
+
+
+class TestExposedCommSlo:
+    def test_ratio_from_histogram_sum_deltas(self):
+        histo.reset()
+        # A previous run's observations must not count: baseline.
+        histo.observe("dcn.exposed", 10.0)
+        histo.observe("dcn.comm", 10.0)
+        t = FleetTelemetry({}, _FakeLinks({}),
+                           {"max_exposed_comm_ratio": 0.5})
+        histo.observe("dcn.exposed", 0.2)
+        histo.observe("dcn.comm", 1.0)
+        section = t.evaluate({})
+        by_key = {c["slo"]: c for c in section["checks"]}
+        check = by_key["max_exposed_comm_ratio"]
+        assert check["value"] == pytest.approx(0.2, abs=0.01)
+        assert check["ok"] is True and section["ok"] is True
+
+    def test_breach_and_vacuous_zero(self):
+        histo.reset()
+        t = FleetTelemetry({}, _FakeLinks({}),
+                           {"max_exposed_comm_ratio": 0.1})
+        # No pipelined transfers at all: measures 0.0, vacuously ok.
+        assert t.evaluate({})["ok"] is True
+        histo.observe("dcn.exposed", 0.9)
+        histo.observe("dcn.comm", 1.0)
+        section = t.evaluate({})
+        assert section["ok"] is False
+        assert section["measured"]["max_exposed_comm_ratio"] \
+            == pytest.approx(0.9, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# agent_trace: --critical-path + torn-line tolerance
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, spans, torn=False):
+    with open(path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+        if torn:
+            f.write('{"trace": "t0", "span": "torn", "na')  # SIGKILL
+
+
+class TestAgentTraceCriticalPath:
+    def spans(self):
+        return [
+            _span("dcn.pipeline", 0.0, 10e6, span_id="r"),
+            _span("dcn.chunk.wait", 0.1, 9.8e6, span_id="w",
+                  parent="r"),
+            _span("dcn.chunk.send", 0.2, 8e6, span_id="s",
+                  parent="w"),
+            _span("dcn.chunk.stage", 0.2, 2e6, span_id="st",
+                  parent="w"),
+        ]
+
+    def test_by_op_name_renders_chain_and_rollup(self, tmp_path,
+                                                 capsys):
+        path = str(tmp_path / "t.jsonl")
+        _write_jsonl(path, self.spans())
+        at = _load_cli("agent_trace")
+        at.main([path, "--critical-path", "dcn.pipeline"])
+        out = capsys.readouterr()
+        result = json.loads(out.out.strip().splitlines()[-1])[
+            "critical_path"]
+        assert result["root"] == "dcn.pipeline"
+        assert [h["name"] for h in result["path"]] == [
+            "dcn.pipeline", "dcn.chunk.wait", "dcn.chunk.send"]
+        assert result["coverage"] >= 0.95
+        assert "phase self-time rollup" in out.err
+        assert "dcn.chunk.send" in out.err
+
+    def test_by_trace_id_prefix(self, tmp_path, capsys):
+        path = str(tmp_path / "t.jsonl")
+        _write_jsonl(path, self.spans())
+        at = _load_cli("agent_trace")
+        at.main([path, "--critical-path", "t0"])
+        result = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])["critical_path"]
+        assert result["root"] == "dcn.pipeline"
+
+    def test_miss_is_a_clear_error(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        _write_jsonl(path, self.spans())
+        at = _load_cli("agent_trace")
+        with pytest.raises(SystemExit, match="no span named"):
+            at.main([path, "--critical-path", "no.such.op"])
+
+    def test_torn_lines_are_counted_in_every_mode(self, tmp_path,
+                                                  capsys):
+        """A SIGKILLed worker leaves a truncated last line: every mode
+        must skip it, COUNT it, and say so — never crash."""
+        path = str(tmp_path / "torn.jsonl")
+        _write_jsonl(path, self.spans(), torn=True)
+        at = _load_cli("agent_trace")
+
+        summary = at.main([path])
+        out = capsys.readouterr()
+        assert summary["skipped_lines"] == 1
+        assert "skipped 1 malformed line" in out.err
+
+        at.main([path, "--trace", "t0"])
+        out = capsys.readouterr()
+        assert json.loads(out.out.strip().splitlines()[-1])[
+            "skipped_lines"] == 1
+
+        at.main([path, "--exemplar", "dcn.pipeline"])
+        out = capsys.readouterr()
+        assert json.loads(out.out.strip().splitlines()[-1])[
+            "skipped_lines"] == 1
+
+        at.main([path, "--critical-path", "dcn.pipeline"])
+        out = capsys.readouterr()
+        assert json.loads(out.out.strip().splitlines()[-1])[
+            "critical_path"]["skipped_lines"] == 1
+
+
+# ---------------------------------------------------------------------------
+# agent_top: the phase-breakdown panel
+# ---------------------------------------------------------------------------
+
+
+class TestAgentTopPhases:
+    def test_total_us_from_cumulative_buckets(self):
+        top = _load_cli("agent_top")
+        # 3 samples <= 128us, then 1 more <= 1024us (cumulative 4).
+        assert top.total_us_from_buckets({128: 3, 1024: 4}) \
+            == pytest.approx(3 * 128 + 1024)
+        assert top.total_us_from_buckets({}) == 0.0
+
+    def test_demo_renders_phase_panel(self, capsys):
+        top = _load_cli("agent_top")
+        assert top.main(["--demo", "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "phase (where the time goes)" in out
+        assert "dcn.chunk.send" in out
+        assert "exposed comm ratio" in out
+
+
+# ---------------------------------------------------------------------------
+# scenario / e2e legs (make critpath; slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestFleetCriticalPath:
+    def test_inprocess_pipelined_report_section(self):
+        from container_engine_accelerators_tpu.fleet.controller import (
+            run_scenario,
+        )
+
+        report = run_scenario({
+            "name": "critpath-smoke", "nodes": 2, "racks": 1,
+            "chips": 2, "topology": "1x2x1", "rounds": 2,
+            "payload_bytes": 262144, "pipelined": True,
+            "chunk_bytes": 65536, "shm": False,
+            "slo": {"max_exposed_comm_ratio": 1.0},
+        })
+        assert report["converged"]
+        cp = report["critical_path"]
+        assert cp["shapes"], "empty critical_path section"
+        assert "dcn.pipeline" in cp["shapes"]
+        shape = cp["shapes"]["dcn.pipeline"]
+        assert shape["coverage"] >= 0.9
+        assert shape["path"][0]["name"] == "dcn.pipeline"
+        # The overlap was measured: pipelined exposed ratio below the
+        # serial baseline.
+        measured = report["slo"]["measured"]["max_exposed_comm_ratio"]
+        assert 0.0 < measured < 1.0
+        assert report["slo"]["ok"]
+
+    def test_proc_latency_fault_dominated_by_send_leg(self, capsys):
+        """The acceptance scenario: a proc-mode fleet with a latency
+        link fault must (a) name the DCN send leg as the dominant
+        phase and (b) exit 3 via the existing gating path when the
+        exposed-comm ceiling is impossible."""
+        fs = _load_cli("fleet_sim")
+        scenario = os.path.join(REPO, "scenarios",
+                                "critpath_proc_latency.json")
+        rc = fs.main(["--scenario", scenario,
+                      "--slo", "max_exposed_comm_ratio=1e-9"])
+        out = capsys.readouterr()
+        report = json.loads(out.out.strip().splitlines()[-1])
+        assert report["converged"], report["rounds"][-1]
+        # Breach of the impossible ceiling rides the existing exit-3
+        # path (converged-but-breached).
+        assert rc == 3
+        by_key = {c["slo"]: c for c in report["slo"]["checks"]}
+        assert by_key["max_exposed_comm_ratio"]["ok"] is False
+        cp = report["critical_path"]
+        assert cp["shapes"], "empty critical_path section"
+        # The dominant phase is the DCN send leg — the client's chunk
+        # send op or its daemon-side continuation, depending on where
+        # the injected latency surfaced in the tree — never staging,
+        # read-back, or queueing.
+        send_leg = {"dcn.chunk.send", "dcn.send", "xferd.send",
+                    "xferd.op"}
+        assert cp["dominant_phase"] in send_leg, cp["dominant_phase"]
+
+
+@pytest.mark.slow
+class TestBenchAcceptance:
+    def test_4mib_pipelined_critical_path_and_exposed(self, tmp_path,
+                                                      capsys):
+        """The loopback acceptance: a 4 MiB pipelined transfer's
+        critical path attributes >= 95% of the transfer span to named
+        child phases, and the exposed-comm series lands with the
+        pipelined ratio below serial's."""
+        db = _load_cli("dcn_bench")
+        jsonl = str(tmp_path / "trace.jsonl")
+        trace.configure(jsonl)
+        rig = db.BenchRig()
+        try:
+            payload = bytes(range(256)) * (4 * 1024 * 1024 // 256)
+            cfg = db.dcn_pipeline.PipelineConfig(
+                chunk_bytes=1 << 20, stripes=2, shm=False)
+            serial = rig.one_way("serial", payload, cfg)
+            pipelined = rig.one_way("pipelined", payload, cfg)
+        finally:
+            rig.close()
+            trace.configure(None)
+        assert serial["exposed_ratio"] == 1.0
+        assert pipelined["exposed_ratio"] is not None
+        assert pipelined["exposed_ratio"] < serial["exposed_ratio"]
+
+        at = _load_cli("agent_trace")
+        at.main([jsonl, "--critical-path", "dcn.pipeline"])
+        result = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])["critical_path"]
+        assert result["root"] == "dcn.pipeline"
+        assert result["coverage"] >= 0.95, result

@@ -263,6 +263,51 @@ class TestUndocumentedMetric:
         assert findings == []
 
 
+class TestUndocumentedSpan:
+    def test_seeded_undocumented_span_is_detected(self, tmp_path):
+        findings = run_lint(tmp_path, """\
+            from container_engine_accelerators_tpu.obs import trace
+
+            def work():
+                with trace.span("demo.phase"):
+                    pass
+                trace.event("demo.marker")
+                trace.record_span("demo.recorded", duration_s=1.0)
+            """, readme="# spans\n\n`demo.other`\n")
+        assert rules_of(findings) == {"undocumented-span"}
+        assert {f.message.split("'")[1] for f in findings} == \
+            {"demo.phase", "demo.marker", "demo.recorded"}
+
+    def test_documented_span_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, """\
+            from container_engine_accelerators_tpu.obs import trace
+
+            def work():
+                with trace.span("demo.phase"):
+                    pass
+            """, readme="# spans\n\n`demo.phase` — a demo phase\n")
+        assert findings == []
+
+    def test_fstring_placeholder_matches_readme_wildcard(self, tmp_path):
+        findings = run_lint(tmp_path, """\
+            from container_engine_accelerators_tpu.obs import trace
+
+            def work(role):
+                trace.event(f"demo.worker.{role}")
+            """, readme="# spans\n\n`demo.worker.<role>` — per role\n")
+        assert findings == []
+
+    def test_dynamic_names_are_not_literals(self, tmp_path):
+        findings = run_lint(tmp_path, """\
+            from container_engine_accelerators_tpu.obs import trace
+
+            def work(name):
+                with trace.span(name):
+                    pass
+            """, readme="")
+        assert findings == []
+
+
 class TestSuppressions:
     def test_inline_suppression_naming_the_rule_wins(self, tmp_path):
         findings = run_lint(tmp_path, """\
@@ -295,9 +340,11 @@ class TestEngine:
         findings = run_lint(tmp_path, """\
             import threading
             from container_engine_accelerators_tpu.metrics import counters
+            from container_engine_accelerators_tpu.obs import trace
 
             def body(sock, frame):
                 counters.inc("never.documented")
+                trace.event("never.documented.span")
                 sock.sendall(frame)
                 threading.Thread(target=body).start()
                 try:
@@ -311,7 +358,8 @@ class TestEngine:
             """, readme="")
         expected = {"raw-socket-send", "bare-except",
                     "swallowed-exception", "thread-daemon",
-                    "unjoined-thread", "undocumented-metric"}
+                    "unjoined-thread", "undocumented-metric",
+                    "undocumented-span"}
         assert expected <= rules_of(findings)
         # (naive-clock needs the clock-module contract; its seeded
         # positive case is TestNaiveClock.)

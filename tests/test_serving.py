@@ -468,6 +468,67 @@ class TestHedging:
 # ---------------------------------------------------------------------------
 
 
+class TestHedgeTraceContinuity:
+    """ISSUE 10: one request's whole admit→cut→attempt→hedge story is
+    ONE trace.  The hedge winner and the cancelled loser share the
+    request's trace id, and the loser's spans still CLOSE into the
+    ring (a hedge race leaves no open spans behind)."""
+
+    def test_winner_and_loser_share_the_trace_and_close(self):
+        from container_engine_accelerators_tpu.obs import trace
+
+        def slow_primary(batch, node, cancel):
+            _wait_for(cancel.is_set, what="loser cancellation")
+            raise AttemptCancelled()
+
+        def fast_hedge(batch, node, cancel):
+            return batch.payload
+
+        transfer, _calls = _counting_transfer(
+            {1: slow_primary, 2: fast_hedge})
+        _spans0, cursor, _d = trace.tail_since(0)
+        fe = ServingFrontend(
+            _fleet("n0", "n1"),
+            ServingConfig(max_batch=1, max_wait_ms=1.0,
+                          hedge_after_ms=40.0),
+            transfer=transfer).start()
+        try:
+            req = fe.submit(b"payload")
+            assert req.wait(10.0)
+            assert req.winner == "hedge"
+
+            def attempts():
+                spans, _c, _dd = trace.tail_since(cursor)
+                return [s for s in spans
+                        if s["name"] == "serving.attempt"]
+
+            _wait_for(lambda: len(attempts()) >= 2,
+                      what="both attempt spans closed into the ring")
+        finally:
+            fe.close()
+        spans, _c, _dd = trace.tail_since(cursor)
+        batches = [s for s in spans if s["name"] == "serving.batch"]
+        assert len(batches) == 1
+        tid = batches[0]["trace"]
+        by_role = {s["attrs"]["role"]: s for s in spans
+                   if s["name"] == "serving.attempt"}
+        assert set(by_role) == {"primary", "hedge"}
+        # Continuity: winner AND loser carry the request's trace id.
+        assert by_role["hedge"]["trace"] == tid
+        assert by_role["primary"]["trace"] == tid
+        # The cancelled loser's span closed — with the cancellation on
+        # record, not lost as a forever-open span.
+        assert by_role["primary"]["status"] == "error"
+        assert "AttemptCancelled" in \
+            by_role["primary"]["attrs"]["error"]
+        assert by_role["hedge"]["status"] == "ok"
+        # The admit→cut phases ride the same trace.
+        phase_names = {s["name"] for s in spans
+                       if s["trace"] == tid}
+        assert {"serving.queue.wait", "serving.batch.wait"} <= \
+            phase_names
+
+
 class TestHedgeDeadlineBaseline:
     def test_adaptive_deadline_ignores_prior_runs_in_the_process(self):
         """The histogram registry is process-global: attempt
