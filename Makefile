@@ -146,6 +146,39 @@ dcnbench:
 	$(PY) cmd/dcn_bench.py --compare \
 	    --sizes 65536,1048576,4194304 --iters 3
 
+# Self-tuning data plane gate: the closed-loop controller end to end —
+# the decision-table/registry/integration suite (slow scenario e2es
+# included), then the CLI acceptance legs: the proc-mode
+# degrade-and-recover scenario (a link degraded mid-run via the worker
+# link shim, healed, goodput back above the declared floor with zero
+# knob changes — exit 3 means converged-but-breached and fails this
+# gate), and the tuned-vs-static bench comparison (the closed-loop
+# plane, told nothing, must reach the best hand-tuned static grid in
+# the sweep; the ratio here is relaxed from the idle-run default the
+# same way the critpath gate relaxes its lane floors, so a loaded
+# builder cannot flake CI on scheduling noise).  Folded into presubmit.
+.PHONY: tune
+tune:
+	$(PY) -m pytest tests/test_dcn_tune.py -q -p no:randomly
+	$(PY) cmd/fleet_sim.py \
+	    --scenario scenarios/tune_link_degrade.json > /dev/null
+	$(PY) cmd/dcn_bench.py --tuned --compare \
+	    --sizes 262144,1048576 --iters 5 --chunk-bytes 262144 \
+	    --grid "131072:1,131072:2,262144:1,262144:2" \
+	    --tune-warmup 6 --tune-min-ratio 0.6 \
+	    --min-ratio 0.5 --shm-min-ratio 0.5 > /dev/null
+	@# ^ THIS gate is the tuned-vs-static comparison; the lane-SPEED
+	@#   floors live in `make dcnbench` and are deliberately relaxed
+	@#   here, exactly like the critpath gate relaxes them.
+	@# ^ 0.6, not the idle-run default 0.9: "best static" is the MAX
+	@#   over four noisy cells (upward-biased) while tuned is one
+	@#   paired series, and a loaded builder's time-correlated
+	@#   scheduling noise (~2x run to run) can exceed the ~1.4x
+	@#   stripe-count effect the probes must detect.  Measured idle
+	@#   the tuned plane sits at 0.97-1.06x the best grid (README) —
+	@#   this floor only catches a controller that converged somewhere
+	@#   genuinely wrong.
+
 # Invariant lint gate (analysis/lint.py rule registry via
 # cmd/agent_lint.py): exit 0 clean, 1 findings, 2 internal error.
 # Inline suppressions must name their rule (# lint: disable=<rule>).
@@ -223,6 +256,7 @@ presubmit:
 	$(MAKE) race
 	$(MAKE) critpath
 	$(MAKE) fleet-serve
+	$(MAKE) tune
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to
 # BENCH_TPU_LOG.jsonl). Each stage is independent; failures don't stop

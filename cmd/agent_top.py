@@ -221,9 +221,25 @@ def digest(fams: dict) -> dict:
                 "wasted": event_by.get("serving.hedge.wasted", 0.0),
             },
         }
+    # The self-tuning data plane's one-glance line: the controller's
+    # current grid next to the phase panel it is steering.
+    tuner = None
+    if "dcn.tune.chunk_bytes" in gauge_by \
+            or "dcn.tune.stripes" in gauge_by:
+        tuner = {
+            "chunk_bytes": gauge_by.get("dcn.tune.chunk_bytes", 0.0),
+            "stripes": gauge_by.get("dcn.tune.stripes", 0.0),
+            "flows": gauge_by.get("dcn.tune.flows", 0.0),
+            # 'clamped' is documented as NO move taken (every lever at
+            # its floor) — counting it would show a saturated
+            # controller as an active one.
+            "moves": sum(v for k, v in event_by.items()
+                         if k.startswith("dcn.tune.")
+                         and k != "dcn.tune.clamped"),
+        }
     return {"rates": rates, "goodput": goodput,
             "latency": latency, "gauges": gauges, "slos": slos,
-            "serving": serving, "phases": phase_rows,
+            "serving": serving, "phases": phase_rows, "tuner": tuner,
             "exposed_ratio": dict(gauges).get("dcn.exposed_ratio")}
 
 
@@ -286,6 +302,18 @@ def render(model: dict, source: str, top_n: int = 10) -> str:
         if exposed is not None:
             lines.append(f"{'exposed comm ratio':<28} "
                          f"{'':>7} {'':>10} {exposed * 100:>6.1f}%")
+
+    tuner = model.get("tuner")
+    if tuner:
+        chunk = tuner["chunk_bytes"]
+        chunk_txt = (f"{chunk / 1024:.0f}K" if chunk < (1 << 20)
+                     else f"{chunk / (1 << 20):.1f}M")
+        lines.append("")
+        lines.append(f"{'tuner (closed-loop grid)':<28} "
+                     f"chunk={chunk_txt} "
+                     f"stripes={tuner['stripes']:.0f} "
+                     f"flows={tuner['flows']:.0f} "
+                     f"moves={tuner['moves']:.0f}")
 
     goodput = [g for g in model["goodput"]][:top_n]
     if goodput:
@@ -374,6 +402,14 @@ def _demo_server():
     with trace.span("dcn.wait", histogram="dcn.wait"):
         time.sleep(0.001)
     timeseries.gauge("dcn.exposed_ratio", 0.42)
+    # The self-tuning data plane's line (parallel/dcn_tune.py).
+    timeseries.gauge("dcn.tune.chunk_bytes", 262144)
+    timeseries.gauge("dcn.tune.stripes", 2)
+    timeseries.gauge("dcn.tune.flows", 1)
+    # Concrete demo instances of the documented `dcn.tune.<decision>`
+    # family — sample data, not new names.
+    counters.inc("dcn.tune.shrink_chunk")  # lint: disable=undocumented-metric
+    counters.inc("dcn.tune.grow_chunk")  # lint: disable=undocumented-metric
     timeseries.gauge("slo.min_goodput_bps.ok", 1)  # lint: disable=undocumented-metric
     timeseries.gauge("slo.min_goodput_bps.value", 4 << 20)  # lint: disable=undocumented-metric
     # The serving workload's panel (serving/frontend.py families).

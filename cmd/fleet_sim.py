@@ -82,6 +82,11 @@ def parse_args(argv=None):
     p.add_argument("--stripes", type=int, default=None,
                    help="pipelined stripe count (default TPU_DCN_STRIPES "
                         "or 2)")
+    p.add_argument("--tuned", action="store_true",
+                   help="close the loop: the chunk/stripe grid is only "
+                        "the base — the per-destination controller "
+                        "(parallel/dcn_tune.py) adapts it from the "
+                        "legs' own telemetry (implies --pipelined)")
     p.add_argument("--no-shm", action="store_true",
                    help="pin the pipelined legs to the socket lane "
                         "(emulated nodes are same-host, so the "
@@ -186,6 +191,9 @@ def main(argv=None):
             scenario[key] = value
     if args.pipelined:
         scenario["pipelined"] = True
+    if args.tuned:
+        scenario["pipelined"] = True
+        scenario["tuned"] = True
     if args.no_shm:
         scenario["shm"] = False
     if args.metrics:

@@ -69,7 +69,11 @@ from container_engine_accelerators_tpu.fleet.topology import (
 )
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import critpath, histo, trace
-from container_engine_accelerators_tpu.parallel import dcn, dcn_pipeline
+from container_engine_accelerators_tpu.parallel import (
+    dcn,
+    dcn_pipeline,
+    dcn_tune,
+)
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnXferError,
 )
@@ -211,10 +215,16 @@ class FleetController:
         # nodes are same-host by construction, so `shm: false` is how
         # a scenario pins the socket lane (fault-parity runs).
         self.pipelined = bool(self.scenario.get("pipelined", False))
+        # `tuned: true` closes the loop: the chunk/stripe grid above
+        # becomes only the BASE — parallel/dcn_tune.py adapts it per
+        # destination from the legs' own telemetry (the no-operator-
+        # knobs scenarios).  Learned state is dropped at boot so every
+        # run starts from the declared grid, reproducibly.
         self.pipe_cfg = dcn_pipeline.PipelineConfig(
             chunk_bytes=self.scenario.get("chunk_bytes"),
             stripes=self.scenario.get("stripes"),
             shm=self.scenario.get("shm"),
+            tuned=self.scenario.get("tuned"),
         )
         self.leg_retry = RetryPolicy(
             max_attempts=int(self.scenario.get("leg_attempts", 3)),
@@ -240,6 +250,10 @@ class FleetController:
     def boot(self) -> "FleetController":
         if self._booted:
             return self
+        if self.pipe_cfg.tuned:
+            # Fresh controller state per scenario run: tuners learned
+            # against a previous fleet's ports must not steer this one.
+            dcn_tune.reset()
         try:
             for spec in self.topology.specs.values():
                 root = os.path.join(self.workdir, spec.name)

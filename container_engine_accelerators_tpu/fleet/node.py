@@ -130,6 +130,12 @@ class EmulatedNode:
         self.pump_health()
 
     def recover(self, now: Optional[float] = None) -> int:
+        # The external injector file is polled on the same deterministic
+        # cadence as recovery: scenarios pump between rounds, so a
+        # fault line written from OUTSIDE the coordinator RPC lands
+        # with the next round's health sweep (TPU_CHIP_FAULT_FILE —
+        # proc workers inherit the env path from their coordinator).
+        self.health.poll_fault_file()
         n = self.health.maybe_recover(now=now)
         self.pump_health()
         return n

@@ -93,6 +93,17 @@ MAX_COLLECTED_SPANS = 20000
 SLO_KEYS = {
     "p99_leg_ms": ("ceiling", "p99 of fleet.leg latency (ms)"),
     "min_goodput_bps": ("floor", "delivered link bytes per second"),
+    # The recovery floor (the self-tuning data plane's acceptance
+    # gate): goodput over the LAST sampled round with live telemetry —
+    # a scenario that degrades a link mid-run and heals it passes only
+    # if the fleet is back above this floor by the end, with no
+    # operator knob change.  Judged from the same per-round node
+    # goodput history in both fleet modes; stale entries are skipped
+    # exactly like the whole-run floor.
+    "min_final_goodput_bps": ("floor",
+                              "delivered bytes per second over the "
+                              "final sampled round (post-heal "
+                              "recovery floor)"),
     "max_retransmit_ratio": ("ceiling",
                              "(link drops + deduped replays) / frames"),
     "max_dedup_ratio": ("ceiling", "deduped replays / frames"),
@@ -462,6 +473,19 @@ class FleetTelemetry:
             return 0.0
         return max(0.0, exp) / comm
 
+    def _final_round_goodput(self) -> float:
+        """Goodput of the last sampled round with any live (non-stale)
+        node entry — the post-heal recovery floor's input.  Rounds
+        where every node was stale are walked past (a node mid-respawn
+        at the final sample must not zero the verdict); no history at
+        all measures 0.0."""
+        for sample in reversed(self.history):
+            live = [e["goodput_bps"] for e in sample["nodes"].values()
+                    if not e.get("stale")]
+            if live:
+                return sum(live)
+        return 0.0
+
     def _serving_measurements(self, elapsed_s: float) -> dict:
         """The serving SLO inputs — coordinator-side in BOTH modes:
         the ServingFrontend runs in the controller process, so its
@@ -488,6 +512,7 @@ class FleetTelemetry:
             "max_retransmit_ratio": (drops + dups) / max(1, frames),
             "max_dedup_ratio": dups / max(1, frames),
             "max_exposed_comm_ratio": self._exposed_comm_ratio(),
+            "min_final_goodput_bps": self._final_round_goodput(),
             **self._serving_measurements(elapsed_s),
         }
 
@@ -524,6 +549,7 @@ class FleetTelemetry:
             "max_retransmit_ratio": ratio,
             "max_dedup_ratio": ratio,
             "max_exposed_comm_ratio": self._exposed_comm_ratio(),
+            "min_final_goodput_bps": self._final_round_goodput(),
             "stale_entries_skipped": stale_entries,
             **self._serving_measurements(elapsed_s),
         }

@@ -48,6 +48,7 @@ means updating runtime_map's patterns, not this state machine.
 """
 
 import logging
+import os
 import threading
 import time
 from typing import Dict, Iterable, Optional, Set
@@ -81,6 +82,23 @@ DEFAULT_RECOVERY_WINDOW_S = 300.0
 FLAP_RESET_FACTOR = 4
 MAX_FLAP_DOUBLINGS = 6
 
+# External chip-fault injector (the NVML-Xid file analog): a path whose
+# appended lines are fault/clear events from OUTSIDE this process —
+# a sidecar health prober, an operator's kubectl exec, a chaos rig.
+# Line grammar, one event per line (malformed lines are logged and
+# skipped — the TPU_FAULT_SPEC rule):
+#
+#   fault <device> [code]     # code defaults to 48 (HBM ECC)
+#   clear <device>            # external all-clear: recover NOW
+#
+# The checker polls the file on every event-loop wakeup (and via
+# ``poll_fault_file`` for deterministic drivers like the fleet rig),
+# byte-offset incremental with truncation/rotation detection.  A
+# ``clear`` rides the normal quiescence-recovery path — same queue,
+# same counters — it just expires the window immediately: an external
+# "fixed it" must not invent a second recovery state machine.
+FAULT_FILE_ENV = "TPU_CHIP_FAULT_FILE"
+
 
 class TpuHealthChecker:
     def __init__(
@@ -90,12 +108,18 @@ class TpuHealthChecker:
         critical_codes: Optional[Iterable[int]] = None,
         recovery_window_s: Optional[float] = DEFAULT_RECOVERY_WINDOW_S,
         event_wait_timeout_s: float = EVENT_WAIT_TIMEOUT_S,
+        fault_file: Optional[str] = None,
     ):
         self.manager = manager
         self.lib = lib
         self.critical_codes: Set[int] = set(DEFAULT_CRITICAL_CODES)
         self.critical_codes.update(critical_codes or [])
         self.event_wait_timeout_s = event_wait_timeout_s
+        # External injector file (TPU_CHIP_FAULT_FILE): env-resolved so
+        # fleet proc workers inherit the path with zero plumbing.
+        self.fault_file = (fault_file if fault_file is not None
+                           else os.environ.get(FAULT_FILE_ENV) or None)
+        self._fault_file_pos = 0
         # None disables recovery (strict reference semantics: Unhealthy
         # is forever).
         self.recovery_window_s = recovery_window_s
@@ -142,6 +166,11 @@ class TpuHealthChecker:
                 self._stop.wait(self.event_wait_timeout_s)
             if event is not None:
                 self.catch_error(event)
+            # The external injector file is polled on the same cadence
+            # as the event stream — and like recovery below, it keeps
+            # working while the stream is down: the injector is a
+            # SECOND fault source, not a consumer of the first.
+            self.poll_fault_file()
             # Recovery runs even while the event stream is down: an
             # outage of the *detector* must not pin devices Unhealthy.
             self.maybe_recover()
@@ -218,6 +247,97 @@ class TpuHealthChecker:
     def _window_for(self, name: str) -> float:
         """Effective quiescence window: doubled per recorded flap."""
         return self.recovery_window_s * (2 ** self._flaps.get(name, 0))
+
+    # -- external injector file (TPU_CHIP_FAULT_FILE) ------------------------
+
+    def poll_fault_file(self) -> int:
+        """Consume new complete lines from the injector file; returns
+        the number of events applied.  Public so deterministic drivers
+        (the fleet rig's per-round pump) can poll without the listener
+        thread.  A missing file is 'no injector yet', never an error;
+        a file that SHRANK was truncated/rotated and is re-read from
+        the top (the new incarnation's events must not be skipped)."""
+        path = self.fault_file
+        if not path:
+            return 0
+        try:
+            size = os.stat(path).st_size
+        except OSError:
+            return 0
+        if size < self._fault_file_pos:
+            self._fault_file_pos = 0
+        if size == self._fault_file_pos:
+            return 0
+        try:
+            with open(path, "rb") as f:
+                f.seek(self._fault_file_pos)
+                blob = f.read(size - self._fault_file_pos)
+        except OSError as e:
+            log.error("chip-fault file %s unreadable: %s", path, e)
+            return 0
+        # Only complete lines are consumed: an injector caught
+        # mid-write leaves its partial tail for the next poll.
+        consumed = blob.rfind(b"\n") + 1
+        if consumed == 0:
+            return 0
+        self._fault_file_pos += consumed
+        applied = 0
+        for raw in blob[:consumed].decode("utf-8", "replace").splitlines():
+            if self._apply_fault_line(raw.strip()):
+                applied += 1
+        return applied
+
+    def _apply_fault_line(self, line: str) -> bool:
+        if not line or line.startswith("#"):
+            return False
+        tokens = line.split()
+        kind = tokens[0].lower()
+        try:
+            if kind == "fault" and 2 <= len(tokens) <= 3:
+                code = int(tokens[2]) if len(tokens) == 3 else 48
+                counters.inc("health.fault_file.events")
+                trace.event("health.fault_file", kind="fault",
+                            device=tokens[1], code=code)
+                self.catch_error(TpuErrorEvent(
+                    code=code, device=tokens[1],
+                    message="injected via chip-fault file"))
+                return True
+            if kind == "clear" and len(tokens) == 2:
+                counters.inc("health.fault_file.events")
+                trace.event("health.fault_file", kind="clear",
+                            device=tokens[1])
+                self.clear_device(tokens[1])
+                return True
+            raise ValueError("want 'fault <dev> [code]' or "
+                             "'clear <dev>'")
+        except ValueError as e:
+            # The TPU_FAULT_SPEC rule: a malformed injector line must
+            # never take the health checker down.
+            counters.inc("health.fault_file.malformed")
+            log.error("ignoring malformed chip-fault line %r: %s",
+                      line, e)
+            return False
+
+    def clear_device(self, name: str) -> int:
+        """External all-clear for one device: expire its quiescence
+        window NOW and run the normal recovery sweep — same queue,
+        same ``health.recovered`` accounting, no second state machine.
+        The flap history is forgiven too: an operator's explicit clear
+        asserts the cause is FIXED, which is exactly the evidence the
+        flap-backoff escalation lacks.  Returns devices recovered."""
+        with self._mu:
+            if name not in self._unhealthy_since:
+                return 0
+            self._unhealthy_since[name] = float("-inf")
+            self._flaps.pop(name, None)
+        if not self.recovery_window_s:
+            # Recovery disabled (strict reference semantics): even an
+            # external clear must not re-announce — maybe_recover
+            # would refuse, so say so instead of silently no-opping.
+            log.warning("chip-fault clear for %s ignored: recovery "
+                        "is disabled", name)
+            return 0
+        return self.maybe_recover()
 
     # -- recovery ------------------------------------------------------------
 

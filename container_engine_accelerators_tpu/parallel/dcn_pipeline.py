@@ -35,7 +35,12 @@ The defaults (1 MiB chunks, 2 stripes) are tuned for the loopback
 rig, where per-chunk thread handoffs cost more than bandwidth and
 wide fan-out loses to scheduling; on real cross-slice NICs smaller
 chunks and more stripes is the FlexLink +27% — that is exactly what
-the env knobs are for.
+the env knobs are for.  With ``TPU_DCN_TUNE`` on, the static grid is
+only the BASE: a per-destination closed-loop controller
+(``parallel/dcn_tune.py``) adapts chunk size and stripe count from
+the transfer's own telemetry — chunk moves latch at transfer
+boundaries (the seq/dedup contract pins the grid mid-transfer),
+stripe moves also apply between retry rounds.
 
 ``read_pipelined`` is the stripe reader: it waits for the peer's frame
 to finish assembling (the daemon's blocking ``wait`` op), then fetches
@@ -74,7 +79,7 @@ from typing import Dict, List, Optional, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import critpath, histo, timeseries, trace
-from container_engine_accelerators_tpu.parallel import dcn_shm
+from container_engine_accelerators_tpu.parallel import dcn_shm, dcn_tune
 from container_engine_accelerators_tpu.parallel.dcn_client import (
     DcnWaitUnsupported,
     DcnXferClient,
@@ -116,7 +121,8 @@ class PipelineConfig:
     def __init__(self, chunk_bytes: Optional[int] = None,
                  stripes: Optional[int] = None,
                  max_rounds: int = DEFAULT_MAX_ROUNDS,
-                 env=None, shm: Optional[bool] = None):
+                 env=None, shm: Optional[bool] = None,
+                 tuned: Optional[bool] = None):
         env = env if env is not None else os.environ
         if chunk_bytes is None:
             chunk_bytes = int(env.get(CHUNK_BYTES_ENV,
@@ -133,10 +139,18 @@ class PipelineConfig:
         # the host-identity match still gate each transfer.
         self.shm = (dcn_shm.shm_enabled(env) if shm is None
                     else bool(shm))
+        # Closed-loop grid control (parallel/dcn_tune.py): the
+        # configured chunk/stripe grid becomes the controller's BASE,
+        # adapted per destination from its own telemetry.  Off (the
+        # TPU_DCN_TUNE kill switch, and the default) the static grid
+        # runs byte-for-byte.
+        self.tuned = (dcn_tune.tune_enabled(env) if tuned is None
+                      else bool(tuned))
 
     def __repr__(self):
         return (f"PipelineConfig(chunk_bytes={self.chunk_bytes}, "
-                f"stripes={self.stripes}, shm={self.shm})")
+                f"stripes={self.stripes}, shm={self.shm}, "
+                f"tuned={self.tuned})")
 
 
 def plan_chunks(nbytes: int, chunk_bytes: int) -> List[Tuple[int, int]]:
@@ -449,7 +463,7 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                 pass
 
 
-def _observe_exposed(span, comm_iv, stage_iv) -> None:
+def _observe_exposed(span, comm_iv, stage_iv) -> Optional[float]:
     """Exposed-communication time for one completed transfer: DCN
     round-trip time NOT overlapped by local staging (obs/critpath's
     interval algebra — the same math the offline analyzer applies to
@@ -460,7 +474,7 @@ def _observe_exposed(span, comm_iv, stage_iv) -> None:
     staging (the T3 goal)."""
     comm_s = critpath.covered_s(comm_iv)
     if comm_s <= 0:
-        return
+        return None
     exp_s = critpath.exposed_s(comm_iv, stage_iv)
     histo.observe("dcn.exposed", exp_s, trace_id=span.trace_id)
     histo.observe("dcn.comm", comm_s, trace_id=span.trace_id)
@@ -468,6 +482,7 @@ def _observe_exposed(span, comm_iv, stage_iv) -> None:
     timeseries.gauge("dcn.exposed_ratio", ratio)
     span.annotate(exposed_ms=round(exp_s * 1e3, 3),
                   exposed_ratio=round(ratio, 4))
+    return ratio
 
 
 def send_pipelined(client, flow: str, data: bytes, host: str,
@@ -494,14 +509,34 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     """
     cfg = cfg or PipelineConfig()
     nbytes = len(data)
-    chunk_bytes = cfg.chunk_bytes
+    # Closed-loop grid control: the tuner (one per destination daemon)
+    # turns the configured grid into this transfer's plan.  The chunk
+    # grid LATCHES here for the whole transfer — it pins the seq block
+    # the dedup window referees — while stripe moves also apply
+    # between retry rounds below.  Kill switch off: tuner is None and
+    # the static grid runs byte-for-byte.
+    tuner = (dcn_tune.tuner_for(f"{host}:{port}")
+             if cfg.tuned else None)
+    if tuner is not None:
+        chunk_bytes, planned_stripes = tuner.plan(cfg.chunk_bytes,
+                                                  cfg.stripes)
+    else:
+        chunk_bytes, planned_stripes = cfg.chunk_bytes, cfg.stripes
     if nbytes > chunk_bytes * MAX_CHUNKS_PER_TRANSFER:
         # More chunks than the dedup window can referee would turn a
         # late retransmit into a silent 'dup' drop; grow the chunks.
+        # For a tuned plan this is the protocol floor the shrink lever
+        # cannot pass (nbytes/128 beats any learned grid), so the plan
+        # gauge is republished with the EFFECTIVE chunk — the wire and
+        # the dashboard must not disagree.
+        grid = chunk_bytes
         chunk_bytes = -(-nbytes // MAX_CHUNKS_PER_TRANSFER)
+        if tuner is not None:
+            timeseries.gauge("dcn.tune.chunk_bytes",
+                             float(chunk_bytes))
         log.warning(
             "chunk size raised %d -> %d for a %d-byte transfer "
-            "(dedup-window cap of %d chunks)", cfg.chunk_bytes,
+            "(dedup-window cap of %d chunks)", grid,
             chunk_bytes, nbytes, MAX_CHUNKS_PER_TRANSFER,
         )
     chunks = plan_chunks(nbytes, chunk_bytes)
@@ -510,7 +545,7 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
         # the public contract must not divide by the chunk count.
         return {"bytes": 0, "chunks": 0, "stripes": 0, "rounds": 0,
                 "lane": "none"}
-    stripes = min(cfg.stripes, len(chunks))
+    stripes = min(planned_stripes, len(chunks))
     # One logical transfer = one xid (the receiver's assembly key) and
     # one contiguous block of per-flow seqs.  A retransmit round reuses
     # BOTH: that is what lets the dedup window kill replays per chunk.
@@ -554,6 +589,16 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                 # that restarted WITHOUT shm downgrades the remaining
                 # rounds to the socket lane.
                 client.ping()
+                if tuner is not None:
+                    # Stripe moves apply BETWEEN retry rounds too:
+                    # re-striping pending chunk indices is seq-safe
+                    # (the chunk grid and its seqs stay latched).
+                    stripes = min(max(1, tuner.stripes_now()),
+                                  len(pending))
+                    timeseries.gauge("dcn.stripes.configured",
+                                     stripes)
+            attempted = len(pending)
+            round_t0 = time.monotonic()
             result = _StripeResult()
             # Zero-copy lane, decided per round: kill switch off, the
             # machinery has not failed this transfer, and the daemon
@@ -620,6 +665,8 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                     # hung mid-op): surface now; the daemon-thread
                     # workers die with their sockets and later frames
                     # dedup away.
+                    if tuner is not None:
+                        tuner.on_transfer(False)
                     raise DcnXferError(
                         f"pipelined send of {flow!r} exceeded its "
                         f"{timeout_s:.1f}s budget with stripe workers "
@@ -631,6 +678,9 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
             # "dropped" (link ate it), "unmatched" (receiver had no
             # flow yet), "rejected", a missing record, any future
             # verdict — goes again under the same seq.
+            settled_bytes = sum(
+                chunks[i][1] for i, v in result.verdicts.items()
+                if v in ("sent", "landed", "dup"))
             pending = [i for i in pending
                        if result.verdicts.get(i)
                        not in ("sent", "landed", "dup")]
@@ -639,13 +689,33 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
             comm_iv.extend(result.phases.get("comm", ()))
             span.annotate(round=rnd, pending=len(pending),
                           lane="+".join(sorted(lanes)))
+            # Published after EVERY round, with the chunks this round
+            # just lost counted in: the tuner and the SLO judge see
+            # mid-transfer loss the moment it is known, not once the
+            # transfer completes — a controller steering on a
+            # completion-time gauge would always be one transfer late.
             timeseries.gauge("dcn.pipeline.retransmit_ratio",
-                             resent / len(chunks))
+                             (resent + len(pending)) / len(chunks))
+            if tuner is not None:
+                tuner.on_round(
+                    attempted=attempted, failed=len(pending),
+                    bytes_confirmed=settled_bytes,
+                    elapsed_s=time.monotonic() - round_t0,
+                    lane="shm" if ran_shm else "socket",
+                    # A partial retry round's B/s is overhead-bound —
+                    # loss evidence, not capability evidence.
+                    full_round=attempted == len(chunks))
             if not pending:
-                _observe_exposed(span, comm_iv, stage_iv)
+                ratio = _observe_exposed(span, comm_iv, stage_iv)
+                if tuner is not None:
+                    tuner.on_transfer(True, exposed_ratio=ratio)
                 return {"bytes": nbytes, "chunks": len(chunks),
                         "stripes": stripes, "rounds": rnd + 1,
                         "lane": "+".join(sorted(lanes))}
+        if tuner is not None:
+            # Round budget spent with chunks still unconfirmed: the
+            # strongest degradation signal the controller gets.
+            tuner.on_transfer(False)
         raise DcnXferError(
             f"pipelined send of {flow!r} left {len(pending)}/"
             f"{len(chunks)} chunk(s) unconfirmed after "

@@ -447,6 +447,42 @@ class TestBenchHarness:
         # "how far from memcpy speed" gap is always on record.
         assert modes == set(mod.MODES)
 
+    def test_bench_tuned_compare_gate(self, tmp_path):
+        """`make tune`'s bench leg in miniature: --tuned adds the
+        closed-loop series, --compare sweeps the static --grid cells
+        and gates tuned against the best of them; the JSONL carries
+        both the tuned records and one dcn_xfer_grid record per
+        cell."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "dcn_bench_tuned",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "cmd", "dcn_bench.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = tmp_path / "bench.jsonl"
+        rc = mod.main(["--sizes", "16384", "--iters", "2",
+                       "--chunk-bytes", "4096", "--stripes", "2",
+                       "--tuned", "--compare", "--tune-warmup", "2",
+                       "--grid", "4096:1,4096:2",
+                       "--tune-min-ratio", "0.1",
+                       "--min-ratio", "0", "--shm-min-ratio", "0",
+                       "--out", str(out)])
+        # min-ratio 0.1: this test pins the plumbing and the JSONL
+        # contract, not the rig's noise floor (make tune owns that).
+        assert rc == 0
+        recs = [json.loads(line)
+                for line in out.read_text().strip().splitlines()]
+        sweep = [r for r in recs if r["bench"] == "dcn_xfer"]
+        grid = [r for r in recs if r["bench"] == "dcn_xfer_grid"]
+        assert {r["mode"] for r in sweep} == set(mod.MODES) | {"tuned"}
+        assert {(r["chunk_bytes"], r["stripes"]) for r in grid} \
+            == {(4096, 1), (4096, 2)}
+        assert all(r["mbps"] > 0 for r in recs)
+
 
 class TestLargeFrameShortWriteGuard:
     """Satellite: the rig's stack truncates very large single-syscall

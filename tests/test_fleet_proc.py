@@ -617,3 +617,37 @@ class TestProcScenarios:
         report = json.loads(proc.stdout.strip().splitlines()[-1])
         assert report["proc"] is True and report["converged"]
         assert report["nodes"]["n1"]["daemon_generation"] == 2
+
+
+@pytest.mark.slow
+class TestChipFaultFile:
+    """Satellite (ISSUE 11): the external chip-fault injector — a
+    worker's health checker polls TPU_CHIP_FAULT_FILE (the NVML-Xid
+    file analog), so faults arrive from OUTSIDE the coordinator RPC:
+    the RPC below only pumps the deterministic health sweep, the fault
+    source is the file."""
+
+    def test_proc_worker_ingests_external_fault_and_clear(
+            self, tmp_path):
+        from container_engine_accelerators_tpu.health.health_checker \
+            import FAULT_FILE_ENV
+
+        fault_path = str(tmp_path / "chip_faults")
+        env = dict(os.environ)
+        env.pop("TPU_FAULT_SPEC", None)
+        env[FAULT_FILE_ENV] = fault_path
+        a = _node(tmp_path, "nf", env=env)
+        try:
+            assert a.all_healthy()
+            with open(fault_path, "w") as f:
+                f.write("fault accel0 48\n")
+            a.recover()  # the per-round pump: polls the file too
+            snap = a.snapshot()
+            assert snap["devices"]["accel0"] == "Unhealthy"
+            assert snap["healthy"] == snap["total"] - 1
+            with open(fault_path, "a") as f:
+                f.write("clear accel0\n")
+            a.recover()
+            assert a.all_healthy()
+        finally:
+            a.close()

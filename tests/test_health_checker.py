@@ -87,3 +87,108 @@ def test_event_loop_end_to_end(manager, tmp_path):
         assert (e.id, e.health) == ("accel3", UNHEALTHY)
     finally:
         hc.stop()
+
+
+# -- external chip-fault injector (TPU_CHIP_FAULT_FILE, ISSUE 11) -----------
+
+
+def _file_checker(manager, tmp_path, **kw):
+    path = str(tmp_path / "chip_faults")
+    kw.setdefault("recovery_window_s", 300.0)
+    return TpuHealthChecker(manager, manager.lib, fault_file=path,
+                            **kw), path
+
+
+def test_fault_file_line_marks_device_unhealthy(manager, tmp_path):
+    hc, path = _file_checker(manager, tmp_path)
+    assert hc.poll_fault_file() == 0  # no injector yet: not an error
+    with open(path, "w") as f:
+        f.write("fault accel1 48\n")
+    assert hc.poll_fault_file() == 1
+    events = drain(manager.health_events)
+    assert [(e.id, e.health) for e in events] == [("accel1", UNHEALTHY)]
+    # Already-consumed lines are not replayed.
+    assert hc.poll_fault_file() == 0
+
+
+def test_fault_file_code_defaults_and_criticality(manager, tmp_path):
+    hc, path = _file_checker(manager, tmp_path)
+    with open(path, "w") as f:
+        f.write("fault accel0\n")     # no code -> 48 (critical)
+        f.write("fault accel2 13\n")  # non-critical code: no flip
+    assert hc.poll_fault_file() == 2  # both lines APPLIED as events
+    assert {e.id for e in drain(manager.health_events)} == {"accel0"}
+
+
+def test_fault_file_clear_recovers_immediately(manager, tmp_path):
+    from container_engine_accelerators_tpu.utils.device import HEALTHY
+
+    hc, path = _file_checker(manager, tmp_path)
+    with open(path, "w") as f:
+        f.write("fault accel3 48\n")
+    hc.poll_fault_file()
+    assert drain(manager.health_events)[0].health == UNHEALTHY
+    # The 300s quiescence window notwithstanding: an external clear is
+    # the operator saying FIXED — recovery rides the normal path, now.
+    with open(path, "a") as f:
+        f.write("clear accel3\n")
+    assert hc.poll_fault_file() == 1
+    events = drain(manager.health_events)
+    assert [(e.id, e.health) for e in events] == [("accel3", HEALTHY)]
+
+
+def test_fault_file_malformed_lines_skipped(manager, tmp_path):
+    from container_engine_accelerators_tpu.metrics import counters
+
+    hc, path = _file_checker(manager, tmp_path)
+    m0 = counters.get("health.fault_file.malformed")
+    with open(path, "w") as f:
+        f.write("garbage line here and more\n")
+        f.write("fault\n")              # missing device
+        f.write("fault accel1 nope\n")  # non-numeric code
+        f.write("# a comment\n")
+        f.write("\n")
+        f.write("fault accel1 48\n")    # the one good line
+    assert hc.poll_fault_file() == 1
+    assert counters.get("health.fault_file.malformed") == m0 + 3
+    assert {e.id for e in drain(manager.health_events)} == {"accel1"}
+
+
+def test_fault_file_partial_line_waits_for_newline(manager, tmp_path):
+    hc, path = _file_checker(manager, tmp_path)
+    with open(path, "w") as f:
+        f.write("fault accel2 48")  # injector caught mid-write
+    assert hc.poll_fault_file() == 0
+    assert drain(manager.health_events) == []
+    with open(path, "a") as f:
+        f.write("\n")
+    assert hc.poll_fault_file() == 1
+    assert {e.id for e in drain(manager.health_events)} == {"accel2"}
+
+
+def test_fault_file_truncation_rereads_from_top(manager, tmp_path):
+    hc, path = _file_checker(manager, tmp_path)
+    with open(path, "w") as f:
+        f.write("fault accel0 48\nfault accel0 48\n")
+    assert hc.poll_fault_file() == 2
+    drain(manager.health_events)
+    # Rotation: the new (shorter) file's lines must not be skipped.
+    # (Detection is size-based: a rotated file at least as long as the
+    # consumed offset reads as an append — the documented limit.)
+    with open(path, "w") as f:
+        f.write("fault accel1 48\n")
+    assert hc.poll_fault_file() == 1
+    assert {e.id for e in drain(manager.health_events)} == {"accel1"}
+
+
+def test_fault_file_env_resolution(manager, tmp_path, monkeypatch):
+    from container_engine_accelerators_tpu.health.health_checker import (
+        FAULT_FILE_ENV,
+    )
+
+    path = str(tmp_path / "env_faults")
+    monkeypatch.setenv(FAULT_FILE_ENV, path)
+    hc = TpuHealthChecker(manager, manager.lib)
+    assert hc.fault_file == path
+    monkeypatch.delenv(FAULT_FILE_ENV)
+    assert TpuHealthChecker(manager, manager.lib).fault_file is None
