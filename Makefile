@@ -138,12 +138,16 @@ fleet-serve:
 # DCN data-plane gate: the serial / pipelined-socket / shm microbench
 # on the loopback rig, with a memcpy reference series in the same
 # JSONL.  --compare exits non-zero if the pipelined lane falls below
-# serial, or the zero-copy same-host lane falls below 1.5x the socket
-# pipelined lane, at the largest swept message size (a lane
-# regression must fail CI, not just dent a table in the README).
+# serial, or the zero-copy same-host plane falls below 2.5x the
+# socket pipelined lane (the rig-measured post-ring/daemon-shm
+# floor), at the largest swept message size (a lane regression must
+# fail CI, not just dent a table in the README).  --shm-exposed-gate
+# additionally asserts the shm lane's exposed-comm ratio did not
+# regress above the socket-pipelined lane's: the descriptor-ring
+# doorbell must keep riding ahead of the staging memcpy.
 .PHONY: dcnbench
 dcnbench:
-	$(PY) cmd/dcn_bench.py --compare \
+	$(PY) cmd/dcn_bench.py --compare --shm-exposed-gate \
 	    --sizes 65536,1048576,4194304 --iters 3
 
 # Self-tuning data plane gate: the closed-loop controller end to end —
@@ -241,7 +245,8 @@ RACE_REPORT := /tmp/tpu_lockwatch_report.jsonl
 race:
 	rm -f $(RACE_REPORT)
 	TPU_LOCKWATCH=1 TPU_LOCKWATCH_REPORT=$(RACE_REPORT) \
-	    $(PY) -m pytest tests/test_dcn_pipeline.py tests/test_fleet.py \
+	    $(PY) -m pytest tests/test_dcn_pipeline.py tests/test_dcn_shm.py \
+	    tests/test_fleet.py \
 	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
 	    tests/test_serving.py \
 	    -q -m "not slow" -p no:randomly

@@ -221,6 +221,17 @@ def digest(fams: dict) -> dict:
                 "wasted": event_by.get("serving.hedge.wasted", 0.0),
             },
         }
+    # Lane split (the memcpy-speed same-host plane): where the data
+    # plane's BYTES go — daemon↔daemon segments, client↔daemon shm
+    # staging, or TCP — as live bytes/s next to cumulative totals.
+    # The shm_direct row > 0 with a flat socket row is the one-glance
+    # proof co-hosted transfers are skipping the peer TCP stream.
+    lanes = {}
+    for lane in ("shm_direct", "shm", "socket"):
+        bps = rate_by.get(f"dcn.lane.{lane}.bytes", 0.0)
+        total = gauge_by.get(f"dcn.lane.{lane}.total_bytes", 0.0)
+        if bps or total:
+            lanes[lane] = {"bps": bps, "total": total}
     # The self-tuning data plane's one-glance line: the controller's
     # current grid next to the phase panel it is steering.
     tuner = None
@@ -240,18 +251,23 @@ def digest(fams: dict) -> dict:
     return {"rates": rates, "goodput": goodput,
             "latency": latency, "gauges": gauges, "slos": slos,
             "serving": serving, "phases": phase_rows, "tuner": tuner,
+            "lanes": lanes,
             "exposed_ratio": dict(gauges).get("dcn.exposed_ratio")}
 
 
 # -- render ------------------------------------------------------------------
 
 
-def human_bps(v: float) -> str:
-    for unit in ("B/s", "KiB/s", "MiB/s", "GiB/s"):
-        if abs(v) < 1024 or unit == "GiB/s":
-            return f"{v:.1f} {unit}"
+def human_bytes(v: float, suffix: str = "") -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024 or unit == "GiB":
+            return f"{v:.1f} {unit}{suffix}"
         v /= 1024
-    return f"{v:.1f} GiB/s"  # pragma: no cover — loop always returns
+    return f"{v:.1f} GiB{suffix}"  # pragma: no cover — loop returns
+
+
+def human_bps(v: float) -> str:
+    return human_bytes(v, "/s")
 
 
 def render(model: dict, source: str, top_n: int = 10) -> str:
@@ -302,6 +318,19 @@ def render(model: dict, source: str, top_n: int = 10) -> str:
         if exposed is not None:
             lines.append(f"{'exposed comm ratio':<28} "
                          f"{'':>7} {'':>10} {exposed * 100:>6.1f}%")
+
+    lanes = model.get("lanes") or {}
+    if lanes:
+        lines.append("")
+        lines.append(f"{'lane split (same-host plane)':<28} "
+                     f"{'bytes/s':>14} {'total':>14}")
+        for lane in ("shm_direct", "shm", "socket"):
+            entry = lanes.get(lane)
+            if entry is None:
+                continue
+            lines.append(f"{lane:<28} "
+                         f"{human_bps(entry['bps']):>14} "
+                         f"{human_bytes(entry['total']):>14}")
 
     tuner = model.get("tuner")
     if tuner:
@@ -402,6 +431,15 @@ def _demo_server():
     with trace.span("dcn.wait", histogram="dcn.wait"):
         time.sleep(0.001)
     timeseries.gauge("dcn.exposed_ratio", 0.42)
+    # The lane-split panel's inputs (the memcpy-speed same-host
+    # plane): per-lane byte series + cumulative totals.
+    timeseries.record("dcn.lane.shm_direct.bytes", 5 << 20)
+    timeseries.record("dcn.lane.shm.bytes", 5 << 20)
+    timeseries.record("dcn.lane.socket.bytes", 1 << 20)
+    timeseries.gauge_add("dcn.lane.shm_direct.total_bytes", 48 << 20)
+    timeseries.gauge_add("dcn.lane.shm.total_bytes", 48 << 20)
+    timeseries.gauge_add("dcn.lane.socket.total_bytes", 9 << 20)
+    counters.inc("dcn.shm.ring.posts", 12)
     # The self-tuning data plane's line (parallel/dcn_tune.py).
     timeseries.gauge("dcn.tune.chunk_bytes", 262144)
     timeseries.gauge("dcn.tune.stripes", 2)

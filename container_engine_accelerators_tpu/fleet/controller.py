@@ -225,6 +225,13 @@ class FleetController:
             stripes=self.scenario.get("stripes"),
             shm=self.scenario.get("shm"),
             tuned=self.scenario.get("tuned"),
+            # `shm_direct: false` pins every daemon→peer leg to TCP —
+            # the lane-parity handle for proc scenarios, where real
+            # co-hosted worker daemons would otherwise take the
+            # daemon↔daemon segment lane (in-process fleets route
+            # through the fabric and never take it).
+            shm_direct=self.scenario.get("shm_direct"),
+            ring=self.scenario.get("shm_ring"),
         )
         self.leg_retry = RetryPolicy(
             max_attempts=int(self.scenario.get("leg_attempts", 3)),

@@ -306,6 +306,18 @@ class FleetTelemetry:
         } if not self.scrape else {}
         sample = {"round": rnd, "nodes": per_node,
                   "links_goodput_bps": per_link}
+        if not self.scrape:
+            # Lane evidence, fleet-wide: in-process daemons share one
+            # gauge registry, so the split is global here; proc mode
+            # carries it per node inside each scraped entry instead.
+            gauges = timeseries.gauges()
+            lanes = {
+                lane: int(gauges.get(f"dcn.lane.{lane}.total_bytes",
+                                     0.0))
+                for lane in ("shm_direct", "shm", "socket")
+            }
+            if any(lanes.values()):
+                sample["lanes_total_bytes"] = lanes
         self.history.append(sample)
         self._drain_local_spans()
         return sample
@@ -404,7 +416,7 @@ class FleetTelemetry:
         self._accumulate(name, "frames",
                          s.value("agent_events",
                                  event="xferd.frames.landed"), gen=gen)
-        return {
+        entry = {
             "goodput_bps": round(
                 s.value("agent_goodput", scope="node", name=name), 1),
             "down": False,
@@ -415,6 +427,17 @@ class FleetTelemetry:
             "transferred": int(s.value("agent_gauge",
                                        name="xferd.total_transferred")),
         }
+        # Per-node lane evidence (the memcpy-speed same-host plane):
+        # a worker whose shm_direct total grows while its socket
+        # total stays flat is provably skipping the peer TCP stream.
+        lanes = {
+            lane: int(s.value("agent_gauge",
+                              name=f"dcn.lane.{lane}.total_bytes"))
+            for lane in ("shm_direct", "shm", "socket")
+        }
+        if any(lanes.values()):
+            entry["lanes_total_bytes"] = lanes
+        return entry
 
     def _accumulate(self, node: str, key: str, current: float,
                     gen: Optional[int] = None) -> None:

@@ -167,10 +167,38 @@ class DcnXferClient:
 
     # -- shm lane ops (zero-copy same-host staging; fleet/xferd.py) ----------
 
-    def shm_attach(self, flow: str, nbytes: int) -> dict:
+    def shm_attach(self, flow: str, nbytes: int,
+                   ring: bool = False) -> dict:
         """Ask the daemon for the flow's mmap segment; returns
-        ``{path, bytes, frame_bytes}``.  Idempotent, grows in place."""
-        return self._call(op="shm_attach", flow=flow, bytes=int(nbytes))
+        ``{path, bytes, frame_bytes}``.  Idempotent, grows in place.
+        ``ring=True`` additionally asks for the flow's descriptor-ring
+        file (``ring_path``/``ring_slots`` in the response); a daemon
+        that predates the handoff just omits them — the caller's
+        signal to run per-chunk sends instead."""
+        req = {"op": "shm_attach", "flow": flow, "bytes": int(nbytes)}
+        if ring:
+            req["ring"] = 1
+        return self._call(**req)
+
+    def shm_post(self, flow: str, count: int, rnd: int, xid: str,
+                 total: int, host: str, port: int,
+                 direct: Optional[int] = None,
+                 stage_wait_ms: Optional[int] = None) -> dict:
+        """The descriptor-ring doorbell: tell the daemon that ``count``
+        chunk descriptors for round ``rnd`` are posted in the flow's
+        ring, to be completed toward the peer at (host, port).  ONE
+        control round trip replaces ``count`` per-chunk send ops; the
+        daemon publishes per-slot verdicts and a completion cursor
+        into the ring itself, which the caller polls out of its own
+        mapping — no further control traffic."""
+        req = {"op": "shm_post", "flow": flow, "count": int(count),
+               "round": int(rnd), "xid": xid, "total": int(total),
+               "host": host, "port": str(port)}
+        if direct is not None:
+            req["direct"] = int(direct)
+        if stage_wait_ms is not None:
+            req["stage_wait_ms"] = int(stage_wait_ms)
+        return self._call(**req)
 
     def shm_commit(self, flow: str, nbytes: int, xid: str = "") -> dict:
         """Declare ``[0, nbytes)`` of the attached segment a completed
@@ -210,7 +238,8 @@ class DcnXferClient:
         return int(self._call(op="data_port")["port"])
 
     def send(self, flow: str, host: str, port: int,
-             nbytes: Optional[int] = None) -> dict:
+             nbytes: Optional[int] = None,
+             direct: Optional[int] = None) -> dict:
         """Stream the flow's staging buffer to a peer daemon's data port.
 
         Returns {bytes, micros, gbps}.  This is the DCN data path the
@@ -223,6 +252,10 @@ class DcnXferClient:
         loss) re-sends the SAME seq and a dedup-aware receiver
         (fleet/xferd.py) lands the frame exactly once.  A caller-level
         retry of a whole leg is a new send() and a new frame.
+
+        ``direct=0`` pins the daemon's peer leg to TCP (the bench's
+        serial series must measure the TCP path, not the daemon↔daemon
+        segment lane); None leaves the daemon's own probe in charge.
         """
         seq = self._send_seq.get(flow, 0) + 1
         self._send_seq[flow] = seq
@@ -230,6 +263,8 @@ class DcnXferClient:
                "seq": seq}
         if nbytes is not None:
             req["bytes"] = nbytes
+        if direct is not None:
+            req["direct"] = int(direct)
         resp = self._call(**req)
         timeseries.record("dcn.tx.bytes", resp.get("bytes", 0))
         return resp
@@ -561,7 +596,8 @@ class ResilientDcnXferClient(DcnXferClient):
     RESTAGE_RX_TIMEOUT_S = 30.0
 
     def send(self, flow: str, host: str, port: int,
-             nbytes: Optional[int] = None) -> dict:
+             nbytes: Optional[int] = None,
+             direct: Optional[int] = None) -> dict:
         """`send` that survives the daemon losing the staged payload.
 
         A send issued (or retried) after a connection loss lands on a
@@ -583,14 +619,14 @@ class ResilientDcnXferClient(DcnXferClient):
             if st is not None and not st.get("frame_bytes", len(data)):
                 self._restage(flow, data)
         try:
-            return super().send(flow, host, port, nbytes)
+            return super().send(flow, host, port, nbytes, direct)
         except DcnXferError as e:
             if "nothing staged" not in str(e) or data is None:
                 raise
             self._restage(flow, data)
             # Re-issue under the seq the failed attempt burned.
             self._send_seq[flow] -= 1
-            return super().send(flow, host, port, nbytes)
+            return super().send(flow, host, port, nbytes, direct)
 
     def _restage(self, flow: str, data: bytes) -> None:
         counters.inc("dcn.send.restaged")

@@ -47,20 +47,28 @@ to finish assembling (the daemon's blocking ``wait`` op), then fetches
 contiguous slabs in parallel over raw data-plane ``DXR1`` requests —
 no base64, no 512 KiB control-socket chunking.
 
-On top of both sits the **zero-copy same-host lane** (ISSUE 6): when
-the daemon advertises ``shm`` in its handshake AND its ``host_id``
-matches this process's boot identity, staging becomes memoryview
-writes into the flow's mmap segment plus one ``shm_commit`` control
-op (no payload bytes on any socket, no stager/stripe thread fan-out —
-this rig's thread handoffs cost more than they buy), and read-back
-becomes ``shm_read`` + a client-side mapping instead of DXR1 socket
-copies.  The daemon→peer leg and every control op (seq assignment,
-dedup, ``wait``, fabric verdicts) are untouched, so exactly-once
-semantics are identical on either lane.  Lane selection happens PER
-RETRY ROUND: a daemon that restarts without the capability mid-
-transfer downgrades the remaining rounds to the socket lane
-(``dcn.shm.fallback``) under the same chunk seqs — cross-host peers
-and capability-less daemons simply never leave it.
+On top of both sits the **memcpy-speed same-host plane** (ISSUE 6 +
+ISSUE 13): when the daemon advertises ``shm`` in its handshake AND
+its ``host_id`` matches this process's boot identity, staging becomes
+memoryview writes into the flow's mmap segment plus one
+``shm_commit`` control op, and read-back becomes ``shm_read`` + a
+client-side mapping instead of DXR1 socket copies.  Per-chunk control
+ops collapse too: the client posts the round's (off, len, seq)
+descriptors into the flow's ring file and fires ONE ``shm_post``
+doorbell — deliberately BEFORE the staging memcpy, so the daemon's
+completer (parked on the descriptors' stage-waits) finishes the round
+behind the memcpy and the lane's exposed-comm ratio drops instead of
+sitting serial-shaped — then polls the completion cursor lock-free
+out of its own mapping.  The daemon→peer leg takes the daemon↔daemon
+segment lane on its own handshake when the PEER is co-hosted too
+(fleet/xferd.py), and every control decision (seq assignment, dedup,
+``wait``, fabric verdicts) is untouched, so exactly-once semantics
+are identical on every lane.  Lane selection happens PER RETRY ROUND:
+a daemon that restarts without the capability mid-transfer downgrades
+the remaining rounds to the socket lane (``dcn.shm.fallback``) under
+the same chunk seqs — cross-host peers and capability-less daemons
+simply never leave it; ring trouble falls back to per-chunk control
+ops (``dcn.shm.ring.fallback``) without leaving the shm lane.
 
 All of it falls back loudly (``DcnXferError``) rather than silently:
 the callers (``dcn.exchange_shard``, the fleet ring workload) own the
@@ -122,7 +130,9 @@ class PipelineConfig:
                  stripes: Optional[int] = None,
                  max_rounds: int = DEFAULT_MAX_ROUNDS,
                  env=None, shm: Optional[bool] = None,
-                 tuned: Optional[bool] = None):
+                 tuned: Optional[bool] = None,
+                 shm_direct: Optional[bool] = None,
+                 ring: Optional[bool] = None):
         env = env if env is not None else os.environ
         if chunk_bytes is None:
             chunk_bytes = int(env.get(CHUNK_BYTES_ENV,
@@ -139,6 +149,19 @@ class PipelineConfig:
         # the host-identity match still gate each transfer.
         self.shm = (dcn_shm.shm_enabled(env) if shm is None
                     else bool(shm))
+        # Daemon↔daemon segment lane pin (TPU_DCN_SHM_DIRECT): False
+        # stamps ``direct: 0`` on every send op, pinning the daemon's
+        # peer leg to TCP — how the bench keeps its socket series
+        # honest and the parity scenarios choose their lane.  True
+        # leaves the daemon's own probe-and-fallback in charge.
+        self.shm_direct = (dcn_shm.shm_direct_enabled(env)
+                           if shm_direct is None else bool(shm_direct))
+        # Descriptor-ring handoff pin (TPU_DCN_SHM_RING): False keeps
+        # shm rounds on per-chunk control ops — the legacy-shape
+        # chaos tests' handle, and the escape hatch if a ring
+        # regression ever ships.
+        self.ring = (dcn_shm.shm_ring_enabled(env)
+                     if ring is None else bool(ring))
         # Closed-loop grid control (parallel/dcn_tune.py): the
         # configured chunk/stripe grid becomes the controller's BASE,
         # adapted per destination from its own telemetry.  Off (the
@@ -150,6 +173,7 @@ class PipelineConfig:
     def __repr__(self):
         return (f"PipelineConfig(chunk_bytes={self.chunk_bytes}, "
                 f"stripes={self.stripes}, shm={self.shm}, "
+                f"shm_direct={self.shm_direct}, "
                 f"tuned={self.tuned})")
 
 
@@ -318,26 +342,31 @@ def _stage_worker(data_host: str, data_port: int, flow: str, data,
 def _send_chunk(ctl, flow: str, chunks, seqs, idx: int, xid: str,
                 host: str, port: int, total: int, timeout_s: float,
                 result: _StripeResult,
-                lane: Optional[str] = None) -> None:
+                lane: Optional[str] = None,
+                direct: Optional[int] = None) -> None:
     """Issue one offset-send and score its verdict — shared by the
     stripe workers and the shm round, so the settled-verdict set and
     the confirmed-chunk accounting can never diverge between lanes.
-    Raises on control-connection trouble; the caller owns what the
-    unrecorded chunks mean then."""
+    ``direct=0`` pins the daemon's peer leg to TCP (the socket
+    series' honesty guarantee); None leaves the daemon's own
+    shm_direct probe in charge.  Raises on control-connection
+    trouble; the caller owns what the unrecorded chunks mean then."""
     off, ln = chunks[idx]
     span_attrs = {"lane": lane} if lane else {}
+    req = dict(
+        op="send", flow=flow, host=host, port=str(port),
+        seq=seqs[idx], offset=off, bytes=ln, total=total, xid=xid,
+        stage_wait_ms=int(min(timeout_s, 5.0) * 1e3),
+    )
+    if direct is not None:
+        req["direct"] = direct
     timeseries.gauge_add("dcn.chunks.inflight", 1)
     t0 = time.monotonic()
     try:
         with trace.span("dcn.chunk.send", histogram="dcn.chunk.send",
                         flow=flow, off=off, bytes=ln, seq=seqs[idx],
                         **span_attrs):
-            resp = ctl._call(
-                op="send", flow=flow, host=host, port=str(port),
-                seq=seqs[idx], offset=off, bytes=ln, total=total,
-                xid=xid,
-                stage_wait_ms=int(min(timeout_s, 5.0) * 1e3),
-            )
+            resp = ctl._call(**req)
     finally:
         timeseries.gauge_add("dcn.chunks.inflight", -1)
         result.phase("comm", t0, time.monotonic())
@@ -354,7 +383,8 @@ def _send_chunk(ctl, flow: str, chunks, seqs, idx: int, xid: str,
 def _send_worker(uds_dir: str, flow: str, chunks, seqs, idxs,
                  xid: str, host: str, port: int, total: int,
                  timeout_s: float, result: _StripeResult,
-                 ctx: Optional[dict]) -> None:
+                 ctx: Optional[dict],
+                 direct: Optional[int] = None) -> None:
     """One stripe sender: its own control connection, issuing
     offset-sends for its share of the chunk grid.  Each stripe's
     chunks ride a distinct persistent daemon→peer stream (the daemon
@@ -368,7 +398,8 @@ def _send_worker(uds_dir: str, flow: str, chunks, seqs, idxs,
             ctl = DcnXferClient(uds_dir, timeout_s=max(timeout_s, 10.0))
             for idx in idxs:
                 _send_chunk(ctl, flow, chunks, seqs, idx, xid, host,
-                            port, total, timeout_s, result)
+                            port, total, timeout_s, result,
+                            direct=direct)
     except (DcnXferError, OSError) as e:
         # The scoreboard decides what to retry; this stripe's remaining
         # chunks simply stay unrecorded.
@@ -382,16 +413,158 @@ def _send_worker(uds_dir: str, flow: str, chunks, seqs, idxs,
                 pass
 
 
+def _shm_stage(ctl, flow: str, data, chunks, attach_resp: dict,
+               xid: str, result: _StripeResult) -> None:
+    """Memcpy the payload into the flow's segment and declare it
+    staged with ONE in-place ``shm_commit``.  Raises on any shortfall
+    (segment unmappable, commit refused) — the caller owns what that
+    means for the round."""
+    nbytes = len(data)
+    seg = None
+    t0 = time.monotonic()
+    try:
+        with trace.span("dcn.shm.stage", histogram="dcn.shm.stage",
+                        flow=flow, bytes=nbytes, xid=xid):
+            seg = dcn_shm.map_segment(
+                attach_resp.get("path", ""),
+                int(attach_resp.get("bytes") or 0))
+            if seg.size < nbytes:
+                raise OSError("segment smaller than payload")
+            src = memoryview(data)
+            for off, ln in chunks:
+                seg.view[off:off + ln] = src[off:off + ln]
+            ctl.shm_commit(flow, nbytes, xid)
+    finally:
+        if seg is not None:
+            seg.close()
+        result.phase("stage", t0, time.monotonic())
+    timeseries.record("dcn.shm.tx.bytes", nbytes)
+    timeseries.record("dcn.lane.shm.bytes", nbytes)
+    timeseries.gauge_add("dcn.lane.shm.total_bytes", nbytes)
+
+
+# Completion-poll backoff: the cursor lives in shared memory, so each
+# read is effectively free — but on an in-process rig (the bench, the
+# unit suites) the daemon needs the GIL to make progress, so the poll
+# yields from the very first iteration (sleep(0) = GIL release) and
+# backs off to 50 µs / 500 µs — still far below one control round
+# trip per chunk, which is the whole point of the handoff.
+_RING_SPIN_FAST = 50
+_RING_SPIN_SLOW = 400
+
+
+def _ring_round(ctl, ring, flow: str, data, chunks, seqs, idxs,
+                xid: str, host: str, port: int, timeout_s: float,
+                result: _StripeResult, attach_resp: dict,
+                staged_already: bool, direct_pin: Optional[int]
+                ) -> Optional[bool]:
+    """One descriptor-ring round: post (off, len, seq) descriptors
+    into the flow's ring, fire ONE ``shm_post`` doorbell, stage the
+    payload while the daemon's completer parks on the descriptors'
+    stage-waits, then poll the completion cursor lock-free out of the
+    client's own mapping and score the per-slot verdicts.
+
+    The doorbell deliberately precedes the staging memcpy: the
+    daemon-side completion window then COVERS the staging interval,
+    so the exposed-communication accounting shows the handoff hiding
+    control time behind the memcpy — the GPU-initiated-networking
+    shape (post work once, let the data plane complete it).
+
+    Returns True (round ran; scoreboard holds the verdicts — possibly
+    with chunks left pending for the next round), False (the shm
+    staging itself broke: caller downgrades to the socket lane), or
+    None (the ring handoff is unusable while shm staging may still
+    be fine: caller falls back to per-chunk control ops)."""
+    n = len(idxs)
+    nbytes = len(data)
+    try:
+        rnd = ring.post([(chunks[i][0], chunks[i][1], seqs[i])
+                         for i in idxs])
+    except (OSError, ValueError, struct.error):
+        return None
+    t0 = time.monotonic()
+    timeseries.gauge_add("dcn.chunks.inflight", n)
+    try:
+        # ONE span from doorbell to completion: this is the ring
+        # lane's whole DCN leg as the client sees it, so injected
+        # link latency (and any daemon-side stall) attributes HERE in
+        # a critical-path walk — the `dcn.chunk.send` analog.  The
+        # staging memcpy nests under it as a child, which is exactly
+        # the overlap story the exposed-comm accounting tells.
+        with trace.span("dcn.shm.post", histogram="dcn.shm.post",
+                        flow=flow, chunks=n, xid=xid):
+            try:
+                ctl.shm_post(flow, n, rnd, xid, nbytes, host, port,
+                             direct=direct_pin,
+                             stage_wait_ms=int(min(timeout_s, 5.0)
+                                               * 1e3))
+            except (DcnXferError, OSError) as e:
+                result.fail(e)
+                return None
+            if not staged_already:
+                try:
+                    _shm_stage(ctl, flow, data, chunks, attach_resp,
+                               xid, result)
+                except (DcnXferError, OSError) as e:
+                    # The posted descriptors' stage-waits expire on
+                    # the daemon side; nothing lands under their seqs.
+                    result.fail(e)
+                    return False
+            deadline = time.monotonic() + timeout_s
+            spins = 0
+            while True:
+                try:
+                    crnd, done = ring.completion()
+                except (ValueError, struct.error):
+                    return None
+                if crnd == rnd and done >= n:
+                    break
+                if time.monotonic() >= deadline:
+                    # Unfinished handoff: unrecorded chunks stay
+                    # pending; the next retry round re-sends them
+                    # under the SAME seqs (the completer's late sends
+                    # dedup away).
+                    result.fail(DcnXferError(
+                        f"ring round for {flow!r} timed out at "
+                        f"{done}/{n}"))
+                    return True
+                spins += 1
+                if spins > _RING_SPIN_SLOW:
+                    time.sleep(0.0005)
+                elif spins > _RING_SPIN_FAST:
+                    time.sleep(0.00005)
+                else:
+                    time.sleep(0)  # GIL yield: the daemon may BE us
+            try:
+                statuses = ring.statuses(n)
+            except (ValueError, struct.error):
+                return None
+    finally:
+        timeseries.gauge_add("dcn.chunks.inflight", -n)
+        result.phase("comm", t0, time.monotonic())
+    for slot, idx in enumerate(idxs):
+        verdict = dcn_shm.RING_VERDICTS.get(statuses[slot], "error")
+        if verdict in ("sent", "landed", "dup"):
+            # Same confirmed-chunk accounting as _send_chunk — the
+            # two handoff shapes must never diverge in the books.
+            counters.inc("dcn.pipeline.chunks")
+            timeseries.record("dcn.pipeline.tx.bytes", chunks[idx][1])
+        result.record(idx, verdict)
+    return True
+
+
 def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                xid: str, host: str, port: int, timeout_s: float,
                result: _StripeResult, ctx: Optional[dict],
-               already_staged: bool = False) -> bool:
-    """One zero-copy-lane round: stage the payload into the flow's
-    segment (memoryview writes + ONE in-place ``shm_commit``), then
-    issue this round's offset-sends serially on a dedicated fail-fast
-    control connection — no stager thread, no stripe fan-out: staging
-    is a memcpy now, and this rig's thread handoffs cost more than
-    they buy.
+               already_staged: bool = False,
+               direct_pin: Optional[int] = None,
+               use_ring: bool = True) -> bool:
+    """One zero-copy-lane round: descriptor-ring handoff when the
+    daemon offers it (one doorbell per round, completion polled out
+    of shared memory), per-chunk offset-sends on a dedicated
+    fail-fast control connection otherwise — either way no stager
+    thread and no stripe fan-out: staging is a memcpy, and this rig's
+    thread handoffs cost more than they buy.
 
     ``already_staged`` means an earlier round of THIS transfer staged
     and committed the whole frame; when the daemon still holds it
@@ -408,45 +581,54 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
     alive then."""
     nbytes = len(data)
     ctl = None
-    seg = None
+    ring_seg = None
     try:
         with trace.attach(ctx.get("trace") if ctx else None,
                           ctx.get("span") if ctx else None):
             try:
                 ctl = DcnXferClient(uds_dir,
                                     timeout_s=max(timeout_s, 10.0))
-                resp = ctl.shm_attach(flow, nbytes)
-                if not (already_staged
-                        and int(resp.get("frame_bytes") or 0)
-                        >= nbytes):
-                    t0 = time.monotonic()
-                    try:
-                        with trace.span("dcn.shm.stage",
-                                        histogram="dcn.shm.stage",
-                                        flow=flow, bytes=nbytes,
-                                        xid=xid):
-                            seg = dcn_shm.map_segment(
-                                resp.get("path", ""),
-                                int(resp.get("bytes") or 0))
-                            if seg.size < nbytes:
-                                raise OSError(
-                                    "segment smaller than payload")
-                            src = memoryview(data)
-                            for off, ln in chunks:
-                                seg.view[off:off + ln] = \
-                                    src[off:off + ln]
-                            ctl.shm_commit(flow, nbytes, xid)
-                    finally:
-                        result.phase("stage", t0, time.monotonic())
-                    timeseries.record("dcn.shm.tx.bytes", nbytes)
+                resp = ctl.shm_attach(flow, nbytes, ring=use_ring)
             except (DcnXferError, OSError) as e:
                 result.fail(e)
                 return False
+            staged_already = (already_staged
+                              and int(resp.get("frame_bytes") or 0)
+                              >= nbytes)
+            ring = None
+            if use_ring and resp.get("ring_path"):
+                try:
+                    ring_seg = dcn_shm.map_segment(
+                        resp["ring_path"],
+                        dcn_shm.ring_bytes(
+                            int(resp.get("ring_slots") or 0)))
+                    ring = dcn_shm.RingView(ring_seg.view)
+                    if ring.slots < len(idxs):
+                        ring = None
+                except OSError:
+                    ring = None
+            if ring is not None:
+                ran = _ring_round(ctl, ring, flow, data, chunks,
+                                  seqs, idxs, xid, host, port,
+                                  timeout_s, result, resp,
+                                  staged_already, direct_pin)
+                if ran is not None:
+                    return ran
+                counters.inc("dcn.shm.ring.fallback")
+            # Per-chunk handoff (ring-less daemons, broken rings):
+            # stage first, then serial offset-sends.
+            if not staged_already:
+                try:
+                    _shm_stage(ctl, flow, data, chunks, resp, xid,
+                               result)
+                except (DcnXferError, OSError) as e:
+                    result.fail(e)
+                    return False
             for idx in idxs:
                 try:
                     _send_chunk(ctl, flow, chunks, seqs, idx, xid,
                                 host, port, nbytes, timeout_s, result,
-                                lane="shm")
+                                lane="shm", direct=direct_pin)
                 except (DcnXferError, OSError) as e:
                     # Staged fine; these chunks simply stay pending
                     # for the next round (same seqs, any lane).
@@ -454,8 +636,8 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                     return True
             return True
     finally:
-        if seg is not None:
-            seg.close()
+        if ring_seg is not None:
+            ring_seg.close()
         if ctl is not None:
             try:
                 ctl.close()
@@ -558,6 +740,10 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     # on the scrape; configured reflects the most recent transfer.
     timeseries.gauge("dcn.stripes.configured", stripes)
     uds_dir = client._uds_dir
+    # Daemon↔daemon lane pin for every send op of this transfer:
+    # ``0`` forces the peer leg onto TCP, None defers to the sending
+    # daemon's own probe (host-identity handshake + env switch).
+    direct_pin = None if cfg.shm_direct else 0
     pending = list(range(len(chunks)))
     resent = 0  # chunk-sends beyond the first round (retransmits)
     lanes = set()  # lanes that actually ran a round
@@ -608,7 +794,9 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                 ran_shm = _shm_round(uds_dir, flow, data, chunks,
                                      seqs, list(pending), xid, host,
                                      port, timeout_s, result, ctx,
-                                     already_staged="shm" in lanes)
+                                     already_staged="shm" in lanes,
+                                     direct_pin=direct_pin,
+                                     use_ring=cfg.ring)
                 if ran_shm:
                     if "shm" not in lanes:
                         counters.inc("dcn.shm.transfers")
@@ -650,7 +838,7 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                             target=_send_worker,
                             args=(uds_dir, flow, chunks, seqs, idxs,
                                   xid, host, port, nbytes, timeout_s,
-                                  result, wctx),
+                                  result, wctx, direct_pin),
                             name=f"dcn-stripe-{flow}-{s}",
                             daemon=True,
                         ))

@@ -470,6 +470,7 @@ class TestBenchHarness:
                        "--grid", "4096:1,4096:2",
                        "--tune-min-ratio", "0.1",
                        "--min-ratio", "0", "--shm-min-ratio", "0",
+                       "--exposed-slack", "1",
                        "--out", str(out)])
         # min-ratio 0.1: this test pins the plumbing and the JSONL
         # contract, not the rig's noise floor (make tune owns that).

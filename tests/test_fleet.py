@@ -354,8 +354,13 @@ PIPE_PAYLOAD = bytes(range(256)) * 64  # 16 KiB
 PIPE_N = len(PIPE_PAYLOAD)
 
 LANE_CFGS = {
+    # ring=False: these scenarios arm drop_response("send"), i.e. the
+    # per-chunk control-op shape.  The descriptor-ring handoff has no
+    # per-chunk ops to drop — its work-done-answer-lost chaos story
+    # (doorbell response dies, completer lands anyway, retry dedups)
+    # lives in tests/test_dcn_shm.py::TestRingHandoff.
     "shm": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                       shm=True),
+                                       shm=True, ring=False),
     "socket": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
                                           shm=False),
 }

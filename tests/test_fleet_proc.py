@@ -54,7 +54,12 @@ PAYLOAD = bytes(range(256)) * 16  # 4 KiB
 N = len(PAYLOAD)
 PIPE_PAYLOAD = bytes(range(256)) * 64  # 16 KiB = 4 chunks
 PIPE_N = len(PIPE_PAYLOAD)
-PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2)
+# ring=False: these scenarios pin the per-chunk control-op shape
+# (drop_response("send") has per-chunk ops to drop, round/seq
+# assertions match it).  The descriptor-ring + daemon↔daemon lane
+# get their own parity scenarios in TestProcShmDirectParity below.
+PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                       ring=False)
 
 # One spawn attempt, tiny backoff: failure tests must not sit through
 # the production respawn budget.
@@ -574,6 +579,95 @@ class TestProcScenarios:
                            event="xferd.frames.landed") == 4.0
             assert dcn_pipeline.read_pipelined(
                 b.client, "pk", PIPE_N, PIPE_CFG) == PIPE_PAYLOAD
+        finally:
+            a.close()
+            b.close()
+
+    def test_receiver_sigkill_exactly_once_daemon_shm_lane(
+            self, tmp_path):
+        """ISSUE 13 chaos parity: the SIGKILL-mid-transfer story on
+        the daemon↔daemon segment lane.  Real co-hosted worker
+        processes take the direct lane (scraped lane counters prove
+        zero peer-TCP payload bytes); kill -9 the receiver with a
+        transfer outstanding and the send fails LOUDLY; after the
+        supervised respawn (fresh port, wiped segments) the retry
+        lands byte-exact exactly once — the respawned daemon is
+        re-probed, never trusted stale."""
+        cfg = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                          shm=True, shm_direct=True)
+        a = _node(tmp_path, "na")
+        b = _node(tmp_path, "nb")
+        try:
+            b.client.register_flow("dk", bytes=PIPE_N)
+            a.client.register_flow("dk", bytes=PIPE_N)
+            b.client.shm_attach("dk", PIPE_N)
+            res = dcn_pipeline.send_pipelined(
+                a.client, "dk", PIPE_PAYLOAD, "127.0.0.1",
+                b.daemon.data_port, cfg, timeout_s=10)
+            assert res["lane"] == "shm"
+            _wait_stable_rx(b.client, "dk", PIPE_N)
+            # Lane evidence over HTTP from the SENDER worker: all
+            # payload bytes moved through segments, none over TCP.
+            s = _scrape_after_collect(a.metrics_port)
+            assert s.value(
+                "agent_gauge",
+                name="dcn.lane.shm_direct.total_bytes") == PIPE_N
+            assert s.value("agent_gauge",
+                           name="dcn.lane.socket.total_bytes") == 0.0
+
+            b.kill_daemon()  # SIGKILL: the lane dies mid-plane
+            with pytest.raises(DcnXferError, match="unconfirmed"):
+                dcn_pipeline.send_pipelined(
+                    a.client, "dk", PIPE_PAYLOAD, "127.0.0.1",
+                    b.daemon.data_port, cfg, timeout_s=3)
+            b.restart_daemon()
+            b.client.ping()  # reconnect + flow replay re-registers dk
+            res = dcn_pipeline.send_pipelined(
+                a.client, "dk", PIPE_PAYLOAD[::-1], "127.0.0.1",
+                b.daemon.data_port, cfg, timeout_s=10)
+            assert res["rounds"] == 1
+            _wait_stable_rx(b.client, "dk", PIPE_N)  # fresh daemon: N
+            assert dcn_pipeline.read_pipelined(
+                b.client, "dk", PIPE_N, cfg) == PIPE_PAYLOAD[::-1]
+        finally:
+            a.close()
+            b.close()
+
+    def test_doorbell_lost_mid_transfer_downgrade_same_seqs_dedup(
+            self, tmp_path):
+        """ISSUE 13 chaos parity, downgrade edition: the ring
+        doorbell's answer dies with the sender's control connection
+        (work enqueued, answer lost).  The SAME transfer downgrades to
+        the socket-lane round and re-sends the SAME chunk seqs; the
+        completer's late landings and the re-sends referee through the
+        receiver WORKER's dedup window — exactly-once proven from its
+        scraped counters, across real process boundaries."""
+        cfg = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                          shm=True, shm_direct=True)
+        a = _node(tmp_path, "na")
+        b = _node(tmp_path, "nb")
+        try:
+            b.client.register_flow("dg2", bytes=PIPE_N)
+            a.client.register_flow("dg2", bytes=PIPE_N)
+            a.drop_response_once("shm_post")
+            res = dcn_pipeline.send_pipelined(
+                a.client, "dg2", PIPE_PAYLOAD, "127.0.0.1",
+                b.daemon.data_port, cfg, timeout_s=10)
+            # The shm round broke mid-transfer; the socket round
+            # completed the SAME transfer under the same seq block.
+            assert "socket" in res["lane"]
+            _wait_stable_rx(b.client, "dg2", PIPE_N)  # exactly once
+            s = _scrape_after_collect(b.metrics_port)
+            landed = s.value("agent_events",
+                             event="xferd.frames.landed")
+            deduped = s.value("agent_events",
+                              event="dcn.frames.deduped")
+            # 4 chunks landed once each; every duplicate delivery
+            # (completer vs. downgraded round, same seqs) deduped.
+            assert landed == 4.0
+            assert deduped >= 1.0
+            assert dcn_pipeline.read_pipelined(
+                b.client, "dg2", PIPE_N, cfg) == PIPE_PAYLOAD
         finally:
             a.close()
             b.close()
