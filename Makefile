@@ -190,6 +190,22 @@ tune:
 lint:
 	$(PY) cmd/agent_lint.py
 
+# Continuous-profiler gate: the sampler suite (fold/classify units,
+# bounded-LRU + dropped accounting, /profile endpoint paging, fleet
+# profile merge, agent_prof CLI, and the attribution smoke — a
+# deliberately staged-copy-heavy run must attribute >= half its busy
+# samples to the shm-staging subsystem), then the overhead gate: the
+# always-on sampler at the default TPU_PROF_HZ must cost < 5% of
+# pipelined bench throughput, judged on paired off/on transfers with
+# a breach-must-reproduce retry (one noisy window on a loaded builder
+# cannot flake CI; a genuinely costly sampler fails both windows).
+# Folded into presubmit.
+.PHONY: prof
+prof:
+	$(PY) -m pytest tests/test_profiler.py -q -p no:randomly
+	$(PY) cmd/dcn_bench.py --prof-overhead-gate \
+	    --sizes 4194304 --iters 7 > /dev/null
+
 # Critical-path gate: the where-did-the-time-go chain end to end —
 # the critpath unit/e2e suite, then one pipelined fleet scenario whose
 # report must carry a non-empty `critical_path` section, the same
@@ -248,7 +264,7 @@ race:
 	    $(PY) -m pytest tests/test_dcn_pipeline.py tests/test_dcn_shm.py \
 	    tests/test_fleet.py \
 	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
-	    tests/test_serving.py \
+	    tests/test_serving.py tests/test_profiler.py \
 	    -q -m "not slow" -p no:randomly
 	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
 	    --check $(RACE_REPORT)
@@ -262,6 +278,7 @@ presubmit:
 	$(MAKE) critpath
 	$(MAKE) fleet-serve
 	$(MAKE) tune
+	$(MAKE) prof
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to
 # BENCH_TPU_LOG.jsonl). Each stage is independent; failures don't stop

@@ -24,7 +24,12 @@ place:
   phase (stage / send / wait / read, socket and shm lanes) — each
   phase op's share of the summed phase time estimated from the
   cumulative le buckets, next to the live ``dcn.exposed_ratio`` gauge
-  (DCN time not hidden behind staging; 1.0 = serial-shaped).
+  (DCN time not hidden behind staging; 1.0 = serial-shaped);
+- **hotspots**: where the CPU goes — top subsystems by sample share
+  from the same server's ``/profile`` endpoint (the continuous
+  profiler, obs/profiler.py), idle threads split out so a parked pool
+  never drowns the busy share.  Absent when the endpoint is (an old
+  agent, or ``TPU_PROF=0``).
 
 Usage:
   python cmd/agent_top.py                       # live, 2s refresh
@@ -47,7 +52,10 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from container_engine_accelerators_tpu.obs import promtext  # noqa: E402
+from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    profiler,
+    promtext,
+)
 
 FAMILIES = ("agent_rate", "agent_goodput", "agent_gauge",
             "agent_latency", "agent_exemplar", "agent_events")
@@ -78,6 +86,23 @@ def parse_args(argv=None):
 def scrape(url: str, timeout_s: float = 10.0) -> str:
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:
         return resp.read().decode()
+
+
+def profile_url(metrics_url: str) -> str:
+    """``…/metrics`` -> ``…/profile`` (same listener serves both)."""
+    if metrics_url.endswith("/metrics"):
+        return metrics_url[: -len("/metrics")] + "/profile"
+    return metrics_url.rstrip("/") + "/profile"
+
+
+def scrape_profile(url: str, timeout_s: float = 10.0):
+    """The hotspot panel's input: the /profile body, or None when the
+    endpoint is absent/unreachable — the panel degrades to absent,
+    never takes down the screen."""
+    try:
+        return profiler.fetch(url, timeout_s)
+    except (OSError, ValueError):
+        return None
 
 
 def parse_families(text: str) -> dict:
@@ -126,8 +151,8 @@ PHASE_OPS = (
 )
 
 
-def digest(fams: dict) -> dict:
-    """Family samples -> the screen model."""
+def digest(fams: dict, prof: dict = None) -> dict:
+    """Family samples (+ optional /profile body) -> the screen model."""
     rates = sorted(
         ((lb.get("event", "?"), v) for lb, v in fams["agent_rate"]),
         key=lambda kv: -kv[1])
@@ -248,10 +273,39 @@ def digest(fams: dict) -> dict:
                          if k.startswith("dcn.tune.")
                          and k != "dcn.tune.clamped"),
         }
+    # Hotspot panel (the continuous profiler's /profile scrape):
+    # subsystems by sample count, idle split out — "which code burns
+    # the CPU" beside the phase panel's "which phase burns the time".
+    # A malformed body (a reused port answering junk JSON) costs the
+    # panel, never the screen — same rule as an unreachable endpoint.
+    hotspots = None
+    try:
+        subs_raw = prof.get("subsystems") if prof else None
+        if isinstance(subs_raw, dict) and subs_raw:
+            subs = {str(k): int(float(v or 0))
+                    for k, v in subs_raw.items()}
+            idle = subs.get("idle", 0)
+            busy = sorted(((s, n) for s, n in subs.items()
+                           if s != "idle" and n > 0),
+                          key=lambda kv: -kv[1])
+            busy_total = sum(n for _, n in busy)
+            ratio = prof.get("overhead_ratio")
+            hotspots = {
+                "samples": int(float(prof.get("samples") or 0)),
+                "dropped": int(float(prof.get("dropped") or 0)),
+                "idle": idle,
+                "overhead_ratio": (float(ratio)
+                                   if ratio is not None else None),
+                "rows": [(s, n,
+                          n / busy_total if busy_total else 0.0)
+                         for s, n in busy],
+            }
+    except (TypeError, ValueError, AttributeError):
+        hotspots = None
     return {"rates": rates, "goodput": goodput,
             "latency": latency, "gauges": gauges, "slos": slos,
             "serving": serving, "phases": phase_rows, "tuner": tuner,
-            "lanes": lanes,
+            "lanes": lanes, "hotspots": hotspots,
             "exposed_ratio": dict(gauges).get("dcn.exposed_ratio")}
 
 
@@ -318,6 +372,21 @@ def render(model: dict, source: str, top_n: int = 10) -> str:
         if exposed is not None:
             lines.append(f"{'exposed comm ratio':<28} "
                          f"{'':>7} {'':>10} {exposed * 100:>6.1f}%")
+
+    hotspots = model.get("hotspots")
+    if hotspots:
+        lines.append("")
+        lines.append(f"{'hotspot (cpu sample share)':<28} "
+                     f"{'samples':>9} {'share':>7}")
+        for sub, n, share in hotspots["rows"][:top_n]:
+            lines.append(f"{sub:<28} {n:>9} {share * 100:>6.1f}%")
+        extra = ""
+        if hotspots.get("overhead_ratio") is not None:
+            extra = (f", sampler overhead "
+                     f"{hotspots['overhead_ratio'] * 100:.2f}%")
+        lines.append(f"{'(idle threads)':<28} "
+                     f"{hotspots['idle']:>9}  of "
+                     f"{hotspots['samples']}{extra}")
 
     lanes = model.get("lanes") or {}
     if lanes:
@@ -463,6 +532,19 @@ def _demo_server():
     timeseries.gauge("serving.breaker.open_nodes", 1)
     timeseries.gauge("slo.min_qps.ok", 1)  # lint: disable=undocumented-metric
     timeseries.gauge("slo.min_qps.value", 38.0)  # lint: disable=undocumented-metric
+    # The hotspot panel's input: seeded folded stacks in the process
+    # profiler registry — the demo server's /profile serves them.
+    profiler.ingest(
+        "parallel.dcn_pipeline.send_pipelined;"
+        "parallel.dcn_pipeline._shm_round;"
+        "parallel.dcn_pipeline._shm_stage", "shm-staging", 46)
+    profiler.ingest(
+        "parallel.dcn_pipeline.send_pipelined;"
+        "parallel.dcn_pipeline._send_worker", "dcn_pipeline", 21)
+    profiler.ingest(
+        "threading.run;fleet.xferd._serve_data_conn;"
+        "fleet.xferd._recv_and_land", "xferd", 12)
+    profiler.ingest("threading.run;threading.wait", "idle", 80)
 
     server = MetricServer(
         collector=_NoChips(), registry=CollectorRegistry(), port=0,
@@ -488,8 +570,9 @@ def main(argv=None):
         while True:
             try:
                 body = scrape(url)
-                screen = render(digest(parse_families(body)), url,
-                                args.top)
+                prof = scrape_profile(profile_url(url))
+                screen = render(digest(parse_families(body), prof),
+                                url, args.top)
                 banner = ""
             except (urllib.error.URLError, OSError) as e:
                 if args.once or screen is None:

@@ -66,6 +66,7 @@ from container_engine_accelerators_tpu.fleet.xferd import (  # noqa: E402
     PyXferd,
 )
 from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    profiler,
     timeseries,
     trace,
 )
@@ -158,6 +159,17 @@ def parse_args(argv=None):
                         "there, so best-of-N measures the CONVERGED "
                         "plane (the static cells get no probes to "
                         "pay, so this is the like-for-like framing)")
+    p.add_argument("--prof-overhead-gate", action="store_true",
+                   help="run ONLY the profiler-overhead comparison: "
+                        "paired pipelined transfers at the largest "
+                        "size with the sampler off and on "
+                        "(TPU_PROF_HZ default rate); exit 1 when the "
+                        "sampled series' best throughput falls more "
+                        "than --prof-max-overhead below the unsampled "
+                        "one (the `make prof` gate)")
+    p.add_argument("--prof-max-overhead", type=float, default=0.05,
+                   help="the continuous profiler's throughput budget "
+                        "on the pipelined lane (default 0.05 = 5%%)")
     return p.parse_args(argv)
 
 
@@ -338,7 +350,8 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
     exposed = {}
     try:
         print(f"{'bytes':>9} {'mode':>10} {'best_ms':>9} {'med_ms':>9} "
-              f"{'best_MB/s':>10} {'exposed':>8} {'%memcpy':>8}",
+              f"{'best_MB/s':>10} {'exposed':>8} {'%memcpy':>8} "
+              f"{'hot':>14}",
               file=table)
         for size in sizes:
             base = bytes(range(256)) * (size // 256) \
@@ -361,12 +374,27 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
                         for w in range(tune_warmup):
                             rig.one_way(mode, rotated(w + 1),
                                         mode_cfg, state)
+                    # Per-cell CPU attribution: the profiler's
+                    # subsystem counts before/after the cell's TIMED
+                    # iterations — which code (staging memcpy vs
+                    # socket IO vs ring poll) burned this cell's
+                    # cycles.  Snapshot AFTER the tuned warmup, so
+                    # probe rounds never pollute the converged
+                    # plane's attribution.
+                    prof0 = profiler.snapshot(top=0)["subsystems"]
                     runs = [rig.one_way(mode, rotated(i), mode_cfg,
                                         state)
                             for i in range(iters)]
                 finally:
                     if state is not None:
                         rig.close_flow(state)
+                shares = profiler.subsystem_shares(baseline=prof0)
+                cpu_attr = ({k: round(v, 3)
+                             for k, v in sorted(shares.items(),
+                                                key=lambda kv:
+                                                -kv[1])}
+                            if shares else None)
+                hot = next(iter(cpu_attr), None) if cpu_attr else None
                 times = [r["elapsed_s"] for r in runs]
                 ratios = [r["exposed_ratio"] for r in runs
                           if r["exposed_ratio"] is not None]
@@ -397,6 +425,7 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
                     "mbps": round(mbps, 2),
                     "exposed_ratio": exp_ratio,
                     "pct_of_memcpy": pct,
+                    "cpu_attr": cpu_attr,
                     "chunk_bytes": cfg.chunk_bytes,
                     "stripes": cfg.stripes,
                     "ts": round(time.time(), 3),
@@ -406,9 +435,12 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
                 exp_txt = ("-" if exp_ratio is None
                            else f"{exp_ratio:.2f}")
                 pct_txt = "-" if pct is None else f"{pct:.1f}%"
+                hot_txt = ("-" if hot is None
+                           else f"{hot} {cpu_attr[hot] * 100:.0f}%")
                 print(f"{size:>9} {mode:>10} {best * 1e3:>9.1f} "
                       f"{med * 1e3:>9.1f} {mbps:>10.1f} "
-                      f"{exp_txt:>8} {pct_txt:>8}", file=table)
+                      f"{exp_txt:>8} {pct_txt:>8} {hot_txt:>14}",
+                      file=table)
     finally:
         if own_rig:
             rig.close()
@@ -496,6 +528,82 @@ def run_static_grid(rig, size, iters, grid, base_cfg, sink,
     return out, tuned_mbps
 
 
+def run_prof_overhead_gate(rig, size, iters, cfg, max_overhead,
+                           table=sys.stderr):
+    """The `make prof` overhead gate: paired pipelined transfers at
+    one size, alternating sampler-off / sampler-on every iteration so
+    environment drift hits both series equally (the run_static_grid
+    discipline).  Best-of-N throughput with the sampler ON must stay
+    within ``max_overhead`` of OFF — the always-on profiler must be
+    observably free on the hot path, not assumed free.  The sampler's
+    own cumulative accounting (``prof.overhead_ratio``) is printed
+    beside the verdict and gated under the same budget."""
+    base = bytes(range(256)) * (size // 256) + b"\x7f" * (size % 256)
+
+    def rotated(i):
+        k = (i * 977) % size if size else 0
+        return base[k:] + base[:k] if k else base
+
+    cfg_socket = dcn_pipeline.PipelineConfig(
+        chunk_bytes=cfg.chunk_bytes, stripes=cfg.stripes, shm=False,
+        tuned=False, shm_direct=False)
+    def measure():
+        state = rig.open_flow("pipelined", size)
+        off_times, on_times = [], []
+        try:
+            # Untimed warmups: the first transfers on a fresh flow
+            # pay cold-start costs (mmap faults, allocator growth,
+            # TCP window ramp) neither series should carry.
+            for w in range(3):
+                rig.one_way("pipelined", rotated(w), cfg_socket,
+                            state)
+            for i in range(iters):
+                profiler.stop()
+                off_times.append(rig.one_way(
+                    "pipelined", rotated(2 * i + 3), cfg_socket,
+                    state)["elapsed_s"])
+                profiler.start()
+                on_times.append(rig.one_way(
+                    "pipelined", rotated(2 * i + 4), cfg_socket,
+                    state)["elapsed_s"])
+        finally:
+            rig.close_flow(state)
+        best_over = min(on_times) / min(off_times) - 1.0
+        med_over = (statistics.median(on_times)
+                    / statistics.median(off_times) - 1.0)
+        print(f"profiler overhead @ {size} bytes ({iters} paired): "
+              f"best {min(off_times) * 1e3:.1f} -> "
+              f"{min(on_times) * 1e3:.1f} ms "
+              f"({best_over * 100:+.2f}%), median "
+              f"{statistics.median(off_times) * 1e3:.1f} -> "
+              f"{statistics.median(on_times) * 1e3:.1f} ms "
+              f"({med_over * 100:+.2f}%), budget "
+              f"{max_overhead * 100:.0f}%", file=table)
+        # A real sampler regression shifts the whole distribution;
+        # one noisy draw shifts a single statistic.  Breach = best
+        # AND median both over budget.
+        return best_over > max_overhead and med_over > max_overhead
+
+    rc = 0
+    # Breach must REPRODUCE (one retry, the scrape discipline): a
+    # loaded builder's one noisy window cannot fail CI; a sampler
+    # that genuinely costs > budget breaches every window.
+    if measure() and measure():
+        print(f"FAIL: sampler throughput cost over the "
+              f"{max_overhead * 100:.0f}% budget in both paired "
+              f"windows", file=table)
+        rc = 1
+    self_ratio = profiler.snapshot(top=0)["overhead_ratio"]
+    print(f"sampler self-accounting: "
+          f"{(self_ratio or 0.0) * 100:.3f}% of wall time",
+          file=table)
+    if self_ratio is not None and self_ratio > max_overhead:
+        print(f"FAIL: prof.overhead_ratio {self_ratio:.4f} over the "
+              f"{max_overhead:.2f} budget", file=table)
+        rc = 1
+    return rc
+
+
 def main(argv=None):
     args = parse_args(argv)
     sizes = sorted({int(s) for s in args.sizes.split(",") if s})
@@ -508,6 +616,21 @@ def main(argv=None):
     # Fresh controller state per bench run: a prior run's learned grid
     # must not flatter (or sandbag) this one's tuned series.
     dcn_tune.reset()
+    if args.prof_overhead_gate:
+        if not profiler.enabled():
+            print("TPU_PROF=0: profiler disabled; overhead gate is "
+                  "vacuous", file=sys.stderr)
+            return 0
+        rig = BenchRig()
+        try:
+            return run_prof_overhead_gate(
+                rig, sizes[-1], max(1, args.iters), cfg,
+                args.prof_max_overhead)
+        finally:
+            rig.close()
+    # Always-on CPU attribution for the sweep (TPU_PROF=0 disables):
+    # every JSONL cell carries its per-subsystem sample shares.
+    profiler.start()
     out = open(args.out, "a") if args.out else sys.stdout
     largest = sizes[-1]
     grid_best = None

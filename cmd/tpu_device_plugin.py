@@ -19,7 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from container_engine_accelerators_tpu.deviceplugin.api import DEVICE_PLUGIN_PATH
 from container_engine_accelerators_tpu.deviceplugin.manager import TpuManager
 from container_engine_accelerators_tpu.health import TpuHealthChecker
-from container_engine_accelerators_tpu.obs import flight
+from container_engine_accelerators_tpu.obs import flight, profiler
 from container_engine_accelerators_tpu.tpulib import open_lib
 from container_engine_accelerators_tpu.utils.config import TPUConfig
 from container_engine_accelerators_tpu.utils.device import Mount
@@ -91,6 +91,10 @@ def main(argv=None):
     # `kill -USR1 <pid>` dumps the last spans + counter snapshot to
     # stderr (and TPU_FLIGHT_FILE) without disturbing the agent.
     flight.install()
+    # Always-on continuous profiler at the low default rate: the
+    # flight dumps and the /profile scrape (when metrics are enabled)
+    # read it.  TPU_PROF=0 disables.
+    profiler.start()
 
     config = TPUConfig.from_file(args.tpu_config)
     config.add_defaults_and_validate()

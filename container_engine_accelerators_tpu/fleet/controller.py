@@ -68,7 +68,12 @@ from container_engine_accelerators_tpu.fleet.topology import (
     build_specs,
 )
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import critpath, histo, trace
+from container_engine_accelerators_tpu.obs import (
+    critpath,
+    histo,
+    profiler,
+    trace,
+)
 from container_engine_accelerators_tpu.parallel import (
     dcn,
     dcn_pipeline,
@@ -251,6 +256,7 @@ class FleetController:
         self._booted = False
         self._counters0: Dict[str, int] = {}
         self.telemetry: Optional[FleetTelemetry] = None
+        self._prof_started = False
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -288,6 +294,14 @@ class FleetController:
         except Exception:
             self.close()  # no orphan workers on a half-booted fleet
             raise
+        # CPU attribution for the run: workers sample themselves
+        # (fleet/proc.py starts the profiler in every worker); the
+        # coordinator — where the transfer clients and the serving
+        # frontend live in BOTH modes — samples here.  Only stop at
+        # close() what this controller itself started: a bench or
+        # test that armed the profiler first keeps it.
+        if not profiler.running():
+            self._prof_started = profiler.start()
         self._counters0 = counters.snapshot()
         self.telemetry = FleetTelemetry(
             self.nodes, self.links, self.scenario.get("slo"),
@@ -311,6 +325,9 @@ class FleetController:
             self.frontend = None
         for node in self.nodes.values():
             node.close()
+        if self._prof_started:
+            profiler.stop()
+            self._prof_started = False
 
     # -- fault schedule ------------------------------------------------------
 
@@ -671,6 +688,11 @@ class FleetController:
             "agent_events_delta": delta,
             "agent_latency": latency,
             "critical_path": critical_path,
+            # Where did the CPU go: merged continuous-profiler stacks
+            # (per worker via /profile scrapes in proc mode, plus the
+            # coordinator's own sampler) — the companion question to
+            # critical_path's "where did the wall time go".
+            "profile": self.telemetry.profile_report(),
             "telemetry": {"rounds": self.telemetry.history},
             "slo": self.telemetry.evaluate(links_report),
             "converged": (survivors_converged and all_up_healthy

@@ -546,7 +546,7 @@ def worker_main() -> int:
     """Entry point for one node worker process."""
     from container_engine_accelerators_tpu.fleet.node import EmulatedNode
     from container_engine_accelerators_tpu.fleet.topology import NodeSpec
-    from container_engine_accelerators_tpu.obs import flight
+    from container_engine_accelerators_tpu.obs import flight, profiler
 
     if os.environ.get(HANG_ENV):
         time.sleep(3600)  # test hook: a worker that never handshakes
@@ -566,6 +566,10 @@ def worker_main() -> int:
 
     signal.signal(signal.SIGTERM, _sigterm)
     flight.install()  # SIGUSR1 on-demand dumps, as on a real agent
+    # Always-on continuous profiler at the low default rate: the
+    # worker's /profile endpoint (scraped by the fleet aggregator) and
+    # the flight dumps above both read it.  TPU_PROF=0 disables.
+    profiler.start()
     with trace.attach_from_env():
         spec = NodeSpec(
             name=blob["name"], rack=blob.get("rack", "r0"),

@@ -54,6 +54,16 @@ module adds the quality verdict:
   (``MAX_COLLECTED_SPANS``); overflow drops oldest and is counted,
   never hidden.
 
+- **profile**: each round the aggregator also pages every worker's
+  ``/profile`` endpoint (the continuous profiler, obs/profiler.py)
+  under the same timeout/one-retry/stale rules
+  (``fleet.scrape.profile_stale``), merging cumulative folded-stack
+  counts restart-aware — a respawned worker's samples restart at
+  zero, so the merge sums increments keyed by incarnation, exactly
+  like the counter accumulator.  :meth:`FleetTelemetry.profile_report`
+  renders the merged result (per-node and fleet-wide top-N stacks +
+  subsystem rollups) into the report's ``profile`` section.
+
 The controller folds :meth:`FleetTelemetry.evaluate`'s result into the
 report's ``slo`` section and ``cmd/fleet_sim.py`` exits non-zero on
 breach — a fleet that converges while violating its goodput floor
@@ -70,6 +80,7 @@ from typing import Dict, List, Optional, Tuple
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import (
     histo,
+    profiler,
     promtext,
     timeseries,
     trace,
@@ -87,6 +98,13 @@ DEFAULT_SCRAPE_TIMEOUT_S = 1.0
 # drop first; the count dropped is reported, never hidden).
 SPANS_SCRAPE_LIMIT = 2048
 MAX_COLLECTED_SPANS = 20000
+
+# Profile scrape page size: the worker registry is LRU-bounded at
+# profiler.MAX_STACKS, so one page at this limit is always complete.
+PROFILE_SCRAPE_LIMIT = profiler.SCRAPE_MAX_LIMIT
+# Top-N folded stacks the report's profile section keeps per node and
+# fleet-wide (agent_prof renders more detail from a live scrape).
+PROFILE_REPORT_TOP_N = 20
 
 # SLO key -> (kind, description).  Ceilings fail when value > limit,
 # floors when value < limit.
@@ -226,6 +244,44 @@ def scrape_spans(port: int, since: int,
     return spans, cursor, dropped
 
 
+def scrape_profile(port: int, since: int,
+                   timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S,
+                   host: str = "127.0.0.1",
+                   limit: int = PROFILE_SCRAPE_LIMIT):
+    """One GET of a node's ``/profile?since=<cursor>``: returns
+    ``(stacks, next_cursor, samples, dropped, subsystems)``.  Raises
+    :class:`ScrapeError` on transport/parse trouble — callers apply
+    the same stale discipline as metric and span scrapes."""
+    url = (f"http://{host}:{int(port)}/profile?since={int(since)}"
+           f"&limit={int(limit)}")
+    try:
+        obj = profiler.fetch(url, timeout_s)
+        stacks_raw = obj.get("stacks")
+        subs_raw = obj.get("subsystems") or {}
+        if not isinstance(stacks_raw, list) \
+                or not isinstance(subs_raw, dict):
+            raise ValueError("malformed /profile body")
+        # Normalize every numeric field HERE, inside the degradation
+        # boundary: a port reused by some other process (a SIGKILLed
+        # worker's successor) can answer JSON that passes the shape
+        # check with garbage counts — that must cost a counted stale
+        # miss, never an exception out of the round loop.
+        stacks = [{"stack": str(e["stack"]),
+                   "subsystem": str(e.get("subsystem", "other")),
+                   "count": float(e.get("count") or 0)}
+                  for e in stacks_raw
+                  if isinstance(e, dict) and "stack" in e]
+        subsystems = {str(k): float(v or 0)
+                      for k, v in subs_raw.items()}
+        cursor = int(obj.get("cursor", since))
+        samples = float(obj.get("samples") or 0)
+        dropped = float(obj.get("dropped") or 0)
+    except (urllib.error.URLError, OSError, ValueError,
+            TypeError, KeyError) as e:
+        raise ScrapeError(f"profile scrape of {url} failed: {e}") from e
+    return stacks, cursor, samples, dropped, subsystems
+
+
 class FleetTelemetry:
     """Scrapes the fleet's telemetry each round and renders the SLO
     verdict at the end of the run.
@@ -276,6 +332,19 @@ class FleetTelemetry:
         self._spans_dropped = 0
         self._local_cursor = 0
         self._span_cursors: Dict[str, int] = {}
+        # Continuous-profiler collection (the report's ``profile``
+        # section): per-node merged folded stacks, accumulated
+        # restart-aware like the counters — a worker's cumulative
+        # stack counts restart at zero on respawn, so the merge sums
+        # increments keyed by incarnation.  Scraped per round so a
+        # SIGKILL costs at most one round of samples, never the run's.
+        self._prof: Dict[str, dict] = {}
+        self._prof_cursors: Dict[str, int] = {}
+        # The coordinator's own profiler registry is cumulative for
+        # the process (like the histograms), so the report's
+        # coordinator entry judges THIS run only: snapshot at boot,
+        # delta at report time.
+        self._prof0 = profiler.snapshot()
 
     # -- per-round scrape ----------------------------------------------------
 
@@ -383,6 +452,176 @@ class FleetTelemetry:
     def spans_dropped(self) -> int:
         return self._spans_dropped
 
+    # -- profile collection (the report's ``profile`` section) ---------------
+
+    def _scrape_node_profile(self, name: str, node) -> bool:
+        """One worker's /profile page, same timeout/stale discipline
+        as the metric and span scrapes (one attempt + one retry,
+        degrade to a counted miss — never a hang, never a raise).
+        The cursor is respawn-aware like the span cursor: a fresh
+        incarnation's sample sequence restarts at 0, so a generation
+        change resets the cursor instead of silently skipping
+        everything the new process sampled."""
+        gen = getattr(getattr(node, "daemon", None), "generation",
+                      None)
+        key = "_gen_" + name
+        if gen is not None and self._prof_cursors.get(key) != gen:
+            self._prof_cursors[name] = 0
+            self._prof_cursors[key] = gen
+        last: Optional[ScrapeError] = None
+        for _attempt in range(2):
+            try:
+                stacks, cursor, samples, dropped, subsystems = \
+                    scrape_profile(node.metrics_port,
+                                   self._prof_cursors.get(name, 0),
+                                   self.scrape_timeout_s)
+                self._prof_cursors[name] = cursor
+                self._merge_profile(name, stacks, samples, dropped,
+                                    subsystems, gen)
+                return True
+            except ScrapeError as e:
+                last = e
+        counters.inc("fleet.scrape.profile_stale")
+        log.warning("node %s profile scrape degraded to stale: %s",
+                    name, last)
+        return False
+
+    def _merge_profile(self, name: str, stacks: List[dict],
+                       samples: float, dropped: float,
+                       subsystems: Dict[str, float],
+                       gen: Optional[int] = None) -> None:
+        """Fold one scraped /profile page into ``name``'s merged
+        profile, restart-aware: every scraped count is cumulative for
+        the worker's life, so the merge adds increments against the
+        last-seen value — a generation change means a fresh process
+        (everything it shows is new increment), and a same-incarnation
+        decrease is a misread to drop, exactly like `_accumulate`."""
+        st = self._prof.setdefault(name, {
+            "stacks": {}, "subsystems": {}, "samples": 0.0,
+            "dropped": 0.0, "_last": {}, "_gen": None,
+        })
+        if gen is not None and gen != st["_gen"]:
+            st["_last"] = {}
+            st["_gen"] = gen
+
+        def fold(key, current, bump, decrease="drop"):
+            current = float(current)
+            last = st["_last"].get(key, 0.0)
+            if current < last:
+                if gen is not None and decrease == "drop":
+                    return  # same incarnation: a misread, drop it
+                # Fresh accumulation: no gen evidence means a fresh
+                # process; decrease="fresh" means the worker's LRU
+                # legitimately evicted and re-admitted this stack
+                # (its pre-eviction samples are already merged, and
+                # the evicted remainder was counted in `dropped`).
+                delta = current
+            else:
+                delta = current - last
+            st["_last"][key] = current
+            bump(delta)
+
+        fold(("total", "samples"), samples,
+             lambda d: st.__setitem__("samples", st["samples"] + d))
+        fold(("total", "dropped"), dropped,
+             lambda d: st.__setitem__("dropped", st["dropped"] + d))
+        for sub, count in subsystems.items():
+            fold(("sub", sub), count,
+                 lambda d, s=sub: st["subsystems"].__setitem__(
+                     s, st["subsystems"].get(s, 0.0) + d))
+        for entry in stacks:
+            stack = entry.get("stack")
+            if not isinstance(stack, str):
+                continue
+            sub = str(entry.get("subsystem", "other"))
+            # decrease="fresh": the worker profiler never resets its
+            # registry mid-life, so a same-incarnation PER-STACK
+            # decrease can only be LRU eviction + re-admission — the
+            # new count is new accumulation, not a misread.  (The
+            # totals and subsystem counters above are monotonic for
+            # the worker's life, so a decrease there stays a misread.)
+            fold(("stack", stack), entry.get("count", 0),
+                 lambda d, s=stack, m=sub: st["stacks"].__setitem__(
+                     s, {"subsystem": m,
+                         "count": st["stacks"].get(
+                             s, {"count": 0.0})["count"] + d}),
+                 decrease="fresh")
+
+    def profile_report(self,
+                       top_n: int = PROFILE_REPORT_TOP_N) -> dict:
+        """The report's ``profile`` section: per-node merged folded
+        stacks (scraped workers plus this process's own profiler when
+        it sampled anything — the coordinator runs the transfer
+        clients in both fleet modes) and the fleet-wide aggregate,
+        each with a subsystem rollup and the top-N stacks."""
+
+        def top(stacks: Dict[str, dict], n: int) -> List[dict]:
+            rows = sorted(stacks.items(),
+                          key=lambda kv: (-kv[1]["count"], kv[0]))
+            return [{"stack": s, "subsystem": m["subsystem"],
+                     "count": int(m["count"])}
+                    for s, m in rows[:n] if m["count"] > 0]
+
+        merged = {
+            name: {"stacks": dict(st["stacks"]),
+                   "subsystems": dict(st["subsystems"]),
+                   "samples": st["samples"], "dropped": st["dropped"]}
+            for name, st in self._prof.items()
+        }
+        local = profiler.snapshot()
+        base_stacks = {e["stack"]: e["count"]
+                       for e in self._prof0["stacks"]}
+        base_subs = self._prof0["subsystems"]
+        local_samples = local["samples"] - self._prof0["samples"]
+        if local_samples > 0:
+            stacks = {}
+            for e in local["stacks"]:
+                d = e["count"] - base_stacks.get(e["stack"], 0)
+                if d > 0:
+                    stacks[e["stack"]] = {"subsystem": e["subsystem"],
+                                          "count": float(d)}
+            merged["coordinator"] = {
+                "stacks": stacks,
+                "subsystems": {
+                    k: float(v - base_subs.get(k, 0))
+                    for k, v in local["subsystems"].items()
+                    if v - base_subs.get(k, 0) > 0},
+                "samples": float(local_samples),
+                "dropped": float(max(0, local["dropped"]
+                                     - self._prof0["dropped"])),
+            }
+        nodes = {}
+        fleet_stacks: Dict[str, dict] = {}
+        fleet_subs: Dict[str, float] = {}
+        total = dropped = 0.0
+        for name, st in merged.items():
+            nodes[name] = {
+                "samples": int(st["samples"]),
+                "dropped": int(st["dropped"]),
+                "subsystems": {k: int(v)
+                               for k, v in st["subsystems"].items()
+                               if v > 0},
+                "top": top(st["stacks"], top_n),
+            }
+            for stack, m in st["stacks"].items():
+                f = fleet_stacks.setdefault(
+                    stack, {"subsystem": m["subsystem"], "count": 0.0})
+                f["count"] += m["count"]
+            for sub, v in st["subsystems"].items():
+                fleet_subs[sub] = fleet_subs.get(sub, 0.0) + v
+            total += st["samples"]
+            dropped += st["dropped"]
+        return {
+            "nodes": nodes,
+            "fleet": {
+                "samples": int(total),
+                "dropped": int(dropped),
+                "subsystems": {k: int(v) for k, v in fleet_subs.items()
+                               if v > 0},
+                "top": top(fleet_stacks, top_n),
+            },
+        }
+
     # -- HTTP scrape path (process-mode fleets) ------------------------------
 
     def _scrape_entry(self, name: str, node) -> dict:
@@ -422,6 +661,7 @@ class FleetTelemetry:
             "down": False,
             "stale": False,
             "spans_stale": not self._scrape_node_spans(name, node),
+            "profile_stale": not self._scrape_node_profile(name, node),
             "active_flows": int(s.value("agent_gauge",
                                         name="xferd.active_flows")),
             "transferred": int(s.value("agent_gauge",

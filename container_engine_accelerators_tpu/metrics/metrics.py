@@ -49,6 +49,13 @@ spans the ring evicted before they were read (the reader fell behind).
 This is how the process-mode fleet aggregator collects every worker's
 spans for the report's ``critical_path`` section without touching the
 worker's disk — metrics and traces ride one scrape surface.
+
+``GET /profile?since=<cursor>`` serves the continuous profiler's
+folded-stack aggregate (obs/profiler.py) under the same cursor/bounded
+JSON discipline: cumulative totals plus the stacks that changed after
+the cursor — the third surface on the same listener, and how the
+fleet aggregator merges per-worker CPU attribution into
+``report.profile``.
 """
 
 import json as _json
@@ -78,7 +85,12 @@ from container_engine_accelerators_tpu.metrics.devices import (
     PodResourcesClient,
     TPU_RESOURCE_NAME,
 )
-from container_engine_accelerators_tpu.obs import histo, timeseries, trace
+from container_engine_accelerators_tpu.obs import (
+    histo,
+    profiler,
+    timeseries,
+    trace,
+)
 from container_engine_accelerators_tpu.tpulib.types import HbmInfo, TpuLib
 from container_engine_accelerators_tpu.utils.retry import RetryPolicy
 
@@ -98,6 +110,11 @@ BIND_RETRY = RetryPolicy(
 # a scraper that never passes `limit` still gets a bounded body.
 SPANS_DEFAULT_LIMIT = 512
 SPANS_MAX_LIMIT = 2048
+
+# /profile response bounds (same discipline): top-N folded stacks per
+# GET, hard-capped — the registry itself is already LRU-bounded.
+PROFILE_DEFAULT_LIMIT = profiler.SCRAPE_DEFAULT_LIMIT
+PROFILE_MAX_LIMIT = profiler.SCRAPE_MAX_LIMIT
 
 _CONTAINER_LABELS = [
     "namespace",
@@ -234,12 +251,15 @@ class MetricServer:
 
     def _wsgi_app(self):
         """The server's one WSGI app: ``/spans`` (bounded JSON from the
-        span ring, cursor-paged) beside the prometheus exposition at
-        every other path — one listener, one port, both surfaces."""
+        span ring, cursor-paged) and ``/profile`` (the continuous
+        profiler's folded stacks, same cursor discipline) beside the
+        prometheus exposition at every other path — one listener, one
+        port, every surface."""
         metrics_app = _make_wsgi_app(self.registry)
 
         def app(environ, start_response):
-            if environ.get("PATH_INFO", "") != "/spans":
+            path = environ.get("PATH_INFO", "")
+            if path not in ("/spans", "/profile"):
                 return metrics_app(environ, start_response)
             qs = urllib.parse.parse_qs(environ.get("QUERY_STRING", ""))
 
@@ -250,14 +270,21 @@ class MetricServer:
                     return default  # malformed query degrades, 500s not
 
             since = qint("since", 0)
-            limit = min(max(1, qint("limit", SPANS_DEFAULT_LIMIT)),
-                        SPANS_MAX_LIMIT)
-            spans, cursor, dropped = trace.tail_since(since, limit)
-            body = _json.dumps({
-                "cursor": cursor,
-                "dropped": dropped,
-                "spans": spans,
-            }).encode()
+            if path == "/spans":
+                limit = min(max(1, qint("limit", SPANS_DEFAULT_LIMIT)),
+                            SPANS_MAX_LIMIT)
+                spans, cursor, dropped = trace.tail_since(since, limit)
+                payload = {
+                    "cursor": cursor,
+                    "dropped": dropped,
+                    "spans": spans,
+                }
+            else:
+                limit = min(max(1, qint("limit",
+                                        PROFILE_DEFAULT_LIMIT)),
+                            PROFILE_MAX_LIMIT)
+                payload = profiler.scrape(since=since, limit=limit)
+            body = _json.dumps(payload).encode()
             start_response("200 OK", [
                 ("Content-Type", "application/json"),
                 ("Content-Length", str(len(body))),

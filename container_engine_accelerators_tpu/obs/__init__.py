@@ -16,6 +16,8 @@ contract with a blocked-import subprocess.
 - ``obs.timeseries``  windowed ring-bucket rates + explicit gauges
                       (goodput, in-flight, SLO status)
 - ``obs.flight``      flight recorder: SIGUSR1 / terminal-failure dumps
+- ``obs.profiler``    continuous stack-sampling profiler: folded
+                      stacks keyed by subsystem, ``/profile`` scrape
 - ``obs.promtext``    the one Prometheus text-exposition parser every
                       scrape surface (agent_top, fleet telemetry) uses
 """
@@ -24,10 +26,11 @@ from container_engine_accelerators_tpu.obs import (
     critpath,
     flight,
     histo,
+    profiler,
     promtext,
     timeseries,
     trace,
 )
 
-__all__ = ["critpath", "flight", "histo", "promtext", "timeseries",
-           "trace"]
+__all__ = ["critpath", "flight", "histo", "profiler", "promtext",
+           "timeseries", "trace"]

@@ -12,6 +12,9 @@ it emits ONE self-contained JSON blob holding
 - the windowed-rate/gauge snapshot (obs/timeseries.py) and the SLO
   verdict gauges (``slo.*``) — what the node was *doing* when it died,
   not just its lifetime totals,
+- the continuous profiler's top folded stacks (obs/profiler.py) —
+  where every thread was stuck or spinning, so a hung worker's
+  postmortem shows the code, not just the open spans,
 
 to stderr (always — `kubectl logs` is the collection path that needs no
 infrastructure) and appended to ``TPU_FLIGHT_FILE`` when set.
@@ -37,7 +40,12 @@ import time
 from typing import Optional
 
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import histo, timeseries, trace
+from container_engine_accelerators_tpu.obs import (
+    histo,
+    profiler,
+    timeseries,
+    trace,
+)
 
 log = logging.getLogger(__name__)
 
@@ -65,6 +73,11 @@ def snapshot(reason: str) -> dict:
         "rates": rates,
         "slo": {name: value for name, value in rates["gauges"].items()
                 if name.startswith("slo.")},
+        # Where every thread was STUCK, not just which spans were
+        # open: the continuous profiler's top folded stacks — a hung
+        # worker's postmortem names the code burning (or parking) its
+        # threads.
+        "profile": profiler.summary(),
     }
 
 

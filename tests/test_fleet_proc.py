@@ -38,6 +38,7 @@ from container_engine_accelerators_tpu.fleet.telemetry import (
     ScrapeError,
     parse_prometheus_text,
     scrape_metric_server,
+    scrape_profile,
 )
 from container_engine_accelerators_tpu.fleet.topology import NodeSpec
 from container_engine_accelerators_tpu.metrics import counters
@@ -357,6 +358,109 @@ class TestScrapeResilience:
         t._accumulate("n0", "frames", 14.0, gen=1)  # +4, not +14
         assert t._accum_total("frames") == pytest.approx(14.0)
 
+    def test_unreachable_profile_scrape_degrades_to_counted_miss(
+            self):
+        """A /profile scrape against a dead port: timeout + one
+        retry, then a counted stale verdict — never a hang, never a
+        raise (the /spans discipline, third surface)."""
+        dead = free_port()
+        with pytest.raises(ScrapeError):
+            scrape_profile(dead, 0, timeout_s=0.5)
+        t = FleetTelemetry({}, None, None, scrape=True,
+                           scrape_timeout_s=0.5)
+        p0 = counters.get("fleet.scrape.profile_stale")
+        assert t._scrape_node_profile("nx", _FakeNode(dead)) is False
+        assert counters.get("fleet.scrape.profile_stale") == p0 + 1
+        assert t.profile_report()["nodes"].get("nx") is None
+
+    def test_garbage_profile_body_degrades_to_counted_miss(self):
+        """A reused port can answer JSON that passes a shallow shape
+        check with garbage counts (a SIGKILLed worker's successor);
+        numeric normalization lives inside the ScrapeError boundary,
+        so the round gets a counted stale miss — never an exception
+        out of the round loop."""
+        import http.server
+        import threading
+
+        body = json.dumps({
+            "cursor": 5, "samples": "many", "dropped": 0,
+            "subsystems": {"xferd": "hi"},
+            "stacks": [{"stack": "a.b", "count": "x"}],
+        }).encode()
+
+        class _Garbage(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), _Garbage)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            with pytest.raises(ScrapeError, match="profile scrape"):
+                scrape_profile(srv.server_address[1], 0, timeout_s=2.0)
+            tele = FleetTelemetry({}, None, None, scrape=True,
+                                  scrape_timeout_s=2.0)
+            p0 = counters.get("fleet.scrape.profile_stale")
+            assert tele._scrape_node_profile(
+                "ng", _FakeNode(srv.server_address[1])) is False
+            assert counters.get("fleet.scrape.profile_stale") == p0 + 1
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            t.join(timeout=5)
+
+    def test_profile_merge_is_restart_aware(self):
+        """Worker stack counts are cumulative and reset to zero on
+        respawn; the merge sums increments keyed by incarnation — a
+        respawned worker that climbed past the dead one's last value
+        still contributes its full fresh count."""
+        t = FleetTelemetry({}, None, None, scrape=True)
+        stack = [{"stack": "a.b;c.d", "subsystem": "xferd",
+                  "count": 10}]
+        t._merge_profile("n0", stack, 10, 0, {"xferd": 10}, gen=1)
+        stack[0]["count"] = 14
+        t._merge_profile("n0", stack, 14, 0, {"xferd": 14}, gen=1)
+        # Respawn: counts restart, already past the old value.
+        stack[0]["count"] = 20
+        t._merge_profile("n0", stack, 20, 0, {"xferd": 20}, gen=2)
+        node = t.profile_report()["nodes"]["n0"]
+        assert node["samples"] == 34  # 10 + 4 + 20
+        assert node["subsystems"]["xferd"] == 34
+        assert node["top"][0]["count"] == 34
+
+    def test_profile_merge_same_gen_decrease_semantics(self):
+        """Same-incarnation decreases split by what they can mean:
+        the worker's TOTALS are monotonic for its life, so a decrease
+        there is a misread and is dropped; a PER-STACK decrease is
+        the worker's LRU legitimately evicting and re-admitting the
+        stack (pre-eviction samples already merged, remainder counted
+        dropped) — the fresh count is NEW accumulation, not a
+        misread, so it merges additively."""
+        t = FleetTelemetry({}, None, None, scrape=True)
+        t._merge_profile("n0", [{"stack": "s.s", "subsystem": "other",
+                                 "count": 10}], 10, 0, {"other": 10},
+                         gen=1)
+        # Stack evicted worker-side, re-admitted with count 2; the
+        # worker's samples total shows a (raced) decrease too.
+        t._merge_profile("n0", [{"stack": "s.s", "subsystem": "other",
+                                 "count": 2}], 2, 0, {"other": 2},
+                         gen=1)
+        t._merge_profile("n0", [{"stack": "s.s", "subsystem": "other",
+                                 "count": 5}], 14, 0, {"other": 14},
+                         gen=1)
+        node = t.profile_report()["nodes"]["n0"]
+        assert node["samples"] == 14        # 10 + dropped + 4
+        assert node["subsystems"]["other"] == 14
+        assert node["top"][0]["count"] == 15  # 10 + 2 + 3: re-admitted
+        # counts pile on top of the pre-eviction merge — a hot-but-
+        # churned stack keeps its history instead of going dark.
+
     def test_label_value_unescape_is_single_pass(self):
         """`\\\\n` in the exposition is an escaped backslash followed by
         a literal n — sequential replaces would corrupt it into a
@@ -424,6 +528,22 @@ class TestProcScenarioSmoke:
         assert slo["ok"], slo
         assert slo["measured"]["min_goodput_bps"] > 0
         assert slo["measured"]["stale_entries_skipped"] >= 1
+
+        # The merged continuous-profiler section (ISSUE 14): every
+        # live-scraped worker contributes folded stacks, the fleet
+        # aggregate merges them, and the per-round stale discipline
+        # covers /profile exactly like /metrics and /spans — live
+        # entries carry profile_stale verdicts, dark rounds are the
+        # whole-entry stale already asserted above.
+        prof = report["profile"]
+        assert prof["fleet"]["samples"] > 0
+        assert prof["fleet"]["top"], prof["fleet"]
+        assert prof["fleet"]["subsystems"]
+        assert {"n0", "n1", "n2"} <= set(prof["nodes"])
+        assert all(e["samples"] > 0 for name, e in
+                   prof["nodes"].items() if name.startswith("n"))
+        assert any(not s["nodes"]["n0"].get("profile_stale", True)
+                   for s in rounds)
 
 
 # ---------------------------------------------------------------------------
@@ -671,6 +791,44 @@ class TestProcScenarios:
         finally:
             a.close()
             b.close()
+
+    def test_profile_scrape_sigkill_stale_then_respawn_resumes(
+            self, tmp_path):
+        """ISSUE 14 satellite: SIGKILL a worker mid-round — the
+        round's profile scrape degrades to a stale verdict (never a
+        hang or raise), and after the supervised respawn the merge
+        resumes restart-aware: the fresh incarnation's samples (its
+        cursor restarted at 0) ADD to the dead one's merged total."""
+        a = _node(tmp_path, "np")
+        t = FleetTelemetry({"np": a}, None, None, scrape=True,
+                           scrape_timeout_s=2.0)
+        try:
+            time.sleep(0.6)  # let the worker's sampler collect
+            sample = t.sample_round(0)
+            assert sample["nodes"]["np"]["profile_stale"] is False
+            before = t.profile_report()["nodes"]["np"]["samples"]
+            assert before > 0
+
+            a.kill_daemon()
+            p0 = counters.get("fleet.scrape.profile_stale")
+            sample = t.sample_round(1)
+            # The whole entry is stale — the kill was mid-scenario —
+            # and nothing hung or raised to get there.
+            assert sample["nodes"]["np"]["stale"] is True
+            mid = t.profile_report()["nodes"]["np"]["samples"]
+            assert mid == before  # dark round adds nothing
+
+            a.restart_daemon()
+            time.sleep(0.6)  # fresh incarnation samples itself
+            sample = t.sample_round(2)
+            assert sample["nodes"]["np"]["profile_stale"] is False
+            after = t.profile_report()["nodes"]["np"]["samples"]
+            # Restart-aware resume: the fresh process's samples pile
+            # ON TOP of the dead incarnation's merged total.
+            assert after > mid
+            assert counters.get("fleet.scrape.profile_stale") == p0
+        finally:
+            a.close()
 
     def test_sigterm_dumps_flight_recorder_before_exit(self, tmp_path):
         """Satellite: the supervisor's SIGTERM makes a worker dump its

@@ -639,15 +639,18 @@ import sys
 sys.modules["prometheus_client"] = None  # import -> ImportError
 sys.modules["grpc"] = None
 from container_engine_accelerators_tpu.obs import (
-    flight, histo, timeseries, trace)
+    flight, histo, profiler, timeseries, trace)
 from container_engine_accelerators_tpu.metrics import counters
 with trace.span("bare", histogram="bare.op"):
     counters.inc("bare.counter")
 timeseries.record("goodput.link.a->b", 4096)
+assert profiler.sample_once() >= 0  # the sampler is stdlib-only too
+profiler.ingest("bare.stack", "other", 2)
 blob = flight.dump("no-deps")
 assert blob["histograms"]["bare.op"]["count"] == 1
 assert blob["counters"]["bare.counter"] == 1
 assert blob["rates"]["rates"]["bare.counter"] > 0
+assert blob["profile"]["samples"] >= 2
 assert timeseries.rate("goodput.link.a->b") > 0
 assert histo.exemplar("bare.op") is not None
 assert trace.tail(1)[0]["name"] == "bare"

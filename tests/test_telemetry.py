@@ -429,3 +429,99 @@ class TestAgentTop:
         assert top.main(["--demo", "--once"]) == 0
         out = capsys.readouterr().out
         assert "goodput" in out and "SLO status" in out
+
+    def test_hotspot_panel_from_profile_scrape(self, tmp_path,
+                                               capsys):
+        """ISSUE 14 satellite: the hotspot panel — top subsystems by
+        sample share from the same server's /profile endpoint, idle
+        split out so a parked pool never drowns the busy share."""
+        from container_engine_accelerators_tpu.obs import profiler
+
+        profiler.reset()
+        profiler.ingest("a.stage;b.copy", "shm-staging", 30)
+        profiler.ingest("a.send;b.sock", "xferd", 10)
+        profiler.ingest("park.ed", "idle", 60)
+        counters.inc("top.prof.marker")
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            server.collect_once()
+            top = _load_cli("agent_top")
+            rc = top.main(["--port", str(server.port), "--once"])
+        finally:
+            server.stop()
+            profiler.reset()
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "hotspot (cpu sample share)" in out
+        shm_line = next(l for l in out.splitlines()
+                        if l.startswith("shm-staging"))
+        assert "75.0%" in shm_line  # 30 of 40 busy samples
+        assert "(idle threads)" in out
+
+    def test_hotspot_panel_absent_without_profile(self, tmp_path,
+                                                  capsys):
+        """An agent without /profile samples (or an unreachable
+        endpoint) costs the panel, never the screen."""
+        from container_engine_accelerators_tpu.obs import profiler
+
+        profiler.reset()
+        counters.inc("top.noprof.marker")
+        server = _server(tmp_path)
+        server.start(retry=FAST_BIND)
+        try:
+            server.collect_once()
+            top = _load_cli("agent_top")
+            rc = top.main(["--port", str(server.port), "--once"])
+        finally:
+            server.stop()
+        assert rc == 0
+        assert "hotspot" not in capsys.readouterr().out
+
+    def test_demo_seeds_hotspot_panel(self, capsys):
+        from container_engine_accelerators_tpu.obs import profiler
+
+        profiler.reset()
+        top = _load_cli("agent_top")
+        try:
+            assert top.main(["--demo", "--once"]) == 0
+        finally:
+            profiler.reset()
+        out = capsys.readouterr().out
+        assert "hotspot (cpu sample share)" in out
+        assert "shm-staging" in out
+
+
+class TestProfileReport:
+    def test_report_merges_local_profiler_as_coordinator(self):
+        """In the one-process rig the coordinator's sampler IS the
+        fleet's: profile_report folds its run-delta in under the
+        `coordinator` key, baselined at telemetry boot so a previous
+        run's samples never leak in."""
+        from container_engine_accelerators_tpu.obs import profiler
+
+        profiler.reset()
+        profiler.ingest("stale.run", "other", 7)  # pre-boot history
+        t = FleetTelemetry({}, None, None, scrape=False)
+        profiler.ingest("this.run;hot.code", "dcn_pipeline", 5)
+        try:
+            report = t.profile_report()
+            coord = report["nodes"]["coordinator"]
+            assert coord["samples"] == 5  # delta, not 12
+            assert [e["stack"] for e in coord["top"]] \
+                == ["this.run;hot.code"]
+            assert report["fleet"]["samples"] == 5
+            assert report["fleet"]["subsystems"] \
+                == {"dcn_pipeline": 5}
+        finally:
+            profiler.reset()
+
+    def test_empty_report_shape(self):
+        from container_engine_accelerators_tpu.obs import profiler
+
+        profiler.reset()
+        t = FleetTelemetry({}, None, None, scrape=True)
+        report = t.profile_report()
+        assert report == {"nodes": {},
+                          "fleet": {"samples": 0, "dropped": 0,
+                                    "subsystems": {}, "top": []}}
