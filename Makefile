@@ -183,6 +183,46 @@ tune:
 	@#   this floor only catches a controller that converged somewhere
 	@#   genuinely wrong.
 
+# Topology-aware collectives gate: the engine suite (comm graph,
+# synthesis verified against the in-memory simulator, runner e2es,
+# slow scenario matrix included), then the two CLI acceptance legs:
+# (1) the ring-vs-hierarchical comparison on a 2-rack rig with a
+# degraded cross-rack tier — the synthesized hierarchical schedule's
+# measured bus bandwidth must beat the flat ring's by the margin;
+# (2) the cross-rack degrade-and-heal scenario — exit 0 means
+# converged AND the busbw recovery floor held (exit 3 is
+# converged-but-breached and fails this gate), and the report check
+# asserts the engine re-synthesized on BOTH edges of the fault
+# (collective.resynth >= 2) with busbw visibly degrading then
+# recovering.  Folded into presubmit.
+COLLECTIVE_REPORT := /tmp/tpu_collective_report.json
+
+.PHONY: collectives
+collectives:
+	$(PY) -m pytest tests/test_collective_engine.py -q -p no:randomly
+	$(PY) -m container_engine_accelerators_tpu.collectives.runner \
+	    --compare --nodes 4 --racks 2 --xrack-latency-ms 25 \
+	    --bytes 262144 --rounds 3 --margin 1.3 > /dev/null
+	rm -f $(COLLECTIVE_REPORT)
+	$(PY) cmd/fleet_sim.py \
+	    --scenario scenarios/collective_xrack_degrade.json \
+	    > $(COLLECTIVE_REPORT)
+	@# Two commands, not a pipe: fleet_sim's own exit code (2 not
+	@# converged / 3 SLO breach) must fail the gate.
+	$(PY) -c "import json; \
+	    r = json.loads(open('$(COLLECTIVE_REPORT)').read() \
+	        .strip().splitlines()[-1]); \
+	    assert r['collective']['resynth'] >= 2, 'no re-synthesis'; \
+	    legs = [l for rnd in r['rounds'] for l in rnd['legs'] \
+	            if l.get('workload') == 'collective']; \
+	    healthy = max(l['busbw_bps'] for l in legs[:2]); \
+	    degraded = min(l['busbw_bps'] for l in legs[2:5]); \
+	    assert degraded < healthy, 'fault never dented busbw'; \
+	    assert legs[-1]['busbw_bps'] > degraded, 'no recovery'; \
+	    print('collectives: resynth', r['collective']['resynth'], \
+	          'busbw healthy/degraded/final', int(healthy), \
+	          int(degraded), int(legs[-1]['busbw_bps']))"
+
 # Invariant lint gate (analysis/lint.py rule registry via
 # cmd/agent_lint.py): exit 0 clean, 1 findings, 2 internal error.
 # Inline suppressions must name their rule (# lint: disable=<rule>).
@@ -265,6 +305,7 @@ race:
 	    tests/test_fleet.py \
 	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
 	    tests/test_serving.py tests/test_profiler.py \
+	    tests/test_collective_engine.py \
 	    -q -m "not slow" -p no:randomly
 	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
 	    --check $(RACE_REPORT)
@@ -277,6 +318,7 @@ presubmit:
 	$(MAKE) race
 	$(MAKE) critpath
 	$(MAKE) fleet-serve
+	$(MAKE) collectives
 	$(MAKE) tune
 	$(MAKE) prof
 

@@ -46,6 +46,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from container_engine_accelerators_tpu.fleet.controller import (  # noqa: E402
+    DEFAULT_COLLECTIVE_SCENARIO,
     DEFAULT_PROC_SCENARIO,
     DEFAULT_SCENARIO,
     DEFAULT_SERVING_SCENARIO,
@@ -100,14 +101,22 @@ def parse_args(argv=None):
                         "Without --scenario this runs the built-in "
                         "SIGKILL scenario; a worker that never "
                         "completes its handshake exits 2, not a hang")
-    p.add_argument("--workload", choices=("ring", "serving"),
+    p.add_argument("--workload", choices=("ring", "serving",
+                                          "collective"),
                    default=None,
                    help="round workload: 'ring' transfer legs "
-                        "(default), or 'serving' — a ServingFrontend "
+                        "(default), 'serving' — a ServingFrontend "
                         "spraying batched/hedged requests across the "
                         "fleet (admission control, per-node breakers, "
                         "serving SLOs; without --scenario this runs "
-                        "the built-in node-kill serving scenario)")
+                        "the built-in node-kill serving scenario), or "
+                        "'collective' — the topology-aware engine "
+                        "synthesizing ring/tree/hierarchical schedules "
+                        "from the fleet's comm graph and executing "
+                        "them over the DCN plane (without --scenario "
+                        "this runs the built-in cross-rack "
+                        "degrade-and-heal scenario with its busbw "
+                        "recovery floor)")
     p.add_argument("--metrics", action="store_true",
                    help="start a per-node MetricServer (ephemeral ports)")
     p.add_argument("--slo", action="append", default=[],
@@ -144,6 +153,19 @@ def _print_report(report, file=sys.stderr):
                   f"{'y' if s['up'] else 'N':>3} {s['frames']:>7} "
                   f"{s['bytes']:>9} {s['drops']:>6} {s['dups']:>5} "
                   f"{s['blocked']:>8}", file=file)
+    if report.get("workload") == "collective" and report["rounds"]:
+        print(f"\n{'round':>5} {'algorithm':>13} {'ok':>3} "
+              f"{'time(ms)':>9} {'busbw(B/s)':>11} {'resynth':>8}",
+              file=file)
+        for rnd in report["rounds"]:
+            for leg in rnd["legs"]:
+                if leg.get("workload") != "collective":
+                    continue
+                print(f"{rnd['round']:>5} {leg['algorithm']:>13} "
+                      f"{'y' if leg['ok'] else 'N':>3} "
+                      f"{leg['time_ms']:>9.1f} "
+                      f"{leg['busbw_bps']:>11.0f} "
+                      f"{leg['resynth']:>8}", file=file)
     if report.get("workload") == "serving" and report["rounds"]:
         print(f"\n{'round':>5} {'accepted':>9} {'ok':>5} {'errors':>7} "
               f"{'shed':>5} {'lost':>5}", file=file)
@@ -173,6 +195,8 @@ def main(argv=None):
         builtin = load_scenario(args.scenario)
     elif args.workload == "serving":
         builtin = DEFAULT_SERVING_SCENARIO
+    elif args.workload == "collective":
+        builtin = DEFAULT_COLLECTIVE_SCENARIO
     elif args.proc:
         builtin = DEFAULT_PROC_SCENARIO
     else:

@@ -1,7 +1,17 @@
-"""Public re-exports for the collectives package."""
-from container_engine_accelerators_tpu.collectives.bench import (
-    CollectiveResult,
-    run_sweep,
-)
+"""Public re-exports for the collectives package.
+
+The engine modules (topo / synth / runner) are dependency-light and
+import eagerly; the XLA bench re-exports resolve lazily so importing
+the engine on a coordinator never drags jax in (bench.py imports jax
+at module top — that is its job, not the planner's).
+"""
 
 __all__ = ["CollectiveResult", "run_sweep"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from container_engine_accelerators_tpu.collectives import bench
+
+        return getattr(bench, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

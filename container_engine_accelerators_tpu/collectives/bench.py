@@ -151,11 +151,13 @@ def _parse_size(s: str) -> int:
 
 
 def _bus_factor(op: str, n: int) -> float:
-    if op == "all_reduce":
-        return 2 * (n - 1) / n
-    if op in ("all_gather", "reduce_scatter"):
-        return (n - 1) / n
-    return 1.0  # ppermute ring
+    # One accounting convention for both rigs: the XLA sweep here and
+    # the fleet-rig engine (collectives/synth.py) share the factor.
+    from container_engine_accelerators_tpu.collectives.synth import (
+        bus_factor,
+    )
+
+    return bus_factor(op, n)
 
 
 def _make_collective(op: str, mesh: Mesh) -> Callable:

@@ -1,0 +1,509 @@
+"""Execute synthesized collective schedules over the fleet rig.
+
+The runner is the wire half of the engine: it takes the schedule
+synth.py planned (ring / tree / hierarchical, chosen from the comm
+graph) and drives every :class:`TransferStep` through the SAME data
+plane the rest of the stack uses — pooled production
+``ResilientDcnXferClient``s per node, serial staging legs or the
+chunked/striped pipelined plane, every cross-node byte through the
+link table (in-process fleets) or each worker daemon's real TCP stack
+(process mode).  Link chaos therefore hits a collective exactly where
+it would hit a training job's exchange.
+
+Semantics: step groups are barriers.  Every leg's payload snapshots
+pre-group state (so concurrent legs in one group can never observe
+each other's landings — the same contract synth.simulate verifies),
+legs run concurrently on a bounded pool, and the group's reductions
+apply on the coordinator after every leg returns.  A leg retries
+under a bounded budget; a leg that spends it fails the whole run for
+this round (the controller's round loop is the outer retry, and a
+graph-signature change from the fault re-synthesizes the schedule —
+``collective.resynth``).
+
+Accounting follows collectives/bench.py's nccl-tests conventions:
+``algbw = S / t`` with S the per-rank payload and t the whole
+schedule's wall time, ``busbw = algbw * bus_factor(op, n)`` — so a
+number measured here compares against the XLA sweep's.  The run
+emits ``collective.*`` counters/gauges and a span tree
+(``collective.run`` > ``collective.phase`` > ``collective.leg`` with
+src/dst/phase attrs) so the critical-path report names the hop that
+dominated, not just the slower total.
+
+CLI (the `make collectives` acceptance leg)::
+
+    python -m container_engine_accelerators_tpu.collectives.runner \
+        --compare --nodes 4 --racks 2 --xrack-latency-ms 25 \
+        --bytes 262144 --margin 1.3
+
+boots an in-process 2-rack fleet, degrades the cross-rack tier, runs
+ring and hierarchical pinned, and exits non-zero unless hierarchical
+beats the flat ring's bus bandwidth by the margin.
+"""
+
+import argparse
+import contextlib
+import itertools
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from container_engine_accelerators_tpu.collectives import synth
+from container_engine_accelerators_tpu.collectives.topo import CommGraph
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import timeseries, trace
+from container_engine_accelerators_tpu.parallel import dcn, dcn_pipeline
+from container_engine_accelerators_tpu.parallel.dcn_client import (
+    DcnXferError,
+    ResilientDcnXferClient,
+)
+from container_engine_accelerators_tpu.utils.retry import RetryPolicy
+
+log = logging.getLogger(__name__)
+
+
+class CollectiveConfig:
+    """Engine knobs.  Scenario specs pass them as the ``collective:``
+    mapping (:meth:`from_scenario` — unknown keys are dropped with a
+    log line, the TPU_FAULT_SPEC rule)."""
+
+    #: which collective and how many payload bytes per rank (S)
+    op: str = "all_reduce"
+    bytes: int = 262144
+    #: pin one algorithm, or None = the cost model chooses per graph
+    algorithm: Optional[str] = None
+    #: verify every node's result region against the in-memory oracle
+    verify: bool = True
+    #: per-leg retry budget (the controller round loop retries above)
+    leg_attempts: int = 3
+    leg_backoff_ms: float = 30.0
+    leg_deadline_s: float = 8.0
+    #: land/read timeout for one DCN phase inside a leg
+    land_timeout_s: float = 2.0
+    #: concurrent legs per step group (and the client-pool high water)
+    max_workers: int = 8
+    #: per-node client retry deadline
+    client_deadline_s: float = 4.0
+
+    _FIELDS = ("op", "bytes", "algorithm", "verify", "leg_attempts",
+               "leg_backoff_ms", "leg_deadline_s", "land_timeout_s",
+               "max_workers", "client_deadline_s")
+
+    def __init__(self, **kw):
+        for field in self._FIELDS:
+            setattr(self, field, kw.pop(field, getattr(type(self),
+                                                       field)))
+        if kw:
+            raise TypeError(f"unknown CollectiveConfig fields: "
+                            f"{sorted(kw)}")
+
+    @classmethod
+    def from_scenario(cls, raw: Optional[dict]) -> "CollectiveConfig":
+        if raw is None:
+            return cls()
+        known = {}
+        for key, value in dict(raw).items():
+            if key in cls._FIELDS:
+                known[key] = value
+            else:
+                log.error("ignoring unknown collective knob %r", key)
+        return cls(**known)
+
+
+class CollectiveEngine:
+    """Synthesize-and-execute loop over one fleet's nodes.
+
+    ``nodes`` is the controller's node map (EmulatedNode or ProcNode —
+    both expose ``root``/``client``/``daemon.data_port``/``down``);
+    ``links`` is the coordinator's LinkTable (fault evidence for the
+    graph; process-mode fleets mirror their worker-shim faults into
+    it).  ``pipe_cfg`` non-None routes legs over the pipelined plane.
+    """
+
+    def __init__(self, nodes: dict, topology, links=None,
+                 cfg: Optional[CollectiveConfig] = None,
+                 pipe_cfg=None):
+        self.nodes = nodes
+        self.topology = topology
+        self.links = links
+        self.cfg = cfg or CollectiveConfig()
+        self.pipe_cfg = pipe_cfg
+        self.synth = synth.Synthesizer(self.cfg.op, self.cfg.bytes,
+                                       self.cfg.algorithm)
+        self._retry = RetryPolicy(
+            max_attempts=int(self.cfg.leg_attempts),
+            initial_backoff_s=float(self.cfg.leg_backoff_ms) / 1e3,
+            max_backoff_s=0.2,
+            deadline_s=float(self.cfg.leg_deadline_s),
+        )
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(self.cfg.max_workers),
+            thread_name_prefix="collective")
+        self._client_pool: Dict[str, List] = {}
+        self._clients_lock = threading.Lock()
+        self._fid = itertools.count()
+
+    # -- pooled clients (the serving frontend's discipline) ------------------
+
+    @contextlib.contextmanager
+    def _client(self, node):
+        c = None
+        with self._clients_lock:
+            pool = self._client_pool.setdefault(node.name, [])
+            if pool:
+                c = pool.pop()
+        if c is None:
+            c = ResilientDcnXferClient(
+                os.path.join(node.root, "tpu-dcn"),
+                retry=RetryPolicy(
+                    max_attempts=4, initial_backoff_s=0.02,
+                    max_backoff_s=0.2,
+                    deadline_s=float(self.cfg.client_deadline_s)),
+            )
+        clean = False
+        try:
+            yield c
+            clean = True
+        finally:
+            if clean:
+                with self._clients_lock:
+                    self._client_pool.setdefault(node.name,
+                                                 []).append(c)
+            else:
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)
+        with self._clients_lock:
+            clients = [c for pool in self._client_pool.values()
+                       for c in pool]
+            self._client_pool.clear()
+        for c in clients:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    # -- graph + schedule ----------------------------------------------------
+
+    def graph(self) -> CommGraph:
+        return CommGraph.build(self.topology, links=self.links)
+
+    # -- one leg -------------------------------------------------------------
+
+    def _leg(self, rnd: int, gi: int, t: synth.TransferStep,
+             payload: bytes, ctx: Optional[dict]) -> bytes:
+        with contextlib.ExitStack() as stack:
+            if ctx:
+                # Legs run on pool threads; join the round's trace so
+                # the critical-path report sees one tree per run.
+                stack.enter_context(trace.attach(ctx["trace"],
+                                                 ctx["span"]))
+            with trace.span("collective.leg",
+                            histogram="collective.leg",
+                            src=t.src, dst=t.dst, phase=t.phase,
+                            bytes=t.nbytes, reduce=t.reduce) as span:
+                src, dst = self.nodes[t.src], self.nodes[t.dst]
+                if getattr(src, "down", False) \
+                        or getattr(dst, "down", False):
+                    counters.inc("collective.failures")
+                    raise DcnXferError(
+                        f"leg {t.src}->{t.dst}: node down")
+                flow = (f"coll.r{rnd}.g{gi}.{t.src}.{t.dst}."
+                        f"{next(self._fid)}")
+                with self._client(src) as sc, self._client(dst) as dc:
+                    # Registration sits INSIDE the try: if the second
+                    # register raises (its worker just died), the
+                    # finally still releases whatever the first one
+                    # registered — faulted rounds must not accumulate
+                    # leaked assembly buffers on surviving daemons.
+                    try:
+                        dc.register_flow(flow, peer=t.src,
+                                         bytes=t.nbytes)
+                        sc.register_flow(flow, peer=t.dst,
+                                         bytes=t.nbytes)
+                        if self.pipe_cfg is None:
+                            # Serial leg: whole-payload staging up
+                            # front, ONCE (the controller's _leg
+                            # discipline) — retries below re-send
+                            # only, and a daemon restart that lost
+                            # the staging is healed by the resilient
+                            # client's transparent restage.  The
+                            # pipelined leg stages chunk-by-chunk
+                            # inside each attempt instead.
+                            sc.put(flow, payload)
+                            dcn.wait_flow_rx(
+                                sc, flow, t.nbytes,
+                                timeout_s=float(
+                                    self.cfg.land_timeout_s))
+                        last: Optional[BaseException] = None
+                        attempts = 0
+                        for _attempt in self._retry.attempts():
+                            attempts += 1
+                            try:
+                                got = self._transfer(sc, dc, dst, flow,
+                                                     payload, t)
+                                if got != payload:
+                                    raise DcnXferError(
+                                        f"payload mismatch on {flow}")
+                                counters.inc("collective.transfers")
+                                span.annotate(attempts=attempts)
+                                return got
+                            except (DcnXferError, OSError,
+                                    TimeoutError) as e:
+                                last = e
+                                counters.inc("collective.leg.retried")
+                        span.annotate(attempts=attempts)
+                        raise DcnXferError(
+                            f"leg {t.src}->{t.dst} spent its retry "
+                            f"budget: {last}")
+                    except (DcnXferError, OSError, TimeoutError):
+                        # One failure count per failed leg, whatever
+                        # phase broke — registration, staging, or a
+                        # spent retry budget.
+                        counters.inc("collective.failures")
+                        raise
+                    finally:
+                        for c in (sc, dc):
+                            try:
+                                c.release_flow(flow)
+                            except (DcnXferError, OSError):
+                                pass
+
+    def _transfer(self, sc, dc, dst_node, flow: str, payload: bytes,
+                  t: synth.TransferStep) -> bytes:
+        """One attempt of a leg's data movement.  The serial path
+        assumes ``_leg`` staged the payload once up front: an attempt
+        re-sends only, and a daemon restart that lost the staging is
+        healed by the resilient client (``dcn.send.restaged``)."""
+        nbytes = len(payload)
+        land_s = float(self.cfg.land_timeout_s)
+        port = dst_node.daemon.data_port
+        if self.pipe_cfg is not None:
+            dcn_pipeline.send_pipelined(sc, flow, payload, "127.0.0.1",
+                                        port, self.pipe_cfg,
+                                        timeout_s=land_s)
+            return dcn_pipeline.read_pipelined(dc, flow, nbytes,
+                                               self.pipe_cfg,
+                                               timeout_s=land_s)
+        sc.send(flow, "127.0.0.1", port, nbytes)
+        dcn.wait_flow_rx(dc, flow, nbytes, timeout_s=land_s)
+        return dc.read(flow, nbytes)
+
+    # -- one collective ------------------------------------------------------
+
+    def run_round(self, rnd: int) -> dict:
+        """Synthesize (or reuse) the schedule for the current graph and
+        run it once.  Returns the round-log entry: algorithm, timing,
+        nccl-convention bandwidths, failure and re-synthesis counts —
+        ``ok`` keeps the controller's convergence contract."""
+        cfg = self.cfg
+        graph = self.graph()
+        before = self.synth.resynth_count
+        schedule = self.synth.schedule_for(graph)
+        resynth = self.synth.resynth_count - before
+        order = schedule.order
+        n = len(order)
+        inputs = synth.make_inputs(cfg.op, order, cfg.bytes, seed=rnd)
+        bufs = {name: bytearray(b) for name, b in inputs.items()}
+        counters.inc("collective.runs")
+        entry = {
+            "workload": "collective",
+            "collective": cfg.op,
+            "algorithm": schedule.algorithm,
+            "bytes": cfg.bytes,
+            "steps": len(schedule.steps),
+            "transfers": schedule.transfers,
+            "resynth": resynth,
+            "est_cost_ms": schedule.to_dict()["est_cost_ms"],
+        }
+        per_node_ok: Dict[str, int] = {name: 0 for name in order}
+        per_node_failed: Dict[str, int] = {name: 0 for name in order}
+        error: Optional[str] = None
+        t0 = time.monotonic()
+        with trace.span("collective.run", histogram="collective.run",
+                        collective=cfg.op,
+                        algorithm=schedule.algorithm, bytes=cfg.bytes,
+                        nodes=n, round=rnd) as span:
+            gi = 0
+            for phase, groups in itertools.groupby(
+                    schedule.steps,
+                    key=lambda g: g[0].phase if g else ""):
+                with trace.span("collective.phase", phase=phase):
+                    for group in groups:
+                        errs = self._run_group(rnd, gi, group, bufs,
+                                               per_node_ok,
+                                               per_node_failed)
+                        gi += 1
+                        if errs:
+                            error = str(errs[0][1])
+                            break
+                if error:
+                    # Later groups consume this one's reductions; a
+                    # broken barrier makes them meaningless.  The
+                    # round fails, the controller loops, and the
+                    # fault's signature change re-plans.
+                    break
+            span.annotate(ok=error is None, error=error)
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        ok = error is None
+        if ok and cfg.verify:
+            expected = synth.expected_outputs(cfg.op, order, inputs,
+                                              cfg.bytes)
+            for name, (off, ln, want) in expected.items():
+                if bytes(bufs[name][off:off + ln]) != want:
+                    counters.inc("collective.verify.failed")
+                    ok = False
+                    error = f"verification failed on {name}"
+                    break
+        algbw = cfg.bytes / elapsed
+        busbw = algbw * synth.bus_factor(cfg.op, n)
+        if ok:
+            # Gauges carry the LAST completed collective — a failed
+            # round keeps the previous evidence instead of publishing
+            # a bandwidth no data actually achieved.
+            timeseries.gauge("collective.busbw_bps", busbw)
+            timeseries.gauge("collective.algbw_bps", algbw)
+        entry.update(
+            ok=ok,
+            error=error,
+            time_ms=round(elapsed * 1e3, 3),
+            algbw_bps=round(algbw, 1) if ok else 0.0,
+            busbw_bps=round(busbw, 1) if ok else 0.0,
+            per_node_ok=per_node_ok,
+            per_node_failed=per_node_failed,
+        )
+        return entry
+
+    def _run_group(self, rnd: int, gi: int,
+                   group: List[synth.TransferStep], bufs: dict,
+                   per_node_ok: Dict[str, int],
+                   per_node_failed: Dict[str, int],
+                   ) -> List[Tuple[synth.TransferStep, BaseException]]:
+        """One barrier group: snapshot payloads, run every leg on the
+        pool, apply reductions coordinator-side after the join (so
+        overlapping reduce targets — a tree root's fan-in — never
+        race)."""
+        counters.inc("collective.steps")
+        ctx = trace.context()
+        staged = [(t, bytes(bufs[t.src][t.offset:t.offset + t.nbytes]))
+                  for t in group]
+        futures = [(t, payload,
+                    self._pool.submit(self._leg, rnd, gi, t, payload,
+                                      ctx))
+                   for t, payload in staged]
+        landed: List[Tuple[synth.TransferStep, bytes]] = []
+        errors: List[Tuple[synth.TransferStep, BaseException]] = []
+        for t, payload, fut in futures:
+            try:
+                landed.append((t, fut.result()))
+                per_node_ok[t.src] += 1
+            except (DcnXferError, OSError, TimeoutError) as e:
+                errors.append((t, e))
+                per_node_failed[t.src] += 1
+        for t, got in landed:
+            if t.reduce:
+                synth.combine(bufs[t.dst], t.offset, got)
+            else:
+                bufs[t.dst][t.offset:t.offset + t.nbytes] = got
+        return errors
+
+
+# -- CLI: the ring-vs-hierarchical acceptance comparison ---------------------
+
+
+def _compare(args) -> int:
+    """Boot an in-process 2-rack fleet, degrade the cross-rack tier,
+    run ring and hierarchical pinned over the SAME rig, and gate
+    hierarchical's bus bandwidth at ``margin`` x the flat ring's."""
+    from container_engine_accelerators_tpu.fleet.controller import (
+        FleetController,
+    )
+
+    ctl = FleetController({
+        "name": "collective-compare",
+        "nodes": int(args.nodes),
+        "racks": int(args.racks),
+        "chips": 2,
+        "topology": "1x2x1",
+        "rounds": 0,
+        "metrics": False,
+    })
+    results = {}
+    try:
+        ctl.boot()
+        if args.xrack_latency_ms > 0:
+            ctl.links.apply(
+                f"rack:r0<->rack:r1:latency:{args.xrack_latency_ms:g}")
+        for algo in ("ring", "hierarchical"):
+            engine = CollectiveEngine(
+                ctl.nodes, ctl.topology, links=ctl.links,
+                cfg=CollectiveConfig(op=args.op, bytes=args.bytes,
+                                     algorithm=algo))
+            try:
+                best = None
+                for rnd in range(int(args.rounds)):
+                    entry = engine.run_round(rnd)
+                    if not entry["ok"]:
+                        print(f"{algo} round {rnd} failed: "
+                              f"{entry['error']}", file=sys.stderr)
+                        return 2
+                    if best is None \
+                            or entry["busbw_bps"] > best["busbw_bps"]:
+                        best = entry
+                results[algo] = best
+            finally:
+                engine.close()
+    finally:
+        ctl.close()
+    ring_bw = results["ring"]["busbw_bps"]
+    hier_bw = results["hierarchical"]["busbw_bps"]
+    ratio = hier_bw / max(ring_bw, 1e-9)
+    ok = ratio >= float(args.margin)
+    print(json.dumps({
+        "nodes": int(args.nodes), "racks": int(args.racks),
+        "op": args.op, "bytes": int(args.bytes),
+        "xrack_latency_ms": float(args.xrack_latency_ms),
+        "ring": results["ring"], "hierarchical": results["hierarchical"],
+        "ratio": round(ratio, 3), "margin": float(args.margin),
+        "pass": ok,
+    }))
+    print(f"# hierarchical {hier_bw:.0f} B/s vs ring {ring_bw:.0f} B/s "
+          f"= {ratio:.2f}x (need >= {args.margin:g}x) -> "
+          f"{'PASS' if ok else 'FAIL'}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="topology-aware collective engine CLI")
+    p.add_argument("--compare", action="store_true",
+                   help="run the ring-vs-hierarchical acceptance "
+                        "comparison on an in-process fleet")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--racks", type=int, default=2)
+    p.add_argument("--bytes", type=int, default=262144)
+    p.add_argument("--op", default="all_reduce",
+                   choices=list(synth.COLLECTIVES))
+    p.add_argument("--rounds", type=int, default=3,
+                   help="rounds per algorithm; best busbw is compared")
+    p.add_argument("--xrack-latency-ms", type=float, default=25.0,
+                   help="injected cross-rack one-way latency (the "
+                        "slow-spine rig the comparison runs on)")
+    p.add_argument("--margin", type=float, default=1.3,
+                   help="hierarchical must beat ring by this factor")
+    args = p.parse_args(argv)
+    if not args.compare:
+        p.error("nothing to do: pass --compare")
+    return _compare(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,6 +165,34 @@ DEFAULT_SERVING_SCENARIO = {
 }
 
 
+# The collective headline (`--workload collective`): the topology-aware
+# engine synthesizes the schedule from the measured comm graph (2 racks
+# -> hierarchical reduce-scatter / exchange / all-gather), the
+# cross-rack tier degrades mid-run and heals (`for:`), the engine
+# re-synthesizes on both edges of the fault (`collective.resynth`), and
+# the recovery floor SLO gates that post-heal bus bandwidth is back.
+DEFAULT_COLLECTIVE_SCENARIO = {
+    "name": "collective-xrack-latency",
+    "workload": "collective",
+    "nodes": 4,
+    "racks": 2,
+    "chips": 2,
+    "topology": "1x2x1",
+    "rounds": 6,
+    "payload_bytes": 65536,
+    "collective": {
+        "op": "all_reduce",
+        "bytes": 65536,
+    },
+    "faults": [
+        {"round": 2, "link": "rack:r0<->rack:r1:latency:30", "for": 2},
+    ],
+    "slo": {
+        "min_final_busbw_bps": 50000,
+    },
+}
+
+
 def load_scenario(path: str) -> dict:
     """Read a scenario file: YAML when the extension says so (and
     PyYAML is importable), JSON otherwise."""
@@ -194,6 +222,12 @@ def _scenario_specs(scenario: dict) -> List[NodeSpec]:
             chips=int(n.get("chips", scenario.get("chips", 4))),
             topology=n.get("topology", scenario.get("topology", "2x2x1")),
             partition_size=n.get("partition_size", ""),
+            # Multi-host slices: explicit node lists may pin a shared
+            # slice id and per-host mesh coords, so the production
+            # distance function (and every tier/ring decision built on
+            # it) sees real ICI structure.
+            slice_id=n.get("slice"),
+            coords=n.get("coords", "0,0,0"),
         )
         for n in nodes
     ]
@@ -246,11 +280,17 @@ class FleetController:
             deadline_s=float(self.scenario.get("leg_deadline_s", 8.0)),
         )
         self.land_timeout_s = float(self.scenario.get("land_timeout_s", 2.0))
-        # Workload: "ring" (the classic transfer legs) or "serving"
-        # (a ServingFrontend spraying batched/hedged requests across
-        # the fleet — serving/frontend.py).
+        # Workload: "ring" (the classic transfer legs), "serving" (a
+        # ServingFrontend spraying batched/hedged requests across the
+        # fleet — serving/frontend.py), or "collective" (the
+        # topology-aware engine synthesizing and executing collective
+        # schedules from the fleet's comm graph — collectives/).
         self.workload = str(self.scenario.get("workload", "ring"))
         self.frontend: Optional[ServingFrontend] = None
+        # A collectives.runner.CollectiveEngine when workload is
+        # "collective" (imported at boot — the engine plans against
+        # fleet.topology, so a module-level import would be circular).
+        self.collective = None
         # round -> list of deferred inverse faults ("for: K" entries)
         self._deferred: Dict[int, List[dict]] = {}
         self._booted = False
@@ -312,6 +352,21 @@ class FleetController:
                 self.nodes,
                 ServingConfig.from_scenario(self.scenario.get("serving")),
             ).start()
+        elif self.workload == "collective":
+            from container_engine_accelerators_tpu.collectives.runner \
+                import CollectiveConfig, CollectiveEngine
+
+            # The engine plans against the coordinator's link table in
+            # BOTH modes: in-process fleets fault it directly, process
+            # fleets mirror their worker-shim faults into it
+            # (_apply_proc_link_fault), so the comm graph sees the
+            # same evidence either way.
+            self.collective = CollectiveEngine(
+                self.nodes, self.topology, links=self.links,
+                cfg=CollectiveConfig.from_scenario(
+                    self.scenario.get("collective")),
+                pipe_cfg=self.pipe_cfg if self.pipelined else None,
+            )
         self._booted = True
         log.info("fleet booted: %d node(s) in %d rack(s)%s",
                  len(self.nodes),
@@ -323,6 +378,9 @@ class FleetController:
         if self.frontend is not None:
             self.frontend.close()
             self.frontend = None
+        if self.collective is not None:
+            self.collective.close()
+            self.collective = None
         for node in self.nodes.values():
             node.close()
         if self._prof_started:
@@ -351,6 +409,16 @@ class FleetController:
                 # same actions, applied in the send path.
                 record["applied"] = self._apply_proc_link_fault(
                     fault, record)
+                # Mirror the fault into the coordinator's link table
+                # as ANNOTATION state (no frame routes through it in
+                # proc mode): the collective engine's comm graph and
+                # the scheduler's link-health penalty read the same
+                # evidence in both fleet modes.  One honest asymmetry:
+                # a mirrored drop BUDGET never decrements here (the
+                # frames that spend it cross worker TCP, not this
+                # table), so the edge reads degraded until a heal —
+                # conservative planning, never the reverse.
+                self.links.apply(fault)
             else:
                 record["applied"] = len(self.links.apply(fault))
             lifetime = int(entry.get("for", 0))
@@ -553,6 +621,25 @@ class FleetController:
         )
         return entry
 
+    def _collective_round(self, rnd: int, per_node_ok: Dict[str, int],
+                          per_node_failed: Dict[str, int]) -> dict:
+        """One collective round: re-plan against the current comm
+        graph if the fault state moved (the engine's synthesizer owns
+        that), execute the schedule over the rig, and fold the
+        per-node leg accounting into the report.  The entry keeps the
+        ``ok``-bool convergence contract, and the telemetry layer
+        collects the busbw history the `min_busbw_bps` /
+        `min_final_busbw_bps` SLOs judge."""
+        entry = self.collective.run_round(rnd)
+        for name, n in entry.pop("per_node_ok").items():
+            per_node_ok[name] += n
+        for name, n in entry.pop("per_node_failed").items():
+            per_node_failed[name] += n
+        self.telemetry.collective_rounds.append(
+            {k: entry[k] for k in ("ok", "algorithm", "busbw_bps",
+                                   "resynth")})
+        return entry
+
     def _ring(self) -> List[tuple]:
         names = list(self.nodes)
         n = len(names)
@@ -581,6 +668,9 @@ class FleetController:
                 with trace.span("fleet.round", round=rnd):
                     if self.frontend is not None:
                         legs.append(self._serving_round(
+                            rnd, per_node_ok, per_node_failed))
+                    elif self.collective is not None:
+                        legs.append(self._collective_round(
                             rnd, per_node_ok, per_node_failed))
                     else:
                         for src, dst in self._ring():
@@ -668,6 +758,18 @@ class FleetController:
         critical_path = critpath.analyze(self.telemetry.spans())
         critical_path["dropped_spans"] = self.telemetry.spans_dropped
         report_extra = {}
+        if self.collective is not None:
+            graph = self.collective.graph()
+            report_extra["collective"] = {
+                "resynth": self.collective.synth.resynth_count,
+                "schedule": (
+                    self.collective.synth.current().to_dict()
+                    if self.collective.synth.current() else None),
+                # The placement side of the same evidence: per-node
+                # partitioned/degraded link rollup — what the
+                # scheduler's link-health penalty steers on.
+                "node_health": graph.node_health(),
+            }
         if self.frontend is not None:
             report_extra["serving"] = {
                 "breakers": self.frontend.breaker.snapshot(),

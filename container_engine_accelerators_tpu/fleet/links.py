@@ -204,6 +204,22 @@ class LinkTable:
                         fault.action, len(pairs))
         return pairs
 
+    def snapshot_state(self) -> Dict[Tuple[str, str], dict]:
+        """Current fault state per touched directed link — the comm
+        graph's evidence source (collectives/topo.py).  Only links the
+        table has actually seen (faulted or carried traffic) appear;
+        an absent pair means "no evidence", which callers read as
+        healthy at its tier's defaults."""
+        with self._lock:
+            return {
+                pair: {
+                    "up": link.up,
+                    "latency_s": link.latency_s,
+                    "drop_next": link.drop_next,
+                }
+                for pair, link in self._links.items()
+            }
+
     def report(self) -> Dict[str, dict]:
         """Per-link accounting for the fleet report, tier-annotated via
         the production scheduler distance."""

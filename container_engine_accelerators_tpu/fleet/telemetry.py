@@ -134,6 +134,20 @@ SLO_KEYS = {
     "min_qps": ("floor", "completed (ok) serving requests per second"),
     "max_error_ratio": ("ceiling",
                         "errored serving requests / terminated"),
+    # Collective SLOs (workload: collective — collectives/runner.py).
+    # The engine runs in the COORDINATOR process in both fleet modes,
+    # so these are judged from the controller-fed round history either
+    # way: bus bandwidth follows bench.py's nccl-tests conventions
+    # (busbw = algbw * bus_factor(op, n)), and only rounds that
+    # completed AND verified count — a failed round contributes no
+    # bandwidth rather than a flattering zero-time sample.
+    "min_busbw_bps": ("floor",
+                      "mean collective bus bandwidth over completed "
+                      "rounds (bytes/s)"),
+    "min_final_busbw_bps": ("floor",
+                            "bus bandwidth of the final completed "
+                            "collective round (bytes/s) — the "
+                            "post-heal recovery floor"),
     # Exposed-communication ceiling (obs/critpath.py): DCN time not
     # hidden behind staging, over the run's pipelined transfers.  The
     # inputs (`dcn.exposed` / `dcn.comm` histogram sums) are recorded
@@ -345,6 +359,11 @@ class FleetTelemetry:
         # coordinator entry judges THIS run only: snapshot at boot,
         # delta at report time.
         self._prof0 = profiler.snapshot()
+        # Collective round history (workload: collective): the
+        # controller appends one entry per round — the engine lives
+        # coordinator-side in both modes, so the busbw SLOs never
+        # need the scrape path.
+        self.collective_rounds: List[dict] = []
 
     # -- per-round scrape ----------------------------------------------------
 
@@ -749,6 +768,19 @@ class FleetTelemetry:
                 return sum(live)
         return 0.0
 
+    def _collective_measurements(self) -> dict:
+        """The collective busbw SLO inputs, from the controller-fed
+        round history.  Only completed (ok) rounds carry bandwidth; a
+        run with no collective rounds measures 0.0 — vacuous only if
+        no busbw SLO was configured, honestly breached otherwise (a
+        floor on a workload that never ran must fail, not pass)."""
+        done = [r.get("busbw_bps", 0.0)
+                for r in self.collective_rounds if r.get("ok")]
+        return {
+            "min_busbw_bps": (sum(done) / len(done)) if done else 0.0,
+            "min_final_busbw_bps": done[-1] if done else 0.0,
+        }
+
     def _serving_measurements(self, elapsed_s: float) -> dict:
         """The serving SLO inputs — coordinator-side in BOTH modes:
         the ServingFrontend runs in the controller process, so its
@@ -776,6 +808,7 @@ class FleetTelemetry:
             "max_dedup_ratio": dups / max(1, frames),
             "max_exposed_comm_ratio": self._exposed_comm_ratio(),
             "min_final_goodput_bps": self._final_round_goodput(),
+            **self._collective_measurements(),
             **self._serving_measurements(elapsed_s),
         }
 
@@ -814,6 +847,7 @@ class FleetTelemetry:
             "max_exposed_comm_ratio": self._exposed_comm_ratio(),
             "min_final_goodput_bps": self._final_round_goodput(),
             "stale_entries_skipped": stale_entries,
+            **self._collective_measurements(),
             **self._serving_measurements(elapsed_s),
         }
 

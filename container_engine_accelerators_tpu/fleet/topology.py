@@ -34,6 +34,12 @@ class NodeSpec:
     chips: int = 4
     topology: str = "2x2x1"
     partition_size: str = ""  # e.g. "2x2" → sub-slice devices
+    # Host origin in the slice's ICI mesh ("x,y,z").  Single-host
+    # slices sit at the origin; a multi-host slice gives each member
+    # its real coordinates, so the production distance function sees
+    # actual torus hops between them instead of every host aliasing
+    # to one point (which made same-slice hosts indistinguishable).
+    coords: str = "0,0,0"
 
     def labels(self) -> Dict[str, str]:
         """The label set label_nodes.py would stamp on this host."""
@@ -43,7 +49,7 @@ class NodeSpec:
             topo.RACK_LABEL: self.rack,
             topo.HOST_LABEL: self.name,
             topo.SLICE_LABEL: self.slice_id or self.name,
-            topo.COORDS_LABEL: "0,0,0",
+            topo.COORDS_LABEL: self.coords,
             topo.TPU_TOPOLOGY_LABEL: self.topology,
         }
 

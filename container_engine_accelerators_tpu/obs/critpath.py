@@ -57,11 +57,13 @@ Interval = Tuple[float, float]
 SHAPE_ROOTS = (
     "fleet.leg",
     "serving.batch",
+    "collective.run",
     "dcn.pipeline",
     "dcn.exchange",
     "bench.xfer",
 )
 HEADLINE_PRIORITY = (
+    "collective.run",
     "dcn.pipeline",
     "serving.batch",
     "dcn.exchange",

@@ -23,7 +23,7 @@ unit-testable against fixture dicts with no cluster (SURVEY.md §4).
 import logging
 import time
 from itertools import groupby
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from container_engine_accelerators_tpu.scheduler.k8s import ApiException, CoreV1
 from container_engine_accelerators_tpu.scheduler.quantity import parse_quantity
@@ -259,6 +259,7 @@ def calculate_pods_assignment(
     sorted_nodes: List[dict],
     sorted_pods: List[dict],
     search_budget_s: Optional[float] = 2.0,
+    link_penalty: Optional[Callable[[dict, dict], float]] = None,
 ) -> List[int]:
     """Exhaustive strictly-increasing-index assignment search minimizing
     Σ distance(consecutive pods' nodes) (ref: schedule-daemon.py:329-360).
@@ -276,9 +277,25 @@ def calculate_pods_assignment(
     assignment almost immediately, so a truncated answer is still a
     valid, usually near-optimal placement).  Pass ``None`` to search
     exhaustively.
+
+    ``link_penalty`` is the optional link-health annotation source
+    (e.g. ``collectives.topo.CommGraph.scheduler_link_penalty``): a
+    callable adding a distance surcharge between two candidate nodes
+    when the fabric between them is known partitioned or lossy.  The
+    packer then *avoids* nodes behind bad links whenever a healthier
+    placement exists, and — because a penalty is finite, never a veto
+    — still returns the least-bad assignment when nothing healthy
+    fits (capacity over purity: a degraded placement beats no
+    placement).
     """
     if not sorted_pods:
         return []
+
+    def _distance(a: dict, b: dict) -> float:
+        d = node_topology_distance(a, b)
+        if link_penalty is not None:
+            d += link_penalty(a, b)
+        return d
     assignment = [-i for i in reversed(range(1, len(sorted_pods) + 1))]
     best, best_distance = [], float("inf")
     deadline = (
@@ -313,7 +330,7 @@ def calculate_pods_assignment(
             break
         if all_ok:
             distance = sum(
-                node_topology_distance(
+                _distance(
                     sorted_nodes[assignment[i]], sorted_nodes[assignment[i - 1]]
                 )
                 for i in range(1, len(sorted_pods))
@@ -373,6 +390,7 @@ class SchedulerDaemon:
         settle_s: float = 5.0,
         sleep=time.sleep,
         search_budget_s: Optional[float] = 2.0,
+        link_penalty: Optional[Callable[[dict, dict], float]] = None,
     ):
         self.api = api
         self.gate_prefix = gate_prefix
@@ -382,6 +400,14 @@ class SchedulerDaemon:
         self._sleep = sleep
         # Per-job cap on the assignment search (None = exhaustive).
         self.search_budget_s = search_budget_s
+        # Optional link-health annotation source (see
+        # calculate_pods_assignment).  The callable is consulted per
+        # pass, but whether it SEES faults armed between passes is the
+        # callable's own contract: a bare
+        # CommGraph.scheduler_link_penalty() closure is a frozen
+        # snapshot; wire collectives.topo.LinkHealthPenalty for a
+        # source that re-snapshots the link table between passes.
+        self.link_penalty = link_penalty
 
     def list_pods(self) -> List[dict]:
         pods = []
@@ -407,6 +433,7 @@ class SchedulerDaemon:
             assignment = calculate_pods_assignment(
                 sorted_nodes, sorted_pods,
                 search_budget_s=self.search_budget_s,
+                link_penalty=self.link_penalty,
             )
             if not assignment:
                 log.info("no placement for job %s under gate %s", job_name, gate)

@@ -1,0 +1,499 @@
+"""Topology-aware collective engine tests (collectives/topo|synth|runner).
+
+Three layers, matching the subsystem: the comm graph (tiers, fault
+evidence, the relative-goodput slowness pass, planning signatures),
+schedule synthesis (every lowerable (collective, algorithm, fleet
+shape) verified against the in-memory simulator; the cost model's
+algorithm choice; re-synthesis on signature change), and the runner
+(schedules executed over a real in-process fleet through the link
+table — busbw accounting, failure semantics, fault -> resynth ->
+heal -> recover).  Whole-scenario e2es are marked ``slow`` (the
+tier-1 budget rule); the fast layers cover the machinery.
+"""
+
+import json
+
+import pytest
+
+from container_engine_accelerators_tpu.collectives import synth
+from container_engine_accelerators_tpu.collectives.topo import (
+    DEGRADED_LINK_PENALTY,
+    PARTITIONED_LINK_PENALTY,
+    TIER_ALPHA_S,
+    TIER_BW_BPS,
+    CommGraph,
+)
+from container_engine_accelerators_tpu.fleet.controller import (
+    DEFAULT_COLLECTIVE_SCENARIO,
+    FleetController,
+    run_scenario,
+)
+from container_engine_accelerators_tpu.fleet.links import LinkTable
+from container_engine_accelerators_tpu.fleet.topology import (
+    TIER_CROSS_RACK,
+    TIER_ICI,
+    TIER_INTRA_RACK,
+    FleetTopology,
+    build_specs,
+)
+from container_engine_accelerators_tpu.metrics import counters
+
+
+def _graph(nodes=4, racks=2, faults=(), rates=None, specs=None):
+    topo = FleetTopology(specs or build_specs(nodes, racks=racks))
+    links = LinkTable(topo)
+    for f in faults:
+        assert links.apply(f), f"fault {f!r} armed nothing"
+    return CommGraph.build(topo, links=links,
+                           rates=rates or (lambda a, b: 0.0))
+
+
+# ---- comm graph ------------------------------------------------------------
+
+
+class TestCommGraph:
+    def test_every_ordered_pair_is_an_edge_with_its_tier(self):
+        g = _graph(4, racks=2)
+        assert g.edge("n0", "n2").tier == TIER_INTRA_RACK
+        assert g.edge("n0", "n1").tier == TIER_CROSS_RACK
+        names = g.nodes()
+        assert all(g.edge(a, b) is not None
+                   for a in names for b in names if a != b)
+
+    def test_ici_tier_for_same_slice_hosts(self):
+        specs = build_specs(2, racks=1, topology="4x2x1")
+        specs[0].slice_id = specs[1].slice_id = "s0"
+        specs[1].coords = "1,0,0"
+        g = _graph(specs=specs)
+        assert g.edge("n0", "n1").tier == TIER_ICI
+
+    def test_partition_prices_infinite_and_directional(self):
+        g = _graph(faults=["node:n0->node:n1:partition"])
+        assert not g.up("n0", "n1")
+        assert g.leg_cost_s("n0", "n1", 1024) == float("inf")
+        assert g.up("n1", "n0")
+        assert g.leg_cost_s("n1", "n0", 1024) < 1.0
+
+    def test_latency_lands_in_alpha(self):
+        clean = _graph().leg_cost_s("n0", "n1", 4096)
+        g = _graph(faults=["node:n0->node:n1:latency:20"])
+        assert g.edge("n0", "n1").degraded
+        assert g.leg_cost_s("n0", "n1", 4096) == pytest.approx(
+            clean + 0.020)
+
+    def test_drop_budget_discounts_bandwidth(self):
+        clean = _graph().leg_cost_s("n0", "n1", 1 << 20)
+        g = _graph(faults=["node:n0->node:n1:drop:5"])
+        assert g.edge("n0", "n1").degraded
+        degraded = g.leg_cost_s("n0", "n1", 1 << 20)
+        assert degraded > clean
+        beta_clean = clean - TIER_ALPHA_S[TIER_CROSS_RACK]
+        beta_degraded = degraded - TIER_ALPHA_S[TIER_CROSS_RACK]
+        assert beta_degraded == pytest.approx(4 * beta_clean)
+
+    def test_signature_moves_on_fault_and_heal_only(self):
+        topo = FleetTopology(build_specs(4, racks=2))
+        links = LinkTable(topo)
+        build = lambda: CommGraph.build(  # noqa: E731
+            topo, links=links, rates=lambda a, b: 0.0)
+        clean = build().signature()
+        assert clean == ()
+        links.apply("rack:r0<->rack:r1:latency:10")
+        faulted = build().signature()
+        assert faulted != clean and len(faulted) == 8
+        links.apply("rack:r0<->rack:r1:heal")
+        assert build().signature() == clean
+
+    def test_slow_pass_flags_active_laggard_not_idle_links(self):
+        """Goodput evidence is relative: an ACTIVE edge far under its
+        tier's best flags `slow`; idle edges (decayed windows) and the
+        healthy peers never do — and the flag stays OUT of the
+        planning signature (measurement noise must not re-plan)."""
+        rates = {("n0", "n1"): 2e6, ("n1", "n0"): 1e5,
+                 ("n2", "n3"): 2e6}
+
+        def rate(a, b):
+            return rates.get((a, b), 0.0)
+
+        g = _graph(rates=rate)
+        assert not g.edge("n0", "n1").slow      # the tier peak
+        assert g.edge("n1", "n0").slow          # active, 5% of peak
+        assert not g.edge("n2", "n3").slow      # healthy peer
+        assert not g.edge("n3", "n2").slow      # idle: no evidence
+        assert g.edge("n1", "n0").suspect
+        assert not g.edge("n1", "n0").degraded
+        assert g.signature() == ()
+        # ...but it does shape cost and the placement penalty.
+        assert g.leg_cost_s("n1", "n0", 1 << 20) > \
+            g.leg_cost_s("n0", "n1", 1 << 20)
+        assert g.node_health()["n1"]["degraded_links"] == 1
+
+    def test_rates_below_trust_floor_are_not_evidence(self):
+        g = _graph(rates=lambda a, b: 512.0)  # everything "active" low
+        assert not any(g.edge(a, b).slow for a in g.nodes()
+                       for b in g.nodes() if a != b)
+
+    def test_node_health_rollup(self):
+        g = _graph(faults=["node:n0<->node:n1:partition",
+                           "node:n2->node:n3:latency:5"])
+        health = g.node_health()
+        assert health["n0"]["partitioned_links"] == 2  # both directions
+        assert health["n2"]["degraded_links"] == 1
+        assert health["n3"]["degraded_links"] == 1
+
+    def test_penalty_ordering(self):
+        assert PARTITIONED_LINK_PENALTY > DEGRADED_LINK_PENALTY > 0
+
+    def test_rack_major_order(self):
+        g = _graph(6, racks=2)
+        assert g.order() == ["n0", "n2", "n4", "n1", "n3", "n5"]
+
+
+# ---- chunk math ------------------------------------------------------------
+
+
+class TestPartition:
+    def test_even_split(self):
+        assert synth.partition(8, 4) == [(0, 2), (2, 2), (4, 2), (6, 2)]
+
+    def test_remainder_spreads_forward(self):
+        assert synth.partition(10, 4) == [(0, 3), (3, 3), (6, 2), (8, 2)]
+
+    def test_tiny_payload_yields_zero_chunks(self):
+        parts = synth.partition(2, 4)
+        assert [ln for _, ln in parts] == [1, 1, 0, 0]
+        assert sum(ln for _, ln in parts) == 2
+
+    def test_bus_factor_matches_bench_conventions(self):
+        assert synth.bus_factor("all_reduce", 8) == pytest.approx(2 * 7 / 8)
+        assert synth.bus_factor("all_gather", 8) == pytest.approx(7 / 8)
+        assert synth.bus_factor("reduce_scatter", 8) == pytest.approx(7 / 8)
+        assert synth.bus_factor("ppermute", 8) == 1.0
+
+
+# ---- synthesis -------------------------------------------------------------
+
+
+SHAPES = [(1, 2), (1, 3), (1, 4), (2, 4), (2, 6), (3, 6)]
+
+
+class TestSynthesis:
+    @pytest.mark.parametrize("racks,nodes", SHAPES)
+    @pytest.mark.parametrize("collective", synth.COLLECTIVES)
+    @pytest.mark.parametrize("algorithm", synth.ALGORITHMS)
+    def test_every_lowerable_schedule_is_simulation_correct(
+            self, racks, nodes, collective, algorithm):
+        g = _graph(nodes, racks=racks)
+        try:
+            sched = synth.synthesize(g, collective, 1000,
+                                     algorithm=algorithm)
+        except synth.SynthesisError:
+            pytest.skip("not lowerable for this shape")
+        inputs = synth.make_inputs(collective, sched.order, 1000, seed=7)
+        out = synth.simulate(sched, inputs)
+        expected = synth.expected_outputs(collective, sched.order,
+                                          inputs, 1000)
+        for name, (off, ln, want) in expected.items():
+            assert bytes(out[name][off:off + ln]) == want, \
+                f"{collective}/{algorithm} wrong on {name}"
+
+    def test_payload_smaller_than_node_count_still_correct(self):
+        g = _graph(4, racks=2)
+        for algorithm in synth.ALGORITHMS:
+            sched = synth.synthesize(g, "all_reduce", 3,
+                                     algorithm=algorithm)
+            inputs = synth.make_inputs("all_reduce", sched.order, 3)
+            out = synth.simulate(sched, inputs)
+            want = synth.expected_outputs("all_reduce", sched.order,
+                                          inputs, 3)
+            for name, (off, ln, exp) in want.items():
+                assert bytes(out[name][off:off + ln]) == exp
+
+    def test_hierarchical_guards(self):
+        with pytest.raises(synth.SynthesisError):
+            synth.synthesize(_graph(4, racks=1), "all_reduce", 1000,
+                             algorithm="hierarchical")
+        lopsided = build_specs(5, racks=2)  # 3 + 2 nodes
+        with pytest.raises(synth.SynthesisError):
+            synth.synthesize(_graph(specs=lopsided), "all_reduce",
+                             1000, algorithm="hierarchical")
+        with pytest.raises(synth.SynthesisError):
+            synth.synthesize(_graph(4, racks=2), "all_gather", 1000,
+                             algorithm="hierarchical")
+
+    def test_auto_choice_skips_unlowerable_candidates(self):
+        sched = synth.synthesize(_graph(4, racks=1), "all_reduce", 1000)
+        assert sched.algorithm in ("ring", "tree")
+        sched = synth.synthesize(_graph(4, racks=2), "all_gather", 1000)
+        assert sched.algorithm in ("ring", "tree")
+
+    def test_degraded_cross_rack_tier_selects_hierarchical(self):
+        g = _graph(4, racks=2,
+                   faults=["rack:r0<->rack:r1:latency:25"])
+        costs = {a: synth.synthesize(g, "all_reduce", 262144,
+                                     algorithm=a).est_cost_s
+                 for a in synth.ALGORITHMS}
+        assert costs["hierarchical"] < costs["ring"]
+        assert costs["hierarchical"] < costs["tree"]
+        assert synth.synthesize(g, "all_reduce",
+                                262144).algorithm == "hierarchical"
+
+    def test_uniform_fast_links_prefer_ring_for_large_payloads(self):
+        """With no slow tier the alpha terms wash out and ring's lower
+        per-node byte volume wins at large S — the cost model keeps a
+        genuine tradeoff, not a hierarchical hardcode."""
+        specs = build_specs(8, racks=1)
+        g = _graph(specs=specs)
+        big = 64 << 20
+        costs = {a: synth.synthesize(g, "all_reduce", big,
+                                     algorithm=a).est_cost_s
+                 for a in ("ring", "tree")}
+        assert costs["ring"] < costs["tree"]
+        assert synth.synthesize(g, "all_reduce", big).algorithm == "ring"
+
+    def test_cost_model_serializes_endpoint_fanin(self):
+        """A tree root receiving n-1 concurrent transfers pays their
+        SUM, not their max — root contention is the whole reason tree
+        loses at scale."""
+        g = _graph(4, racks=1)
+        sched = synth.synthesize(g, "all_reduce", 1 << 20,
+                                 algorithm="tree")
+        up_group = sched.steps[0]
+        single = g.leg_cost_s(up_group[0].src, up_group[0].dst,
+                              up_group[0].nbytes)
+        assert synth.estimate_cost_s(g, [up_group]) == pytest.approx(
+            3 * single)
+
+    def test_partitioned_graph_prices_infinite_but_still_plans(self):
+        g = _graph(4, racks=2,
+                   faults=["rack:r0<->rack:r1:partition"])
+        sched = synth.synthesize(g, "all_reduce", 4096)
+        assert sched.est_cost_s == float("inf")
+        assert sched.to_dict()["est_cost_ms"] is None
+
+    def test_schedule_to_dict_is_json_clean(self):
+        sched = synth.synthesize(_graph(4, racks=2), "all_reduce", 4096)
+        assert json.dumps(sched.to_dict())
+
+    def test_synthesizer_caches_until_signature_moves(self):
+        topo = FleetTopology(build_specs(4, racks=2))
+        links = LinkTable(topo)
+        build = lambda: CommGraph.build(  # noqa: E731
+            topo, links=links, rates=lambda a, b: 0.0)
+        s = synth.Synthesizer("all_reduce", 4096)
+        before = counters.get("collective.resynth")
+        first = s.schedule_for(build())
+        assert s.schedule_for(build()) is first
+        assert s.resynth_count == 0
+        assert counters.get("collective.resynth") == before
+
+        links.apply("rack:r0<->rack:r1:latency:25")
+        second = s.schedule_for(build())
+        assert second is not first
+        assert s.resynth_count == 1
+        assert counters.get("collective.resynth") == before + 1
+        assert s.current() is second
+
+        links.apply("rack:r0<->rack:r1:heal")
+        third = s.schedule_for(build())
+        assert third is not second
+        assert s.resynth_count == 2
+
+
+# ---- config ----------------------------------------------------------------
+
+
+def test_collective_config_from_scenario_drops_unknown_keys():
+    from container_engine_accelerators_tpu.collectives.runner import (
+        CollectiveConfig,
+    )
+
+    cfg = CollectiveConfig.from_scenario(
+        {"op": "all_gather", "bytes": 1234, "definitely_a_typo": 9})
+    assert cfg.op == "all_gather"
+    assert cfg.bytes == 1234
+    assert not hasattr(cfg, "definitely_a_typo")
+    assert CollectiveConfig.from_scenario(None).op == "all_reduce"
+
+
+# ---- runner over the in-process rig ----------------------------------------
+
+
+class TestRunner:
+    def _fleet(self, nodes=3, racks=1):
+        return FleetController({
+            "name": "engine-test", "nodes": nodes, "racks": racks,
+            "chips": 2, "topology": "1x2x1", "rounds": 0,
+            "metrics": False,
+        }).boot()
+
+    def _engine(self, ctl, **cfg_kw):
+        from container_engine_accelerators_tpu.collectives.runner import (
+            CollectiveConfig,
+            CollectiveEngine,
+        )
+
+        cfg_kw.setdefault("op", "all_reduce")
+        cfg_kw.setdefault("bytes", 8192)
+        return CollectiveEngine(ctl.nodes, ctl.topology,
+                                links=ctl.links,
+                                cfg=CollectiveConfig(**cfg_kw))
+
+    def test_round_moves_real_bytes_and_accounts_busbw(self):
+        ctl = self._fleet()
+        try:
+            engine = self._engine(ctl)
+            try:
+                before = counters.get("collective.transfers")
+                entry = engine.run_round(0)
+                assert entry["ok"], entry
+                assert entry["busbw_bps"] > 0
+                assert entry["algbw_bps"] > 0
+                assert entry["time_ms"] > 0
+                assert counters.get("collective.transfers") \
+                    == before + entry["transfers"]
+                # Every frame crossed the link table: the rig's links
+                # carry exactly the schedule's bytes.
+                delivered = sum(l["bytes"] for l
+                                in ctl.links.report().values())
+                assert delivered > 0
+                from container_engine_accelerators_tpu.obs import (
+                    timeseries,
+                )
+
+                gauges = timeseries.gauges()
+                assert gauges["collective.busbw_bps"] == pytest.approx(
+                    entry["busbw_bps"], rel=0.01)
+            finally:
+                engine.close()
+        finally:
+            ctl.close()
+
+    @pytest.mark.parametrize("collective", synth.COLLECTIVES)
+    def test_each_collective_verifies_on_the_wire(self, collective):
+        ctl = self._fleet()
+        try:
+            engine = self._engine(ctl, op=collective, bytes=4096)
+            try:
+                entry = engine.run_round(1)
+                assert entry["ok"], entry
+                assert entry["collective"] == collective
+            finally:
+                engine.close()
+        finally:
+            ctl.close()
+
+    def test_fault_resynthesizes_and_heal_recovers(self):
+        ctl = self._fleet(nodes=4, racks=2)
+        try:
+            engine = self._engine(ctl, bytes=16384)
+            try:
+                healthy = engine.run_round(0)
+                assert healthy["ok"] and healthy["resynth"] == 0
+
+                ctl.links.apply("rack:r0<->rack:r1:latency:25")
+                degraded = engine.run_round(1)
+                assert degraded["ok"]
+                assert degraded["resynth"] == 1
+                assert degraded["busbw_bps"] < healthy["busbw_bps"]
+
+                ctl.links.apply("rack:r0<->rack:r1:heal")
+                recovered = engine.run_round(2)
+                assert recovered["resynth"] == 1
+                assert recovered["busbw_bps"] > degraded["busbw_bps"]
+                assert engine.synth.resynth_count == 2
+            finally:
+                engine.close()
+        finally:
+            ctl.close()
+
+    def test_partition_fails_round_without_wedging(self):
+        ctl = self._fleet(nodes=4, racks=2)
+        try:
+            engine = self._engine(ctl, bytes=4096, leg_attempts=1,
+                                  leg_deadline_s=2.0,
+                                  land_timeout_s=0.5)
+            try:
+                ctl.links.apply("rack:r0<->rack:r1:partition")
+                failures0 = counters.get("collective.failures")
+                entry = engine.run_round(0)
+                assert not entry["ok"]
+                assert entry["error"]
+                assert entry["busbw_bps"] == 0.0
+                assert counters.get("collective.failures") > failures0
+                ctl.links.apply("rack:r0<->rack:r1:heal")
+                entry = engine.run_round(1)
+                assert entry["ok"], entry
+            finally:
+                engine.close()
+        finally:
+            ctl.close()
+
+
+# ---- whole-scenario e2e (slow: the tier-1 budget rule) ---------------------
+
+
+@pytest.mark.slow
+class TestCollectiveScenarios:
+    def test_builtin_scenario_degrades_resynthesizes_recovers(self):
+        report = run_scenario(dict(DEFAULT_COLLECTIVE_SCENARIO))
+        assert report["converged"]
+        assert report["slo"]["ok"]
+        assert report["collective"]["resynth"] >= 2
+        rounds = [leg for rnd in report["rounds"] for leg in rnd["legs"]
+                  if leg.get("workload") == "collective"]
+        assert all(r["ok"] for r in rounds)
+        # The fault is round 2 `for: 2`: degraded busbw must dip below
+        # the healthy rounds and recover by the end.
+        degraded = min(r["busbw_bps"] for r in rounds[2:4])
+        assert degraded < rounds[0]["busbw_bps"]
+        assert rounds[-1]["busbw_bps"] > degraded
+
+    def test_xrack_degrade_scenario_file_passes_its_slo(self):
+        from container_engine_accelerators_tpu.fleet.controller import (
+            load_scenario,
+        )
+
+        report = run_scenario(load_scenario(
+            "scenarios/collective_xrack_degrade.json"))
+        assert report["converged"]
+        assert report["slo"]["ok"], report["slo"]
+        assert report["collective"]["resynth"] >= 2
+
+    def test_proc_mode_collective_with_mirrored_fault(self):
+        report = run_scenario({
+            "name": "coll-proc", "proc": True,
+            "workload": "collective",
+            "nodes": 4, "racks": 2, "chips": 2, "topology": "1x2x1",
+            "rounds": 4, "payload_bytes": 16384,
+            "collective": {"op": "all_reduce", "bytes": 16384,
+                           "land_timeout_s": 6.0,
+                           "leg_deadline_s": 15.0},
+            "faults": [{"round": 1,
+                        "link": "rack:r0<->rack:r1:latency:25",
+                        "for": 2}],
+            "slo": {"min_final_busbw_bps": 10000},
+        })
+        assert report["converged"]
+        assert report["slo"]["ok"], report["slo"]
+        # The coordinator mirror gave the planner the fault evidence
+        # even though no frame routes through the coordinator table.
+        assert report["collective"]["resynth"] >= 2
+        rounds = [leg for rnd in report["rounds"]
+                  for leg in rnd["legs"]
+                  if leg.get("workload") == "collective"]
+        assert rounds[1]["busbw_bps"] < rounds[0]["busbw_bps"]
+
+    def test_compare_cli_hierarchical_beats_ring(self, capsys):
+        from container_engine_accelerators_tpu.collectives import runner
+
+        rc = runner.main([
+            "--compare", "--nodes", "4", "--racks", "2",
+            "--bytes", "65536", "--xrack-latency-ms", "25",
+            "--rounds", "2", "--margin", "1.2",
+        ])
+        assert rc == 0
+        verdict = json.loads(
+            capsys.readouterr().out.strip().splitlines()[-1])
+        assert verdict["pass"]
+        assert verdict["ratio"] >= 1.2

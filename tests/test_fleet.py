@@ -144,6 +144,47 @@ class TestFleetTopology:
         assert topo.tier("n0", "n2") == TIER_INTRA_RACK  # both r0
         assert topo.tier("n0", "n1") == TIER_CROSS_RACK
 
+    def test_node_coords_reach_the_production_distance(self):
+        """NodeSpec carries REAL mesh coords (the labels no longer
+        hardcode "0,0,0"): two hosts of one slice at different
+        coordinates are distinguishable to the production distance —
+        actual ICI torus hops, not an aliased zero."""
+        from container_engine_accelerators_tpu.scheduler import (
+            topology as sched_topo,
+        )
+
+        specs = build_specs(2, racks=1, topology="4x2x1")
+        specs[0].slice_id = specs[1].slice_id = "sliceX"
+        specs[0].coords = "0,0,0"
+        specs[1].coords = "2,0,0"
+        assert specs[1].labels()[sched_topo.COORDS_LABEL] == "2,0,0"
+        topo = FleetTopology(specs)
+        # 2 hops on the 4-wide torus axis — non-zero AND below the
+        # DCN floor, so the pair still classifies as ICI.
+        assert topo.distance("n0", "n1") == 2.0
+        assert topo.tier("n0", "n1") == TIER_ICI
+        # Farther coords cost more: the distance function actually
+        # discriminates between member hosts now.
+        specs[1].coords = "1,1,0"
+        assert FleetTopology(specs).distance("n0", "n1") == 2.0
+        specs[1].coords = "1,0,0"
+        assert FleetTopology(specs).distance("n0", "n1") == 1.0
+
+    def test_scenario_node_lists_carry_slice_and_coords(self):
+        """Explicit scenario node dicts pass slice/coords through to
+        the specs, so multi-host-slice fleets are declarable."""
+        from container_engine_accelerators_tpu.fleet.controller import (
+            _scenario_specs,
+        )
+
+        specs = _scenario_specs({"nodes": [
+            {"name": "h0", "slice": "s0", "coords": "0,0,0"},
+            {"name": "h1", "slice": "s0", "coords": "1,0,0"},
+        ]})
+        topo = FleetTopology(specs)
+        assert topo.distance("h0", "h1") == 1.0
+        assert topo.tier("h0", "h1") == TIER_ICI
+
 
 class TestLinkTable:
     def _table(self):

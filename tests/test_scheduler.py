@@ -542,3 +542,182 @@ def test_jobs_scheduled_fifo_by_creation_time():
     d.run_once()
     # Both fit (2 nodes); the older job must have been bound first.
     assert api.replaced[0][1] == "old-0"
+
+
+# ---- link-health annotations (collectives/topo.py -> the packer) -----------
+
+
+def _fleet_penalty(*faults, specs=None):
+    """A scheduler penalty built the production way: a fleet topology,
+    real link-table faults, a CommGraph snapshot."""
+    from container_engine_accelerators_tpu.collectives.topo import CommGraph
+    from container_engine_accelerators_tpu.fleet.links import LinkTable
+    from container_engine_accelerators_tpu.fleet.topology import (
+        FleetTopology,
+        NodeSpec,
+    )
+
+    specs = specs or [NodeSpec(name="a", rack="r0"),
+                      NodeSpec(name="b", rack="r0"),
+                      NodeSpec(name="c", rack="r1")]
+    fleet = FleetTopology(specs)
+    links = LinkTable(fleet)
+    for f in faults:
+        assert links.apply(f), f"fault {f!r} armed nothing"
+    graph = CommGraph.build(fleet, links=links, rates=lambda a, b: 0.0)
+    return graph.scheduler_link_penalty()
+
+
+def _two_pods():
+    return [
+        {"name": f"p-{i}", "namespace": "default", "index": str(i),
+         "cpu": 1.0, "memory": 1.0, "tpu": 4, "node_selector": None}
+        for i in range(2)
+    ]
+
+
+def test_assignment_avoids_node_behind_partitioned_link():
+    """Healthy fleet: the packer picks the same-rack pair (a, b).
+    With the a<->b fabric partitioned, the link-health annotation must
+    steer it onto a cross-rack pair instead — placement reacting to
+    the fault, not just the transfer plane."""
+    nodes = _infos([make_node("a", rack="r0"),
+                    make_node("b", rack="r0"),
+                    make_node("c", rack="r1")])
+    pods = _two_pods()
+    baseline = sched.calculate_pods_assignment(nodes, pods,
+                                               search_budget_s=None)
+    assert {nodes[i]["name"] for i in baseline} == {"a", "b"}
+
+    penalty = _fleet_penalty("node:a<->node:b:partition")
+    steered = sched.calculate_pods_assignment(
+        nodes, pods, search_budget_s=None, link_penalty=penalty)
+    chosen = {nodes[i]["name"] for i in steered}
+    assert "c" in chosen and chosen != {"a", "b"}
+
+
+def test_assignment_avoids_node_behind_lossy_link():
+    """Degraded (not partitioned) links steer the same way: loss
+    injection on the a<->b pair prices it above a healthy cross-rack
+    placement."""
+    nodes = _infos([make_node("a", rack="r0"),
+                    make_node("b", rack="r0"),
+                    make_node("c", rack="r1")])
+    penalty = _fleet_penalty("node:a<->node:b:drop:5")
+    steered = sched.calculate_pods_assignment(
+        nodes, _two_pods(), search_budget_s=None, link_penalty=penalty)
+    chosen = {nodes[i]["name"] for i in steered}
+    assert "c" in chosen and chosen != {"a", "b"}
+
+
+def test_assignment_degrades_to_least_bad_when_nothing_healthy():
+    """A penalty is finite, never a veto: when every candidate pair
+    sits behind a partitioned link, the packer still returns the
+    least-bad assignment — capacity over purity (and the graceful
+    fallback the annotation source documents)."""
+    from container_engine_accelerators_tpu.fleet.topology import NodeSpec
+
+    nodes = _infos([make_node("a", rack="r0"),
+                    make_node("b", rack="r0")])
+    penalty = _fleet_penalty(
+        "node:a<->node:b:partition",
+        specs=[NodeSpec(name="a", rack="r0"),
+               NodeSpec(name="b", rack="r0")])
+    assignment = sched.calculate_pods_assignment(
+        nodes, _two_pods(), search_budget_s=None, link_penalty=penalty)
+    assert {nodes[i]["name"] for i in assignment} == {"a", "b"}
+
+
+def test_assignment_unknown_hosts_cost_nothing():
+    """Candidates the fleet has never heard of (a real cluster's other
+    nodes) are not penalized — the annotation source only ever ADDS
+    evidence it actually has."""
+    penalty = _fleet_penalty("node:a<->node:b:partition")
+    stranger = {"node_labels": {topology.HOST_LABEL: "zz-unknown"}}
+    known = {"node_labels": {topology.HOST_LABEL: "a"}}
+    assert penalty(stranger, known) == 0.0
+    assert penalty(stranger, stranger) == 0.0
+
+
+def test_scheduler_daemon_binds_around_partitioned_link():
+    """The fake-API end-to-end: a SchedulerDaemon armed with the
+    link-health source binds the job AROUND the node behind the
+    partitioned fabric."""
+    nodes = [make_node("a", rack="r0"), make_node("b", rack="r0"),
+             make_node("c", rack="r1")]
+    pods = [make_pod("j-0", index=0), make_pod("j-1", index=1)]
+    api = FakeCoreV1(nodes, pods)
+    d = sched.SchedulerDaemon(
+        api, settle_s=0, sleep=lambda *_: None,
+        link_penalty=_fleet_penalty("node:a<->node:b:partition"))
+    assert d.run_once() == 2
+    bound = set()
+    for (_, name) in api.replaced:
+        pod = api.pods[("default", name)]
+        terms = pod["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"][
+            "nodeSelectorTerms"]
+        bound.add(terms[0]["matchExpressions"][0]["values"][0])
+    assert "c" in bound and bound != {"a", "b"}
+
+
+def test_scheduler_daemon_healthy_fleet_unchanged_by_annotations():
+    """With no faults armed the annotation source is a no-op: the
+    daemon makes the same placement it would have made bare."""
+    nodes = [make_node("a", rack="r0"), make_node("b", rack="r0"),
+             make_node("c", rack="r1")]
+    pods = [make_pod("j-0", index=0), make_pod("j-1", index=1)]
+    api = FakeCoreV1(nodes, pods)
+    d = sched.SchedulerDaemon(api, settle_s=0, sleep=lambda *_: None,
+                              link_penalty=_fleet_penalty())
+    assert d.run_once() == 2
+    bound = set()
+    for (_, name) in api.replaced:
+        pod = api.pods[("default", name)]
+        terms = pod["spec"]["affinity"]["nodeAffinity"][
+            "requiredDuringSchedulingIgnoredDuringExecution"][
+            "nodeSelectorTerms"]
+        bound.add(terms[0]["matchExpressions"][0]["values"][0])
+    assert bound == {"a", "b"}
+
+
+def test_live_penalty_sees_faults_armed_between_passes():
+    """A bare scheduler_link_penalty() closure is a frozen snapshot;
+    LinkHealthPenalty re-snapshots the link table, so a fault armed
+    AFTER the daemon was constructed steers the next pass — the
+    placement-reacts-to-faults contract for a long-lived daemon."""
+    from container_engine_accelerators_tpu.collectives.topo import (
+        LinkHealthPenalty,
+    )
+    from container_engine_accelerators_tpu.fleet.links import LinkTable
+    from container_engine_accelerators_tpu.fleet.topology import (
+        FleetTopology,
+        NodeSpec,
+    )
+
+    fleet = FleetTopology([NodeSpec(name="a", rack="r0"),
+                           NodeSpec(name="b", rack="r0"),
+                           NodeSpec(name="c", rack="r1")])
+    links = LinkTable(fleet)
+    penalty = LinkHealthPenalty(fleet, links,
+                                rates=lambda a, b: 0.0, refresh_s=0)
+    nodes = _infos([make_node("a", rack="r0"),
+                    make_node("b", rack="r0"),
+                    make_node("c", rack="r1")])
+    healthy = sched.calculate_pods_assignment(
+        nodes, _two_pods(), search_budget_s=None, link_penalty=penalty)
+    assert {nodes[i]["name"] for i in healthy} == {"a", "b"}
+
+    # The fault arms AFTER the penalty object exists — the next pass
+    # must see it.
+    links.apply("node:a<->node:b:partition")
+    steered = sched.calculate_pods_assignment(
+        nodes, _two_pods(), search_budget_s=None, link_penalty=penalty)
+    chosen = {nodes[i]["name"] for i in steered}
+    assert "c" in chosen and chosen != {"a", "b"}
+
+    # ...and the heal steers it back.
+    links.apply("node:a<->node:b:heal")
+    healed = sched.calculate_pods_assignment(
+        nodes, _two_pods(), search_budget_s=None, link_penalty=penalty)
+    assert {nodes[i]["name"] for i in healed} == {"a", "b"}
