@@ -310,6 +310,22 @@ race:
 	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
 	    --check $(RACE_REPORT)
 
+# Continuous soak gate: the composed-workload world (fleet/soak.py) —
+# the sentinel/schedule/resource-RPC suite (the short e2e soak rides
+# under -m slow there), then one CI-bounded CLI soak: serving +
+# collective + pipelined exchange CONCURRENTLY on a 3-node proc fleet,
+# faults from the seeded schedule (the deterministic prologue
+# guarantees >= 1 SIGKILL/respawn, >= 1 grey window, >= 1 heal even at
+# this duration), tuner + profiler on, invariant sentinels judging the
+# whole run.  Exit contract: 0 clean, 2 never re-converged, 3 an
+# invariant sentinel or SLO breached — either non-zero fails the gate.
+# This gate is the standing evidence behind TPU_DCN_TUNE defaulting ON.
+.PHONY: soak
+soak:
+	$(PY) -m pytest tests/test_soak.py -q -p no:randomly
+	$(PY) cmd/fleet_soak.py \
+	    --scenario scenarios/soak_ci.json > /dev/null
+
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests
 	bash build/check_boilerplate.sh
@@ -321,6 +337,7 @@ presubmit:
 	$(MAKE) collectives
 	$(MAKE) tune
 	$(MAKE) prof
+	$(MAKE) soak
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to
 # BENCH_TPU_LOG.jsonl). Each stage is independent; failures don't stop

@@ -1,0 +1,174 @@
+#!/usr/bin/env python3
+"""Run the continuous soak world and print the sentinel verdict.
+
+The long-horizon companion of cmd/fleet_sim.py: one proc-mode fleet,
+serving + collective + pipelined-exchange traffic CONCURRENTLY every
+window, the per-destination tuner and continuous profiler on, faults
+drawn from a seeded reproducible schedule (SIGKILL/respawn, grey
+slow-not-dead nodes, link latency/drop — each with a scheduled heal),
+and the invariant sentinels judging the WHOLE run: counter
+monotonicity across worker generations, leak slopes on
+fds/threads/shm/rss, tuner convergence after each heal, and the
+windowed SLO table.
+
+Usage:
+  python cmd/fleet_soak.py                       # default world,
+                                                 # ~45 s wall clock
+  python cmd/fleet_soak.py --duration 20         # CI-bounded
+  python cmd/fleet_soak.py --duration 14400      # the actual soak
+  python cmd/fleet_soak.py --seed 99             # a different chaos
+                                                 # tape (same seed =
+                                                 # same schedule)
+  python cmd/fleet_soak.py --scenario soak.json  # declarative spec
+  python cmd/fleet_soak.py --slo max_dedup_ratio=0.5
+
+Prints human-readable window/sentinel tables to stderr and one JSON
+report line to stdout (the repo's CLI contract).  Exit code: 0 clean;
+2 when the fleet never re-converged; 3 when it converged but an
+invariant sentinel or SLO breached — a soak that "works" while
+leaking fds must fail CI, not just dent a dashboard.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from container_engine_accelerators_tpu.fleet.controller import (  # noqa: E402
+    load_scenario,
+)
+from container_engine_accelerators_tpu.fleet.proc import (  # noqa: E402
+    ProcHandshakeError,
+)
+from container_engine_accelerators_tpu.fleet.soak import (  # noqa: E402
+    exit_code_for,
+    run_soak,
+)
+from container_engine_accelerators_tpu.fleet.telemetry import (  # noqa: E402
+    SLO_KEYS,
+)
+from container_engine_accelerators_tpu.obs import trace  # noqa: E402
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenario", default=None,
+                   help="scenario file (JSON, or YAML with .yaml/.yml) "
+                        "merged over the built-in soak world")
+    p.add_argument("--duration", type=float, default=None,
+                   metavar="SECONDS",
+                   help="wall-clock budget (default 45; hours for a "
+                        "real soak)")
+    p.add_argument("--window", type=float, default=None,
+                   metavar="SECONDS",
+                   help="window cadence: one fault draw + one composed "
+                        "traffic burst + one sentinel sample per window "
+                        "(default 2)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="fault-schedule seed; the same seed replays "
+                        "the same chaos (default 1234)")
+    p.add_argument("--nodes", type=int, default=None,
+                   help="override node count")
+    p.add_argument("--slo", action="append", default=[],
+                   metavar="KEY=VALUE",
+                   help="add/override one SLO (repeatable); breach "
+                        "exits 3")
+    p.add_argument("--trace-file", default=None,
+                   help="write the run's span JSONL here "
+                        "(summarize with cmd/agent_trace.py)")
+    return p.parse_args(argv)
+
+
+def _print_report(report, file=sys.stderr):
+    soak = report.get("soak", {})
+    sentinels = soak.get("sentinels", {})
+    print(f"scenario: {report['scenario']}  seed: {soak.get('seed')}  "
+          f"windows: {soak.get('windows')}  "
+          f"duration: {soak.get('duration_s')}s  "
+          f"converged: {report['converged']}", file=file)
+    print(f"chaos: kills={soak.get('kills')} greys={soak.get('greys')} "
+          f"heals={soak.get('heals')} "
+          f"heal_windows={soak.get('heal_windows')}", file=file)
+    nodes = report["nodes"]
+    width = max([len(n) for n in nodes] + [4])
+    print(f"\n{'node':<{width}} {'rack':>6} {'healthy':>8} {'gen':>4} "
+          f"{'legs_ok':>8} {'legs_failed':>12} {'down':>5}", file=file)
+    for name, n in sorted(nodes.items()):
+        print(f"{name:<{width}} {n['rack']:>6} "
+              f"{n['healthy']}/{n['total']:>4} "
+              f"{n['daemon_generation']:>4} {n['legs_ok']:>8} "
+              f"{n['legs_failed']:>12} {str(n['down']):>5}", file=file)
+    print(f"\n{'sentinel':<14} {'ok':>4}  detail", file=file)
+    for key in ("monotonicity", "leaks", "tuner"):
+        s = sentinels.get(key, {})
+        if key == "monotonicity":
+            detail = f"{len(s.get('violations', []))} violation(s)"
+        elif key == "leaks":
+            detail = f"{len(s.get('breaches', []))} breach(es) over " \
+                     f"{len(s.get('series', {}))} series"
+        else:
+            detail = s.get("reason", "")
+        print(f"{key:<14} {'ok' if s.get('ok') else 'FAIL':>4}  "
+              f"{detail}", file=file)
+    for key in ("monotonicity",):
+        for v in sentinels.get(key, {}).get("violations", [])[:8]:
+            print(f"  violation: {v}", file=file)
+    for b in sentinels.get("leaks", {}).get("breaches", [])[:8]:
+        print(f"  leak: {b}", file=file)
+    slo = report.get("slo") or {}
+    if slo.get("checks"):
+        print(f"\n{'slo':<22} {'kind':>8} {'limit':>12} {'value':>12} "
+              f"{'ok':>4}", file=file)
+        for c in slo["checks"]:
+            print(f"{c['slo']:<22} {c['kind']:>8} {c['limit']:>12g} "
+                  f"{c['value']:>12g} {'ok' if c['ok'] else 'FAIL':>4}",
+                  file=file)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    scenario = {}
+    if args.scenario:
+        scenario = dict(load_scenario(args.scenario))
+    if args.nodes is not None:
+        scenario["nodes"] = args.nodes
+    if args.slo:
+        # An --slo the OPERATOR typed is an explicit CI gate: a typo'd
+        # key must fail the invocation, not silently evaluate zero
+        # checks and exit 0 (the fleet_sim rule).
+        slo = scenario.get("slo")
+        slo = dict(slo) if isinstance(slo, dict) else {}
+        for entry in args.slo:
+            key, sep, value = entry.partition("=")
+            if not sep or key not in SLO_KEYS:
+                print(f"bad --slo {entry!r}: want KEY=VALUE with KEY "
+                      f"one of {', '.join(sorted(SLO_KEYS))}",
+                      file=sys.stderr)
+                return 2
+            slo[key] = value
+        scenario["slo"] = slo
+    if args.trace_file:
+        trace.configure(args.trace_file)
+
+    try:
+        report = run_soak(scenario or None,
+                          duration_s=args.duration,
+                          window_s=args.window,
+                          seed=args.seed)
+    except ProcHandshakeError as e:
+        print(f"fleet boot failed: {e}", file=sys.stderr)
+        if args.trace_file:
+            trace.configure(None)
+        return 2
+
+    _print_report(report)
+    print(json.dumps(report))
+    if args.trace_file:
+        trace.configure(None)  # flush/close the sink
+    return exit_code_for(report)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

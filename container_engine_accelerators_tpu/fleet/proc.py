@@ -354,6 +354,25 @@ class ProcNode:
                              action=action, param=float(param),
                              host=host).get("applied", 0))
 
+    def resources(self) -> Dict[str, int]:
+        """The worker's resource census (fds / threads / shm segments
+        / rss) for the soak leak sentinel.  Raises OSError on a dark
+        worker — unlike :meth:`snapshot` there is no cached fallback,
+        because a stale census would fake a flat (leak-free) series
+        for exactly as long as the worker is unobservable."""
+        return dict(self._rpc("resources").get("resources", {}))
+
+    def burn_cpu(self, seconds: float = 1.0) -> float:
+        """Arm the grey-failure CPU burn: the worker spins a core for
+        ``seconds`` (capped worker-side) — slow, not dead."""
+        return float(self._rpc("burn",
+                               seconds=float(seconds)).get(
+                                   "burning_s", 0.0))
+
+    def stop_burn(self) -> None:
+        """Disarm any in-flight CPU burn (the grey fault's heal)."""
+        self._rpc("burn_stop")
+
     def device_health(self) -> Dict[str, str]:
         return dict(self.snapshot().get("devices", {}))
 
@@ -493,6 +512,78 @@ def _emit(out, obj: dict) -> None:
     out.flush()
 
 
+def _resource_snapshot(shm_dir: Optional[str] = None) -> dict:
+    """Process-local resource census for the soak leak sentinel: open
+    fds (``/proc/self/fd``), OS thread count, shm segment files under
+    the daemon's segment dir, and resident set size.  Every probe
+    degrades to 0 instead of raising — a worker mid-teardown must
+    still answer its supervisor."""
+    snap = {"fds": 0, "threads": 0, "shm_segments": 0, "rss_bytes": 0}
+    try:
+        snap["fds"] = len(os.listdir("/proc/self/fd"))
+    except OSError:
+        pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("Threads:"):
+                    snap["threads"] = int(line.split()[1])
+                    break
+    except (OSError, ValueError, IndexError):
+        snap["threads"] = threading.active_count()
+    if not snap["threads"]:
+        snap["threads"] = threading.active_count()
+    if shm_dir:
+        try:
+            snap["shm_segments"] = len(os.listdir(shm_dir))
+        except OSError:
+            pass
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        snap["rss_bytes"] = pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    return snap
+
+
+# Grey-failure CPU burn: a daemon thread spinning until its deadline
+# or until ``_stop_burn`` bumps the epoch — the "slow, not dead" half
+# of the soak world's grey fault (the other half is outbound link
+# latency via the PyXferd shim).  Bounded so a lost ``burn_stop`` can
+# never wedge a worker past the fault window it was armed for.
+MAX_BURN_S = 30.0
+_burn_lock = threading.Lock()
+_burn_epoch = 0
+
+
+def _start_burn(seconds: float) -> float:
+    seconds = max(0.0, min(float(seconds), MAX_BURN_S))
+    with _burn_lock:
+        epoch = _burn_epoch
+
+    def _spin():
+        deadline = time.monotonic() + seconds
+        x = 1
+        while time.monotonic() < deadline:
+            with _burn_lock:
+                if _burn_epoch != epoch:
+                    return
+            for _ in range(20000):
+                x = (x * 1103515245 + 12345) & 0x7FFFFFFF
+
+    threading.Thread(target=_spin, name="grey-burn",
+                     daemon=True).start()
+    return seconds
+
+
+def _stop_burn() -> int:
+    global _burn_epoch
+    with _burn_lock:
+        _burn_epoch += 1
+        return _burn_epoch
+
+
 def _serve(node, out) -> None:
     """The worker's RPC loop: newline-JSON requests on stdin, one
     response line each on stdout.  EOF means the coordinator is gone
@@ -531,6 +622,14 @@ def _serve(node, out) -> None:
                     req.get("host", "127.0.0.1"), int(req["port"]),
                     req.get("action", ""),
                     float(req.get("param", 0.0)))
+            elif op == "resources":
+                resp["resources"] = _resource_snapshot(
+                    getattr(node.daemon, "shm_dir", None))
+            elif op == "burn":
+                resp["burning_s"] = _start_burn(
+                    float(req.get("seconds", 1.0)))
+            elif op == "burn_stop":
+                resp["epoch"] = _stop_burn()
             elif op == "shutdown":
                 _emit(out, resp)
                 return

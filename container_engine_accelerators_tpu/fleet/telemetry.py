@@ -320,6 +320,12 @@ class FleetTelemetry:
         # reset to zero on respawn, so the fleet totals sum increments
         # between scrapes, treating a decrease as a fresh process.
         self._accum: Dict[str, Dict[str, float]] = {}
+        # Same-generation counter DECREASES — dropped from the totals
+        # (see _accumulate) but recorded here, because a worker whose
+        # cumulative counters go backwards without a respawn is a
+        # monotonicity violation the soak sentinel must see, not just
+        # a sample to silently skip.
+        self.misreads: List[dict] = []
         self._t0 = time.monotonic()
         # Histograms are process-global and cumulative; the p99 SLOs
         # must judge THIS run only, so snapshot their buckets at boot
@@ -715,7 +721,13 @@ class FleetTelemetry:
             # this can only be a misread (e.g. the scrape raced the
             # exporter's periodic registry reset).  Folding it in
             # would double-count the pre-reset total on the next
-            # scrape — drop the sample and keep the last-known state.
+            # scrape — drop the sample and keep the last-known state,
+            # but RECORD the event: the soak monotonicity sentinel
+            # treats a same-generation decrease as a verdict input.
+            self.misreads.append({
+                "node": node, "key": key,
+                "last": last, "current": current, "gen": gen,
+            })
             return
         elif current < last:
             # No incarnation evidence but the counter went DOWN:

@@ -217,6 +217,25 @@ def snapshot(window_s: Optional[float] = None,
     }
 
 
+def least_squares_slope(points) -> float:
+    """Ordinary-least-squares slope of ``(x, y)`` pairs — the windowed
+    trend gate behind the soak world's leak sentinel (fleet/soak.py):
+    a resource series whose fitted slope exceeds its per-window budget
+    is a leak, whatever its instantaneous wobble.  Fewer than two
+    points, or zero x-variance, judge nothing and return 0.0."""
+    pts = [(float(x), float(y)) for x, y in points]
+    n = len(pts)
+    if n < 2:
+        return 0.0
+    mean_x = sum(x for x, _ in pts) / n
+    mean_y = sum(y for _, y in pts) / n
+    var_x = sum((x - mean_x) ** 2 for x, _ in pts)
+    if var_x <= 0.0:
+        return 0.0
+    cov = sum((x - mean_x) * (y - mean_y) for x, y in pts)
+    return cov / var_x
+
+
 def reset() -> None:
     """Drop every series and gauge — test isolation only, same contract
     as counters.reset()."""

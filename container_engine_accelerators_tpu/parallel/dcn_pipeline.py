@@ -164,9 +164,9 @@ class PipelineConfig:
                      if ring is None else bool(ring))
         # Closed-loop grid control (parallel/dcn_tune.py): the
         # configured chunk/stripe grid becomes the controller's BASE,
-        # adapted per destination from its own telemetry.  Off (the
-        # TPU_DCN_TUNE kill switch, and the default) the static grid
-        # runs byte-for-byte.
+        # adapted per destination from its own telemetry.  ON by
+        # default (the soak world gates the loop); TPU_DCN_TUNE=0 is
+        # the kill switch pinning the static grid byte-for-byte.
         self.tuned = (dcn_tune.tune_enabled(env) if tuned is None
                       else bool(tuned))
 

@@ -51,16 +51,24 @@ shm rounds have no stripe fan-out at all: they bypass stripe
 adaptation and keep chunk adaptation, exactly as the lane bypasses the
 stager threads.
 
-``TPU_DCN_TUNE`` is the kill switch: off, ``tuner_for`` returns None
-and the pipeline runs today's static grid byte-for-byte.  Learned
-state never survives a daemon respawn by construction — a restarted
-daemon binds a fresh data port, which is a fresh controller key; the
-stale key ages out of the bounded registry.
+``TPU_DCN_TUNE`` is the kill switch — and the loop is ON by default
+now that the continuous soak world (fleet/soak.py) gates every
+presubmit on its convergence: ``TPU_DCN_TUNE=0`` still pins today's
+static grid byte-for-byte.  Learned state never survives a daemon
+respawn by construction — a restarted daemon binds a fresh data port,
+which is a fresh controller key; the stale key ages out of the
+bounded registry.
 
 Decisions are observable like everything else in this stack:
 ``dcn.tune.*`` counters per decision kind, ``dcn.tune.chunk_bytes`` /
-``dcn.tune.stripes`` gauges carrying the latest plan, and an
-``agent_top`` tuner line.
+``dcn.tune.stripes`` gauges carrying the latest plan, an ``agent_top``
+tuner line, and a bounded per-tuner decision HISTORY
+(:func:`decision_history`) that the soak world's oscillation sentinel
+replays.  The profiler bridge is observation-only: each observation
+records the ``shm-staging`` subsystem share next to goodput, and the
+``dcn.tune.cpu_bound`` gauge flips to 1.0 when staging share grows
+while goodput stalls — the host is the bottleneck, so no grid move
+can help and the tuner (deliberately) takes none.
 """
 
 import logging
@@ -87,13 +95,43 @@ DEFAULT_MAX_STRIPES = 8
 # gone or idle; a fresh key relearns from the static grid).
 MAX_TUNERS = 64
 
+# Bounded per-tuner decision history: every observation appends one
+# entry (decision or None), the soak world's oscillation sentinel
+# replays the tail, and the cap keeps a days-long soak from turning
+# the controller into a leak of its own.
+MAX_HISTORY = 512
+
+# The profiler bridge verdict (observation-only): ``cpu_bound`` means
+# staging share grew at least this much while goodput failed to beat
+# the last observation by more than scheduling slack — evidence the
+# HOST, not the link, is the bottleneck, so no grid move can help.
+CPU_BOUND_SHARE_STEP = 0.05
+CPU_BOUND_GOODPUT_SLACK = 1.02
+
+
+def _profiler_staging_share() -> Optional[float]:
+    """The ``shm-staging`` subsystem share from the in-process
+    profiler, or None when the profiler is not running — the default
+    observation source for the tuner's cpu-bound verdict.  Injectable
+    per tuner for tests (and for the soak driver's synthetic rigs)."""
+    from container_engine_accelerators_tpu.obs import profiler
+    if not profiler.running():
+        return None
+    try:
+        return float(profiler.subsystem_shares().get("shm-staging",
+                                                     0.0))
+    except Exception:  # pragma: no cover - defensive: never block a plan
+        return None
+
 
 def tune_enabled(env=None) -> bool:
-    """The kill switch.  Default OFF: absent, the pipeline is today's
-    static grid exactly — flipping it on is one env var, and the fleet
-    scenario / bench prove the closed loop before the default moves."""
+    """The kill switch.  Default ON: the continuous soak world
+    (fleet/soak.py, ``make soak``) is the standing evidence the loop
+    converges and never limit-cycles under mixed load, so absent the
+    env var the closed loop runs.  ``TPU_DCN_TUNE=0`` (or any falsy
+    spelling, including explicitly empty) pins the static grid."""
     env = env if env is not None else os.environ
-    return env.get(TUNE_ENV, "0") not in ("0", "false", "off", "")
+    return env.get(TUNE_ENV, "1") not in ("0", "false", "off", "")
 
 
 class TuneConfig:
@@ -168,10 +206,23 @@ class FlowTuner:
     touches a socket, which is what makes the decision table unit-
     testable row by row."""
 
-    def __init__(self, key: str, cfg: Optional[TuneConfig] = None):
+    def __init__(self, key: str, cfg: Optional[TuneConfig] = None,
+                 staging_share=None):
         self.key = key
         self.cfg = cfg or TuneConfig()
         self._lock = threading.Lock()
+        # Profiler bridge (observation-only): a zero-arg callable
+        # returning the staging-memcpy subsystem share, or None when
+        # unknown.  Injectable so the verdict is unit-testable without
+        # a live profiler.
+        self._staging_share = (staging_share if staging_share
+                               is not None else _profiler_staging_share)
+        self._last_share: Optional[float] = None
+        self._last_goodput: Optional[float] = None
+        self._cpu_bound = False
+        # Bounded observation log for the oscillation sentinel.
+        self._history: list = []
+        self._decisions = 0
         # Learned grid deltas, applied to the caller's base grid:
         # chunk_scale is a power-of-two divisor (1 = the base grid),
         # stripe_delta an additive offset.  Keeping deltas instead of
@@ -285,6 +336,12 @@ class FlowTuner:
     def _observe(self, retx: float, goodput: float,
                  exposed: Optional[float], lane: str,
                  full: bool = True) -> Optional[str]:
+        # Profiler read OUTSIDE the lock: the provider may sample
+        # /proc or walk frames — never under the decision lock.
+        try:
+            share = self._staging_share()
+        except Exception:
+            share = None
         with self._lock:
             self.observations += 1
             self._since_move += 1
@@ -293,6 +350,32 @@ class FlowTuner:
             decision = self._decide_locked(retx, goodput, exposed,
                                            lane, full)
             chunk, stripes = self._plan_locked()
+            # cpu-bound verdict (observation-only, never a decision
+            # input): staging share grew while goodput stalled — the
+            # host is the bottleneck, no grid move can help.
+            if (share is not None and self._last_share is not None
+                    and self._last_goodput is not None):
+                self._cpu_bound = (
+                    share > self._last_share + CPU_BOUND_SHARE_STEP
+                    and goodput <= (self._last_goodput
+                                    * CPU_BOUND_GOODPUT_SLACK))
+            if share is not None:
+                self._last_share = share
+            self._last_goodput = goodput
+            cpu_bound = self._cpu_bound
+            if decision:
+                self._decisions += 1
+            self._history.append({
+                "obs": self.observations,
+                "decision": decision,
+                "retx": round(retx, 4),
+                "goodput_bps": round(goodput, 1),
+                "staging_share": (round(share, 4)
+                                  if share is not None else None),
+                "chunk_bytes": chunk,
+                "stripes": stripes,
+            })
+            del self._history[:-MAX_HISTORY]
         if decision:
             counters.inc(f"dcn.tune.{decision}")
             trace.event("dcn.tune.decision", key=self.key,
@@ -304,6 +387,8 @@ class FlowTuner:
                      decision, chunk, stripes, retx, goodput)
         timeseries.gauge("dcn.tune.chunk_bytes", float(chunk))
         timeseries.gauge("dcn.tune.stripes", float(stripes))
+        timeseries.gauge("dcn.tune.cpu_bound",
+                         1.0 if cpu_bound else 0.0)
         return decision
 
     # -- the decision table (caller holds the lock) --------------------------
@@ -495,7 +580,17 @@ class FlowTuner:
                 "clean_streak": self._clean_streak,
                 "observations": self.observations,
                 "probing": self._probe is not None,
+                "decisions": self._decisions,
+                "cpu_bound": self._cpu_bound,
             }
+
+    def history(self) -> list:
+        """The bounded observation log — one entry per observation
+        (``decision`` is None when no law fired), newest last.  The
+        soak world's convergence sentinel replays this to tell a
+        settling controller from a limit cycle."""
+        with self._lock:
+            return [dict(e) for e in self._history]
 
 
 def _exposed_worse(now: Optional[float], before: Optional[float],
@@ -544,6 +639,15 @@ def snapshot() -> Dict[str, dict]:
     with _lock:
         items = list(_tuners.values())
     return {t.key: t.snapshot() for t in items}
+
+
+def decision_history() -> Dict[str, list]:
+    """Every live tuner's bounded observation log, keyed like
+    :func:`snapshot` — the export the soak oscillation sentinel (and
+    the soak report's tuner section) consumes."""
+    with _lock:
+        items = list(_tuners.values())
+    return {t.key: t.history() for t in items}
 
 
 def reset() -> None:

@@ -628,6 +628,7 @@ class TestFleetCriticalPath:
             "name": "critpath-smoke", "nodes": 2, "racks": 1,
             "chips": 2, "topology": "1x2x1", "rounds": 2,
             "payload_bytes": 262144, "pipelined": True,
+            "tuned": False,  # span-shape assertions want the static grid
             "chunk_bytes": 65536, "shm": False,
             "slo": {"max_exposed_comm_ratio": 1.0},
         })
@@ -693,7 +694,8 @@ class TestBenchAcceptance:
         try:
             payload = bytes(range(256)) * (4 * 1024 * 1024 // 256)
             cfg = db.dcn_pipeline.PipelineConfig(
-                chunk_bytes=1 << 20, stripes=2, shm=False)
+                chunk_bytes=1 << 20, stripes=2, shm=False,
+                tuned=False)
             serial = rig.one_way("serial", payload, cfg)
             pipelined = rig.one_way("pipelined", payload, cfg)
         finally:

@@ -36,7 +36,11 @@ FAST_RETRY = RetryPolicy(
 )
 
 # Small grid so tests exercise multi-chunk paths in milliseconds.
-CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2)
+# tuned=False: these suites assert the STATIC wire contract (exact
+# chunk grids, stripe counts, round budgets) — the closed loop is on
+# by default now and would adapt the grid mid-assert.
+CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                  tuned=False)
 PAYLOAD = bytes(range(256)) * 64  # 16 KiB == 4 chunks under CFG
 N = len(PAYLOAD)
 
@@ -94,7 +98,8 @@ class TestChunkPlan:
         chunk grid: the transfer still completes and burns at most
         MAX_CHUNKS_PER_TRANSFER seqs."""
         _a, b, ca, cb = pair
-        tiny = dcn_pipeline.PipelineConfig(chunk_bytes=16, stripes=2)
+        tiny = dcn_pipeline.PipelineConfig(chunk_bytes=16, stripes=2,
+                                           tuned=False)
         payload = bytes(range(256)) * 24  # 6144 B = 384 chunks of 16
         flow = _flow()
         cb.register_flow(flow, bytes=len(payload))
@@ -532,7 +537,8 @@ class TestLargeFrameShortWriteGuard:
         _a, b, ca, cb = pair
         payload = bytes(range(256)) * (self.MB6 // 256)
         cfg = dcn_pipeline.PipelineConfig(chunk_bytes=1 << 20,
-                                          stripes=2, shm=False)
+                                          stripes=2, shm=False,
+                                          tuned=False)
         flow = _flow("bigp")
         cb.register_flow(flow, bytes=len(payload))
         ca.register_flow(flow, bytes=len(payload))

@@ -53,10 +53,12 @@ FAST_RETRY = RetryPolicy(
     deadline_s=10.0,
 )
 
+# tuned=False throughout: the shm suites pin exact lane/chunk wire
+# behavior; the (now default-on) closed loop would adapt the grid.
 CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                  shm=True)
+                                  shm=True, tuned=False)
 CFG_SOCKET = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                         shm=False)
+                                         shm=False, tuned=False)
 PAYLOAD = bytes(range(256)) * 64  # 16 KiB == 4 chunks under CFG
 N = len(PAYLOAD)
 
@@ -491,7 +493,8 @@ class TestRingHandoff:
     def test_ring_kill_switch_runs_per_chunk_ops(self, pair):
         _a, b, ca, cb = pair
         cfg = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                          shm=True, ring=False)
+                                          shm=True, ring=False,
+                                          tuned=False)
         p0 = counters.get("dcn.shm.ring.posts")
         res = _roundtrip(ca, cb, b, cfg)
         assert res["lane"] == "shm"
@@ -557,7 +560,8 @@ class TestShmDirectLane:
     def test_direct_pin_off_rides_tcp(self, pair):
         _a, b, ca, cb = pair
         cfg = dcn_pipeline.PipelineConfig(
-            chunk_bytes=4096, stripes=2, shm=True, shm_direct=False)
+            chunk_bytes=4096, stripes=2, shm=True, shm_direct=False,
+            tuned=False)
         direct0 = _lane_total("shm_direct")
         socket0 = _lane_total("socket")
         res = _roundtrip(ca, cb, b, cfg)

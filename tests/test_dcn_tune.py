@@ -276,13 +276,19 @@ class TestDecisionTable:
 
 
 class TestKillSwitch:
-    def test_disabled_by_default(self):
-        assert not dcn_tune.tune_enabled(env={})
-        assert not dcn_pipeline.PipelineConfig(env={}).tuned
+    def test_enabled_by_default(self):
+        """The soak world (fleet/soak.py) is the standing evidence:
+        absent the env var, the closed loop is ON.  TPU_DCN_TUNE=0
+        remains the kill switch."""
+        assert dcn_tune.tune_enabled(env={})
+        assert dcn_pipeline.PipelineConfig(env={}).tuned
 
     def test_env_values(self):
         for raw in ("1", "true", "on", "yes"):
             assert dcn_tune.tune_enabled(env={dcn_tune.TUNE_ENV: raw})
+        # "" is EXPLICITLY-set-empty — still off: an operator that
+        # blanked the var asked for the static grid, default flip or
+        # not.
         for raw in ("0", "false", "off", ""):
             assert not dcn_tune.tune_enabled(
                 env={dcn_tune.TUNE_ENV: raw})

@@ -390,7 +390,10 @@ class TestFrameDedup:
 # bar holds on BOTH data lanes — the zero-copy same-host shm lane
 # (the default in the one-process rig) and the socket lane cross-host
 # deployments ride — so the chunk-chaos scenarios run once per lane.
-PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2)
+# tuned=False here and in LANE_CFGS: these scenarios assert the
+# static wire contract — the (now default-on) loop would adapt it.
+PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
+                                       tuned=False)
 PIPE_PAYLOAD = bytes(range(256)) * 64  # 16 KiB
 PIPE_N = len(PIPE_PAYLOAD)
 
@@ -401,9 +404,10 @@ LANE_CFGS = {
     # (doorbell response dies, completer lands anyway, retry dedups)
     # lives in tests/test_dcn_shm.py::TestRingHandoff.
     "shm": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                       shm=True, ring=False),
+                                       shm=True, ring=False,
+                                       tuned=False),
     "socket": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                          shm=False),
+                                          shm=False, tuned=False),
 }
 
 
@@ -567,6 +571,7 @@ class TestPipelinedChunkChaos:
             "rounds": 4,
             "payload_bytes": 32768,
             "pipelined": True,
+            "tuned": False,  # static-grid assertions below
             "chunk_bytes": 8192,
             "stripes": 2,
             "faults": [
@@ -594,6 +599,7 @@ class TestPipelinedChunkChaos:
             "rounds": 2,
             "payload_bytes": 16384,
             "pipelined": True,
+            "tuned": False,  # static-grid assertions below
             "chunk_bytes": 8192,
             "stripes": 2,
             "shm": False,

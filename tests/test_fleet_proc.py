@@ -59,8 +59,10 @@ PIPE_N = len(PIPE_PAYLOAD)
 # (drop_response("send") has per-chunk ops to drop, round/seq
 # assertions match it).  The descriptor-ring + daemon↔daemon lane
 # get their own parity scenarios in TestProcShmDirectParity below.
+# tuned=False: these chaos suites assert exact chunk/round wire
+# behavior — the (now default-on) closed loop would adapt the grid.
 PIPE_CFG = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                       ring=False)
+                                       ring=False, tuned=False)
 
 # One spawn attempt, tiny backoff: failure tests must not sit through
 # the production respawn budget.
@@ -639,7 +641,7 @@ class TestProcScenarios:
         the socket lane on the SAME flow with exactly-once
         accounting."""
         cfg = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                          shm=True)
+                                          shm=True, tuned=False)
         a = _node(tmp_path, "na")
         b = _node(tmp_path, "nb")
         try:
@@ -714,7 +716,8 @@ class TestProcScenarios:
         lands byte-exact exactly once — the respawned daemon is
         re-probed, never trusted stale."""
         cfg = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                          shm=True, shm_direct=True)
+                                          shm=True, shm_direct=True,
+                                          tuned=False)
         a = _node(tmp_path, "na")
         b = _node(tmp_path, "nb")
         try:
@@ -763,7 +766,8 @@ class TestProcScenarios:
         receiver WORKER's dedup window — exactly-once proven from its
         scraped counters, across real process boundaries."""
         cfg = dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                          shm=True, shm_direct=True)
+                                          shm=True, shm_direct=True,
+                                          tuned=False)
         a = _node(tmp_path, "na")
         b = _node(tmp_path, "nb")
         try:
