@@ -305,7 +305,7 @@ race:
 	    tests/test_fleet.py \
 	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
 	    tests/test_serving.py tests/test_profiler.py \
-	    tests/test_collective_engine.py \
+	    tests/test_collective_engine.py tests/test_history.py \
 	    -q -m "not slow" -p no:randomly
 	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
 	    --check $(RACE_REPORT)
@@ -326,6 +326,40 @@ soak:
 	$(PY) cmd/fleet_soak.py \
 	    --scenario scenarios/soak_ci.json > /dev/null
 
+# Run-history gate: the ledger durability suite (torn final line,
+# rotation generation, two-process concurrent append, malformed
+# TPU_HISTORY_DIR), baseline math, attributed verdicts — then the
+# seeded two-run regression fixture: three quiet runs plus one with a
+# planted p99 blow-up whose cpu_attr skews toward shm-staging;
+# agent_trend must exit 1 AND name the planted subsystem in the
+# attribution (a regression verdict without the "where" is half a
+# verdict).  Folded into presubmit.
+TREND_DIR := /tmp/tpu_trend_fixture
+
+.PHONY: trend
+trend:
+	$(PY) -m pytest tests/test_history.py -q -m "not slow" -p no:randomly
+	rm -rf $(TREND_DIR)
+	$(PY) -c "from container_engine_accelerators_tpu.obs import history; \
+	    led = history.RunLedger('$(TREND_DIR)'); \
+	    [led.record('fleet_serving', 'fleet-serving:n3', \
+	        {'p99_e2e_ms': 40.0 + i}, run_id='seed%d' % i, \
+	        cpu_attr={'serving': 0.7, 'shm-staging': 0.1, \
+	                  'dcn_pipeline': 0.2}, \
+	        dominant_phase='serve.batch') for i in range(3)]; \
+	    led.record('fleet_serving', 'fleet-serving:n3', \
+	        {'p99_e2e_ms': 95.0}, run_id='planted', \
+	        cpu_attr={'serving': 0.45, 'shm-staging': 0.35, \
+	                  'dcn_pipeline': 0.2}, \
+	        dominant_phase='dcn.chunk.stage')"
+	$(PY) cmd/agent_trend.py --dir $(TREND_DIR) \
+	    > /dev/null 2> $(TREND_DIR)/verdict.txt; rc=$$?; \
+	    [ $$rc -eq 1 ] || { cat $(TREND_DIR)/verdict.txt; \
+	        echo "trend gate: expected exit 1, got $$rc"; exit 1; }; \
+	    grep -q "shm-staging share +" $(TREND_DIR)/verdict.txt || { \
+	        cat $(TREND_DIR)/verdict.txt; \
+	        echo "trend gate: planted subsystem not named"; exit 1; }
+
 presubmit:
 	$(PY) -m compileall -q container_engine_accelerators_tpu cmd tests
 	bash build/check_boilerplate.sh
@@ -338,6 +372,7 @@ presubmit:
 	$(MAKE) tune
 	$(MAKE) prof
 	$(MAKE) soak
+	$(MAKE) trend
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to
 # BENCH_TPU_LOG.jsonl). Each stage is independent; failures don't stop

@@ -53,6 +53,7 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    history,
     profiler,
     promtext,
 )
@@ -309,6 +310,39 @@ def digest(fams: dict, prof: dict = None) -> dict:
             "exposed_ratio": dict(gauges).get("dcn.exposed_ratio")}
 
 
+def trend_lines(model: dict) -> list:
+    """One trend verdict line per headline SLO metric, judged against
+    the history ledger (obs/history.py) when ``TPU_HISTORY_DIR`` is
+    set.  Each scraped ``slo.<key>.value`` is compared to the most
+    recent ledger series carrying that metric (fleet reports record
+    SLO measurements under the SLO key itself).  Any trouble — no
+    history dir, unreadable ledger, thin baseline — costs the lines,
+    never the screen."""
+    try:
+        ledger = history.RunLedger()
+        if not ledger.enabled:
+            return []
+        lines = []
+        for key in sorted(model.get("slos") or {}):
+            entry = model["slos"][key]
+            if "value" not in entry:
+                continue
+            recs = ledger.records(metric=key)
+            if not recs:
+                continue
+            # Judge against the most recently recorded config's
+            # series — the scrape carries no config key, and mixing
+            # configs would compare apples to racks.
+            cfg = recs[-1].get("config_key")
+            series = [r for r in recs if r.get("config_key") == cfg]
+            v = history.trend_verdict(series, key, entry["value"])
+            if v["status"] != "no_baseline":
+                lines.append("  " + history.format_verdict(v))
+        return lines
+    except Exception:  # noqa: BLE001 — the panel-degrade rule
+        return []
+
+
 # -- render ------------------------------------------------------------------
 
 
@@ -336,6 +370,12 @@ def render(model: dict, source: str, top_n: int = 10) -> str:
             ok = entry.get("ok", 0.0) >= 1.0
             lines.append(f"  {key:<24} {entry.get('value', 0.0):>14.3f} "
                          f"{'ok' if ok else '** BREACH **'}")
+        trends = model.get("trends") or []
+        if trends:
+            lines.append("")
+            lines.append("trend vs history "
+                         "(obs/history.py baseline):")
+            lines.extend(trends)
 
     serving = model.get("serving")
     if serving:
@@ -571,8 +611,9 @@ def main(argv=None):
             try:
                 body = scrape(url)
                 prof = scrape_profile(profile_url(url))
-                screen = render(digest(parse_families(body), prof),
-                                url, args.top)
+                model = digest(parse_families(body), prof)
+                model["trends"] = trend_lines(model)
+                screen = render(model, url, args.top)
                 banner = ""
             except (urllib.error.URLError, OSError) as e:
                 if args.once or screen is None:

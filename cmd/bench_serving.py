@@ -77,6 +77,11 @@ def parse_args(argv=None):
     p.add_argument("--fleet-min-qps", type=float, default=0.0,
                    help="exit non-zero when sustained QPS lands below "
                         "this floor (the regression gate)")
+    p.add_argument("--trend-gate", action="store_true",
+                   help="fleet mode: judge sustained QPS and p99 "
+                        "against the history ledger baseline "
+                        "(TPU_HISTORY_DIR); a regression exits 1 "
+                        "with the cpu_attr attribution named")
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--requests", type=int, default=12)
     p.add_argument("--prompt-lens", default="8,24,48",
@@ -134,10 +139,25 @@ def fleet_main(args) -> int:
     from container_engine_accelerators_tpu.fleet.controller import (
         FleetController,
     )
+    from container_engine_accelerators_tpu.obs import (
+        critpath,
+        histo,
+        history,
+        profiler,
+    )
     from container_engine_accelerators_tpu.serving.frontend import (
         RequestShed,
     )
 
+    run_id = history.new_run_id()
+    version = history.repo_version()
+    # Per-run CPU attribution baseline (the controller's boot starts
+    # the continuous profiler): the run's subsystem shares are the
+    # delta against this snapshot, so a regressed p99 comes with
+    # "which subsystem's share moved" attached.
+    prof0 = profiler.snapshot(top=0)["subsystems"]
+    e2e0 = dict(histo.snapshot().get("serving.e2e",
+                                     {}).get("buckets", {}))
     scenario = {
         "name": "bench-serving-fleet",
         "workload": "serving",
@@ -214,7 +234,18 @@ def fleet_main(args) -> int:
                 errors += 1
         elapsed = time.monotonic() - t0
         qps = ok / max(elapsed, 1e-9)
+        # Run evidence for the history ledger: this run's p99 (e2e
+        # histogram delta against the boot baseline), its cpu_attr
+        # subsystem shares, and the critical-path dominant phase —
+        # the regression ATTRIBUTION inputs.
+        p99_us = histo.delta_percentile_us("serving.e2e", e2e0, 0.99)
+        p99_ms = round((p99_us or 0.0) / 1e3, 3)
+        cpu_attr = profiler.subsystem_shares(baseline=prof0) or None
+        dominant = critpath.analyze(
+            ctl.telemetry.spans()).get("dominant_phase")
         for w in windows:
+            w["run_id"] = run_id
+            w["version"] = version
             print(json.dumps(w))
         result = {
             "metric": "serving_fleet_sustained_qps",
@@ -222,10 +253,16 @@ def fleet_main(args) -> int:
             "unit": f"req/s ({args.fleet_nodes} nodes, "
                     f"{args.fleet_payload} B shard reads, closed loop "
                     f"x{args.fleet_inflight})",
+            "run_id": run_id,
+            "version": version,
             "ok": ok,
             "errors": errors,
             "shed": shed,
             "elapsed_s": round(elapsed, 2),
+            "p99_e2e_ms": p99_ms,
+            "cpu_attr": {k: round(v, 4)
+                         for k, v in (cpu_attr or {}).items()},
+            "dominant_phase": dominant,
             "nodes": args.fleet_nodes,
             "payload_bytes": args.fleet_payload,
             "inflight": args.fleet_inflight,
@@ -234,7 +271,9 @@ def fleet_main(args) -> int:
         print(json.dumps(result))
         print(f"bench_serving --fleet: {qps:.1f} req/s sustained "
               f"({ok} ok, {errors} errors, {shed} shed over "
-              f"{elapsed:.1f}s)", file=sys.stderr)
+              f"{elapsed:.1f}s, p99 {p99_ms:.1f}ms)", file=sys.stderr)
+        trend_rc = _fleet_trend(args, run_id, qps, p99_ms, cpu_attr,
+                                dominant)
         if errors or not ok:
             return 1
         if args.fleet_min_qps and qps < args.fleet_min_qps:
@@ -242,9 +281,48 @@ def fleet_main(args) -> int:
                   f"--fleet-min-qps floor {args.fleet_min_qps:g}",
                   file=sys.stderr)
             return 1
-        return 0
+        return trend_rc
     finally:
         ctl.close()
+
+
+def _fleet_trend(args, run_id, qps, p99_ms, cpu_attr,
+                 dominant) -> int:
+    """Record this fleet-serving run into the history ledger and
+    judge it against PRIOR runs of the same config (recording happens
+    after judging, so a regressed run cannot poison its own
+    baseline).  Returns 1 on a regression under --trend-gate, else 0;
+    ledger trouble costs the trend layer, never the bench."""
+    from container_engine_accelerators_tpu.obs import history
+
+    ledger = history.RunLedger()
+    if not ledger.enabled:
+        return 0
+    cfg_key = history.config_key(
+        "fleet-serving", f"n{args.fleet_nodes}",
+        f"p{args.fleet_payload}", f"b{args.fleet_batch}",
+        f"c{args.fleet_inflight}")
+    metrics = {"sustained_qps": round(qps, 2),
+               "p99_e2e_ms": p99_ms}
+    try:
+        prior = ledger.records(kind="fleet_serving", cfg_key=cfg_key)
+    except history.LedgerError as e:
+        print(f"history ledger unreadable ({e}); trend gate skipped",
+              file=sys.stderr)
+        return 0
+    verdicts = [
+        history.trend_verdict(prior, m, v, cpu_attr=cpu_attr,
+                              dominant_phase=dominant)
+        for m, v in sorted(metrics.items())
+    ]
+    ledger.record("fleet_serving", cfg_key, metrics, run_id=run_id,
+                  cpu_attr=cpu_attr, dominant_phase=dominant)
+    regressed = [v for v in verdicts if v["status"] == "regressed"]
+    for v in verdicts:
+        if v["status"] != "no_baseline":
+            print("trend: " + history.format_verdict(v),
+                  file=sys.stderr)
+    return 1 if (args.trend_gate and regressed) else 0
 
 
 def main(argv=None) -> int:
@@ -481,9 +559,13 @@ def main(argv=None) -> int:
             if args.speculative else "")
     if temp > 0:
         stag += f"_sampledT{temp:g}"
+    from container_engine_accelerators_tpu.obs import history
+
     result = {
         "metric": "serving_continuous_batching_ttft_speedup" + stag,
         "value": round(mean_seq_ttft / mean_eng_ttft, 3),
+        "run_id": history.new_run_id(),
+        "version": history.repo_version(),
         "unit": f"x (mean burst TTFT, sequential/engine, "
                 f"{args.slots} slots)",
         "vs_baseline": round(seq_s / eng_s, 3),

@@ -66,6 +66,7 @@ from container_engine_accelerators_tpu.fleet.xferd import (  # noqa: E402
     PyXferd,
 )
 from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    history,
     profiler,
     timeseries,
     trace,
@@ -170,6 +171,12 @@ def parse_args(argv=None):
     p.add_argument("--prof-max-overhead", type=float, default=0.05,
                    help="the continuous profiler's throughput budget "
                         "on the pipelined lane (default 0.05 = 5%%)")
+    p.add_argument("--trend-gate", action="store_true",
+                   help="judge every sweep cell's throughput and "
+                        "exposed-comm ratio against the history "
+                        "ledger baseline (TPU_HISTORY_DIR); a "
+                        "regression exits 1 (the --compare gates "
+                        "still fail first)")
     return p.parse_args(argv)
 
 
@@ -321,9 +328,12 @@ class BenchRig:
 
 
 def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
-              modes=MODES, rig=None, tune_warmup=0):
-    """Returns {(mode, size): best_mbps} after writing one JSONL
-    record per cell to ``sink``."""
+              modes=MODES, rig=None, tune_warmup=0, run_id=None,
+              version=None):
+    """Returns ``(best_mbps, exposed, cells)`` — the first two keyed
+    by (mode, size), the third the per-cell JSONL record dicts in
+    sweep order — after writing one JSONL record per cell to
+    ``sink``."""
     own_rig = rig is None
     rig = rig or BenchRig()
     # The socket-pipelined and shm lanes must be measured apart, so
@@ -348,6 +358,7 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
         tuned=True, shm_direct=False)
     results = {}
     exposed = {}
+    cells = []
     try:
         print(f"{'bytes':>9} {'mode':>10} {'best_ms':>9} {'med_ms':>9} "
               f"{'best_MB/s':>10} {'exposed':>8} {'%memcpy':>8} "
@@ -417,6 +428,8 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
                        if ref and mode != "memcpy" else None)
                 record = {
                     "bench": "dcn_xfer",
+                    "run_id": run_id,
+                    "version": version,
                     "mode": mode,
                     "bytes": size,
                     "iters": iters,
@@ -432,6 +445,7 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
                 }
                 sink.write(json.dumps(record) + "\n")
                 sink.flush()
+                cells.append(record)
                 exp_txt = ("-" if exp_ratio is None
                            else f"{exp_ratio:.2f}")
                 pct_txt = "-" if pct is None else f"{pct:.1f}%"
@@ -444,7 +458,7 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
     finally:
         if own_rig:
             rig.close()
-    return results, exposed
+    return results, exposed, cells
 
 
 def parse_grid(spec: str):
@@ -469,7 +483,7 @@ def parse_grid(spec: str):
 
 
 def run_static_grid(rig, size, iters, grid, base_cfg, sink,
-                    table=sys.stderr):
+                    table=sys.stderr, run_id=None, version=None):
     """The hand-tuned competition, measured PAIRED: each iteration
     runs every static (chunk, stripes) cell AND one tuned transfer
     back to back, so environment drift (a loaded builder, a noisy
@@ -509,6 +523,8 @@ def run_static_grid(rig, size, iters, grid, base_cfg, sink,
         out[(chunk, stripes)] = mbps
         sink.write(json.dumps({
             "bench": "dcn_xfer_grid",
+            "run_id": run_id,
+            "version": version,
             "mode": "static",
             "bytes": size,
             "iters": iters,
@@ -634,12 +650,17 @@ def main(argv=None):
     out = open(args.out, "a") if args.out else sys.stdout
     largest = sizes[-1]
     grid_best = None
+    # Joinability stamps: every JSONL record from this invocation
+    # (sweep cells AND grid cells) carries the same run_id, which is
+    # also the ledger record's key.
+    run_id = history.new_run_id()
+    version = history.repo_version()
     rig = BenchRig()
     try:
-        results, exposed = run_sweep(sizes, max(1, args.iters), cfg,
-                                     out, modes=modes, rig=rig,
-                                     tune_warmup=max(
-                                         0, args.tune_warmup))
+        results, exposed, cells = run_sweep(
+            sizes, max(1, args.iters), cfg, out, modes=modes, rig=rig,
+            tune_warmup=max(0, args.tune_warmup), run_id=run_id,
+            version=version)
         tuned_gate_mbps = None
         if args.tuned and args.compare:
             grid = parse_grid(args.grid)
@@ -648,7 +669,8 @@ def main(argv=None):
                       "plane against", file=sys.stderr)
                 return 2
             grid_best, tuned_gate_mbps = run_static_grid(
-                rig, largest, max(1, args.iters), grid, cfg, out)
+                rig, largest, max(1, args.iters), grid, cfg, out,
+                run_id=run_id, version=version)
     finally:
         rig.close()
         if args.out:
@@ -720,7 +742,49 @@ def main(argv=None):
                   f"{args.tune_min_ratio:.2f}x the best static grid "
                   f"at {largest} bytes", file=sys.stderr)
             rc = 1
-    return rc
+    trend_rc = _record_and_trend(args, run_id, cells)
+    return rc if rc else trend_rc
+
+
+def _record_and_trend(args, run_id, cells) -> int:
+    """Ledger recording + the --trend-gate verdict, one ledger record
+    per sweep cell.  Verdicts are judged against PRIOR runs of the
+    same (mode, size, chunk, stripes) cell, then this run is
+    appended — a regressed run never poisons its own baseline.
+    Returns 1 on a regression under --trend-gate, else 0; history
+    trouble costs the trend layer, never the bench verdict."""
+    ledger = history.RunLedger()
+    if not ledger.enabled:
+        return 0
+    regressed = False
+    for cell in cells:
+        cfg_key = history.config_key(
+            "dcn_bench", cell["mode"], cell["bytes"],
+            f"c{cell['chunk_bytes']}", f"s{cell['stripes']}")
+        metrics = {"mbps": cell["mbps"]}
+        if cell.get("exposed_ratio") is not None:
+            metrics["exposed_ratio"] = cell["exposed_ratio"]
+        if cell.get("pct_of_memcpy") is not None:
+            metrics["pct_of_memcpy"] = cell["pct_of_memcpy"]
+        try:
+            prior = ledger.records(kind="dcn_bench", cfg_key=cfg_key)
+        except history.LedgerError as e:
+            print(f"history ledger unreadable ({e}); trend gate "
+                  f"skipped", file=sys.stderr)
+            return 0
+        verdicts = [
+            history.trend_verdict(prior, m, v,
+                                  cpu_attr=cell.get("cpu_attr"))
+            for m, v in sorted(metrics.items())
+        ]
+        ledger.record("dcn_bench", cfg_key, metrics, run_id=run_id,
+                      cpu_attr=cell.get("cpu_attr"))
+        for v in verdicts:
+            if v["status"] == "regressed":
+                regressed = True
+                print(f"trend [{cfg_key}]: "
+                      + history.format_verdict(v), file=sys.stderr)
+    return 1 if (args.trend_gate and regressed) else 0
 
 
 if __name__ == "__main__":

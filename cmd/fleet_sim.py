@@ -59,7 +59,10 @@ from container_engine_accelerators_tpu.fleet.proc import (  # noqa: E402
 from container_engine_accelerators_tpu.fleet.telemetry import (  # noqa: E402
     SLO_KEYS,
 )
-from container_engine_accelerators_tpu.obs import trace  # noqa: E402
+from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    history,
+    trace,
+)
 
 
 def parse_args(argv=None):
@@ -127,6 +130,12 @@ def parse_args(argv=None):
     p.add_argument("--trace-file", default=None,
                    help="write the run's span JSONL here "
                         "(summarize with cmd/agent_trace.py)")
+    p.add_argument("--trend-gate", action="store_true",
+                   help="judge this run's SLO measurements against "
+                        "the history ledger baseline "
+                        "(TPU_HISTORY_DIR); a regression exits 1 "
+                        "(non-convergence/SLO breach still exit 2/3 "
+                        "first)")
     return p.parse_args(argv)
 
 
@@ -242,6 +251,7 @@ def main(argv=None):
     if args.trace_file:
         trace.configure(args.trace_file)
 
+    run_id = history.new_run_id()
     try:
         report = run_scenario(scenario)
     except ProcHandshakeError as e:
@@ -253,13 +263,61 @@ def main(argv=None):
             trace.configure(None)
         return 2
 
+    # Joinability stamps: the stdout report line and the ledger
+    # record carry the same run_id.
+    report["run_id"] = run_id
+    report["version"] = history.repo_version()
+    trend_rc = _record_and_trend(report, scenario, args, run_id)
     _print_report(report)
     print(json.dumps(report))
     if args.trace_file:
         trace.configure(None)  # flush/close the sink
     if not report["converged"]:
         return 2
-    return 0 if report["slo"]["ok"] else 3
+    if not report["slo"]["ok"]:
+        return 3
+    return trend_rc
+
+
+def _record_and_trend(report, scenario, args, run_id) -> int:
+    """Ledger recording + the --trend-gate verdict, judged against
+    PRIOR runs of this same config key (this run is appended after,
+    so a regressed run cannot poison its own baseline).  Returns 1 on
+    a regression under --trend-gate, else 0; history trouble costs
+    the trend layer, never the fleet verdict."""
+    ledger = history.RunLedger()
+    if not ledger.enabled:
+        return 0
+    cfg_key = history.config_key(
+        "fleet_sim", report.get("scenario"),
+        report.get("workload"),
+        "proc" if report.get("proc") else "inproc",
+        f"n{scenario.get('nodes')}")
+    metrics, cpu_attr, phase = history.fleet_report_evidence(report)
+    if not metrics:
+        return 0
+    try:
+        prior = ledger.records(kind="fleet_sim", cfg_key=cfg_key)
+    except history.LedgerError as e:
+        print(f"history ledger unreadable ({e}); trend gate skipped",
+              file=sys.stderr)
+        return 0
+    verdicts = [
+        history.trend_verdict(prior, m, v, cpu_attr=cpu_attr,
+                              dominant_phase=phase)
+        for m, v in sorted(metrics.items())
+    ]
+    ledger.record("fleet_sim", cfg_key, metrics, run_id=run_id,
+                  cpu_attr=cpu_attr, dominant_phase=phase,
+                  slo=report.get("slo"))
+    regressed = [v for v in verdicts if v["status"] == "regressed"]
+    for v in verdicts:
+        if v["status"] != "no_baseline":
+            print("trend: " + history.format_verdict(v),
+                  file=sys.stderr)
+    report["trend"] = {"config_key": cfg_key, "verdicts": verdicts,
+                       "ok": not regressed}
+    return 1 if (args.trend_gate and regressed) else 0
 
 
 if __name__ == "__main__":

@@ -49,7 +49,14 @@ from container_engine_accelerators_tpu.fleet.soak import (  # noqa: E402
 from container_engine_accelerators_tpu.fleet.telemetry import (  # noqa: E402
     SLO_KEYS,
 )
-from container_engine_accelerators_tpu.obs import trace  # noqa: E402
+from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    history,
+    trace,
+)
+
+# Version stamp for the stdout JSON report line: bump when the report
+# shape changes incompatibly (downstream joins records by run_id).
+REPORT_SCHEMA_VERSION = 1
 
 
 def parse_args(argv=None):
@@ -78,6 +85,11 @@ def parse_args(argv=None):
     p.add_argument("--trace-file", default=None,
                    help="write the run's span JSONL here "
                         "(summarize with cmd/agent_trace.py)")
+    p.add_argument("--trend-gate", action="store_true",
+                   help="judge this run's SLO measurements and leak "
+                        "slopes against the history ledger baseline "
+                        "(TPU_HISTORY_DIR); a regression exits 1 "
+                        "(sentinel/SLO breaches still exit 3 first)")
     return p.parse_args(argv)
 
 
@@ -152,6 +164,7 @@ def main(argv=None):
     if args.trace_file:
         trace.configure(args.trace_file)
 
+    run_id = history.new_run_id()
     try:
         report = run_soak(scenario or None,
                           duration_s=args.duration,
@@ -163,11 +176,61 @@ def main(argv=None):
             trace.configure(None)
         return 2
 
+    # Joinability stamps: the stdout report line and the ledger
+    # record carry the same run_id.
+    report["run_id"] = run_id
+    report["version"] = history.repo_version()
+    report["schema_version"] = REPORT_SCHEMA_VERSION
+    trend_rc = _record_and_trend(report, args, run_id)
     _print_report(report)
     print(json.dumps(report))
     if args.trace_file:
         trace.configure(None)  # flush/close the sink
-    return exit_code_for(report)
+    rc = exit_code_for(report)
+    return rc if rc else trend_rc
+
+
+def _record_and_trend(report, args, run_id) -> int:
+    """Ledger recording + the --trend-gate verdict.  Verdicts are
+    judged against PRIOR runs (this run is appended after), so one
+    regressed run cannot poison its own baseline.  Returns the gate's
+    exit contribution: 1 on a regression under --trend-gate, else 0.
+    History trouble costs the trend layer, never the soak verdict."""
+    ledger = history.RunLedger()
+    if not ledger.enabled:
+        return 0
+    soak = report.get("soak") or {}
+    cfg_key = (soak.get("history") or {}).get("config_key") \
+        or history.config_key("soak", report.get("scenario"))
+    metrics, cpu_attr, phase = history.fleet_report_evidence(report)
+    slopes = ((soak.get("sentinels") or {}).get("leaks") or {}) \
+        .get("max_slopes") or {}
+    for metric, slope in slopes.items():
+        metrics[f"leak_slope.{metric}"] = float(slope)
+    try:
+        prior = ledger.records(kind="fleet_soak", cfg_key=cfg_key)
+    except history.LedgerError as e:
+        print(f"history ledger unreadable ({e}); trend gate skipped",
+              file=sys.stderr)
+        return 0
+    verdicts = [
+        history.trend_verdict(prior, m, v, cpu_attr=cpu_attr,
+                              dominant_phase=phase)
+        for m, v in sorted(metrics.items())
+    ]
+    ledger.record("fleet_soak", cfg_key, metrics, run_id=run_id,
+                  seed=soak.get("seed"), cpu_attr=cpu_attr,
+                  dominant_phase=phase,
+                  sentinels={"leak_slopes": slopes},
+                  slo=report.get("slo"))
+    regressed = [v for v in verdicts if v["status"] == "regressed"]
+    for v in verdicts:
+        if v["status"] != "no_baseline":
+            print("trend: " + history.format_verdict(v),
+                  file=sys.stderr)
+    report["trend"] = {"config_key": cfg_key, "verdicts": verdicts,
+                       "ok": not regressed}
+    return 1 if (args.trend_gate and regressed) else 0
 
 
 if __name__ == "__main__":

@@ -74,8 +74,16 @@ from typing import Dict, List, Optional, Tuple
 from container_engine_accelerators_tpu.fleet.controller import (
     FleetController,
 )
+from container_engine_accelerators_tpu.fleet.telemetry import (
+    SLO_KEYS,
+    parse_slo_spec,
+)
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import timeseries, trace
+from container_engine_accelerators_tpu.obs import (
+    history,
+    timeseries,
+    trace,
+)
 from container_engine_accelerators_tpu.parallel import dcn_tune
 from container_engine_accelerators_tpu.serving.frontend import (
     ServingConfig,
@@ -243,10 +251,27 @@ class LeakSentinel:
     points fit any line); the budgets are per window."""
 
     def __init__(self, limits: Optional[dict] = None,
-                 min_samples: int = 4, warmup_samples: int = 2):
+                 min_samples: int = 4, warmup_samples: int = 2,
+                 learned: Optional[Dict[str, dict]] = None):
         self.limits = dict(DEFAULT_LEAK_LIMITS)
         if limits:
             self.limits.update(limits)
+        # History-learned slope budgets (obs/history.learned_limit
+        # shapes, keyed by metric): a learned limit replaces the
+        # pinned one — by construction it can only TIGHTEN it (the
+        # learner's hard ceiling is the pinned constant), so a fleet
+        # whose demonstrated slopes sit near zero flags a creep the
+        # generous pinned budget alone would wave through.
+        self.limit_sources: Dict[str, dict] = {}
+        for metric, ll in (learned or {}).items():
+            if metric in self.limits \
+                    and ll.get("source") == "learned":
+                self.limits[metric] = min(self.limits[metric],
+                                          float(ll["limit"]))
+                self.limit_sources[metric] = {
+                    "source": "learned", "n": ll.get("n"),
+                    "median": ll.get("median"),
+                    "pinned": DEFAULT_LEAK_LIMITS.get(metric)}
         self.min_samples = max(2, int(min_samples))
         self.warmup_samples = max(0, int(warmup_samples))
         self._series: Dict[Tuple[str, str, Optional[int]],
@@ -269,21 +294,33 @@ class LeakSentinel:
     def report(self) -> dict:
         breaches: List[dict] = []
         series: Dict[str, dict] = {}
+        # Worst judged slope per metric across every node/generation
+        # segment — what the history ledger persists, and what the
+        # NEXT run's learned thresholds are fitted over.
+        max_slopes: Dict[str, float] = {}
         for (node, metric, gen), pts in sorted(self._series.items(),
                                                key=lambda kv: str(kv[0])):
             slope = timeseries.least_squares_slope(pts)
             limit = self.limits[metric]
+            if len(pts) >= self.min_samples:
+                max_slopes[metric] = max(
+                    max_slopes.get(metric, slope), slope)
             entry = {
                 "node": node, "metric": metric, "gen": gen,
                 "samples": len(pts),
                 "slope_per_window": round(slope, 4),
                 "limit_per_window": limit,
             }
+            if metric in self.limit_sources:
+                entry["limit_source"] = "learned"
             series[f"{node}.{metric}.gen{gen}"] = entry
             if len(pts) >= self.min_samples and slope > limit:
                 breaches.append(entry)
         return {"ok": not breaches, "breaches": breaches,
-                "series": series}
+                "series": series,
+                "max_slopes": {m: round(s, 4)
+                               for m, s in max_slopes.items()},
+                "learned_limits": dict(self.limit_sources)}
 
 
 def judge_tuner_convergence(moves_per_window: List[int],
@@ -323,6 +360,56 @@ def judge_tuner_convergence(moves_per_window: List[int],
         return out
     out["reason"] = "converged"
     return out
+
+
+def history_learned_limits(cfg_key: str,
+                           slo_spec: Optional[dict] = None,
+                           ledger: Optional["history.RunLedger"]
+                           = None) -> Tuple[Dict[str, dict],
+                                            Dict[str, dict]]:
+    """Fit this config's sentinel thresholds from prior soak runs in
+    the history ledger (``TPU_HISTORY_DIR``): per-metric leak-slope
+    budgets from the runs' recorded ``max_slopes`` and per-key SLO
+    limits from their measured values — each ``median + k·MAD``
+    (floors mirrored), pinned-constant fallback when history is
+    thinner than ``MIN_BASELINE_RUNS``, and the pinned constant as
+    the hard bound the learned value can never relax past.  No
+    ledger, an unreadable one, or thin history all degrade to empty
+    mappings: the pinned constants judge alone, exactly as before
+    this layer existed."""
+    ledger = history.RunLedger() if ledger is None else ledger
+    leak: Dict[str, dict] = {}
+    slo: Dict[str, dict] = {}
+    if not ledger.enabled:
+        return leak, slo
+    try:
+        prior = ledger.records(kind="fleet_soak", cfg_key=cfg_key)
+    except history.LedgerError as e:
+        log.error("history ledger unreadable (%s); soak thresholds "
+                  "stay pinned", e)
+        return leak, slo
+    for metric, pinned in DEFAULT_LEAK_LIMITS.items():
+        slopes = [
+            float(r["sentinels"]["leak_slopes"][metric])
+            for r in prior
+            if isinstance((r.get("sentinels") or {})
+                          .get("leak_slopes"), dict)
+            and metric in r["sentinels"]["leak_slopes"]
+        ][-history.BASELINE_N:]
+        ll = history.learned_limit(slopes, pinned)
+        if ll["source"] == "learned":
+            leak[metric] = ll
+    for key, pinned in parse_slo_spec(slo_spec).items():
+        kind = SLO_KEYS[key][0]
+        values = [
+            float(r["slo"]["measured"][key]) for r in prior
+            if isinstance((r.get("slo") or {}).get("measured"), dict)
+            and key in r["slo"]["measured"]
+        ][-history.BASELINE_N:]
+        ll = history.learned_limit(values, pinned, kind=kind)
+        if ll["source"] == "learned":
+            slo[key] = ll
+    return leak, slo
 
 
 def exit_code_for(report: dict) -> int:
@@ -386,7 +473,20 @@ class SoakWorld(FleetController):
         self.schedule = SoakSchedule(
             self.seed, [s.name for s in self.topology.specs.values()])
         self.mono = MonotonicitySentinel()
-        self.leak = LeakSentinel(merged.get("leak_limits"))
+        # History-learned thresholds: prior soak runs of this SAME
+        # config (ledger under TPU_HISTORY_DIR) tighten the leak
+        # budgets and SLO limits toward the fleet's demonstrated
+        # baseline — pinned constants stay the fallback AND the hard
+        # bound, so no history and thin history behave exactly as
+        # before this layer existed.
+        self.history_key = history.config_key(
+            "soak", merged.get("name", "soak"),
+            f"n{merged.get('nodes')}")
+        self._learned_leak, self._learned_slo = \
+            history_learned_limits(self.history_key,
+                                   merged.get("slo"))
+        self.leak = LeakSentinel(merged.get("leak_limits"),
+                                 learned=self._learned_leak)
         self._moves_per_window: List[int] = []
         self._last_moves = 0
         self._heal_windows: set = set()
@@ -401,6 +501,9 @@ class SoakWorld(FleetController):
         if self._booted:
             return self
         super().boot()
+        # The SLO sentinel judges with the history-learned limits
+        # (tighten-only; telemetry clamps them to the pinned spec).
+        self.telemetry.learned_slo.update(self._learned_slo)
         # Compose ALL the workloads on the booted substrate.  The
         # frontend and the engine keep their own pooled clients, so
         # they are safe to drive concurrently with the exchange legs
@@ -677,6 +780,11 @@ class SoakWorld(FleetController):
             counters.inc("soak.sentinel.breach")
         report["soak"] = {
             "seed": self.seed,
+            "history": {
+                "config_key": self.history_key,
+                "learned_leak": self._learned_leak,
+                "learned_slo": self._learned_slo,
+            },
             "windows": windows,
             "window_s": self.window_s,
             "duration_s": round(time.monotonic() - start, 3),

@@ -309,10 +309,18 @@ class FleetTelemetry:
 
     def __init__(self, nodes: dict, links, slo: Optional[dict] = None,
                  *, scrape: bool = False,
-                 scrape_timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S):
+                 scrape_timeout_s: float = DEFAULT_SCRAPE_TIMEOUT_S,
+                 learned_slo: Optional[dict] = None):
         self.nodes = nodes
         self.links = links
         self.slo = parse_slo_spec(slo)
+        # History-learned SLO limits (obs/history.learned_limit
+        # shapes: {key: {"limit", "source", "n", ...}}), applied on
+        # top of the scenario's pinned limits in evaluate() — a
+        # learned limit may TIGHTEN a check, never relax it past the
+        # pinned constant (fleet/soak.py feeds this from prior runs'
+        # measured values under TPU_HISTORY_DIR).
+        self.learned_slo: Dict[str, dict] = dict(learned_slo or {})
         self.scrape = bool(scrape)
         self.scrape_timeout_s = float(scrape_timeout_s)
         self.history: List[dict] = []
@@ -875,12 +883,30 @@ class FleetTelemetry:
         for key, limit in self.slo.items():
             kind, what = SLO_KEYS[key]
             value = measured[key]
+            source = "pinned"
+            learned = self.learned_slo.get(key)
+            if learned and learned.get("source") == "learned":
+                # Tighten-only: a ceiling may come DOWN toward the
+                # fleet's demonstrated baseline, a floor may come UP
+                # — neither ever relaxes past the scenario's pinned
+                # limit (the hard bound the learner cannot cross).
+                lv = float(learned["limit"])
+                tightened = (min(limit, lv) if kind == "ceiling"
+                             else max(limit, lv))
+                if tightened != limit:
+                    limit = tightened
+                    source = "learned"
             ok = value >= limit if kind == "floor" else value <= limit
-            checks.append({
+            check = {
                 "slo": key, "kind": kind, "what": what,
                 "limit": limit, "value": round(value, 3),
                 "ok": bool(ok),
-            })
+            }
+            if source == "learned":
+                check["limit_source"] = "learned"
+                check["pinned_limit"] = self.slo[key]
+                check["history_n"] = learned.get("n")
+            checks.append(check)
             timeseries.gauge(f"slo.{key}.ok", 1.0 if ok else 0.0)
             timeseries.gauge(f"slo.{key}.value", value)
         ok = all(c["ok"] for c in checks)

@@ -18,6 +18,9 @@ contract with a blocked-import subprocess.
 - ``obs.flight``      flight recorder: SIGUSR1 / terminal-failure dumps
 - ``obs.profiler``    continuous stack-sampling profiler: folded
                       stacks keyed by subsystem, ``/profile`` scrape
+- ``obs.history``     persistent run ledger (``TPU_HISTORY_DIR``) +
+                      median/MAD trend engine with attributed
+                      regression verdicts
 - ``obs.promtext``    the one Prometheus text-exposition parser every
                       scrape surface (agent_top, fleet telemetry) uses
 """
@@ -26,11 +29,12 @@ from container_engine_accelerators_tpu.obs import (
     critpath,
     flight,
     histo,
+    history,
     profiler,
     promtext,
     timeseries,
     trace,
 )
 
-__all__ = ["critpath", "flight", "histo", "profiler", "promtext",
-           "timeseries", "trace"]
+__all__ = ["critpath", "flight", "histo", "history", "profiler",
+           "promtext", "timeseries", "trace"]

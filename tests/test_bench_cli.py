@@ -204,3 +204,35 @@ def test_roofline_check_cpu_smoke(tmp_path):
     assert row["mxu_ms"] >= 0 and row["other_ms"] >= 0
     assert json.load(open(out))["metric"] == row["metric"]
     assert not log.exists()  # CPU runs never pollute the on-chip log
+
+
+def test_fleet_clis_grow_trend_gate():
+    """ISSUE 17 surface: every fleet-facing bench CLI accepts
+    --trend-gate (history-judged regressions gate the exit code), and
+    the soak report schema is pinned for downstream run_id joins."""
+    db = _load("db_cli", "cmd/dcn_bench.py")
+    assert db.parse_args(["--trend-gate"]).trend_gate
+    bs = _load("bs_cli", "cmd/bench_serving.py")
+    args = bs.parse_args(["--fleet", "--trend-gate"])
+    assert args.fleet and args.trend_gate
+    fsim = _load("fsim_cli", "cmd/fleet_sim.py")
+    assert fsim.parse_args(["--trend-gate"]).trend_gate
+    fsoak = _load("fsoak_cli", "cmd/fleet_soak.py")
+    assert fsoak.parse_args(["--trend-gate"]).trend_gate
+    assert fsoak.REPORT_SCHEMA_VERSION == 1
+
+
+def test_agent_trend_arg_surface():
+    from container_engine_accelerators_tpu.obs import history
+
+    at = _load("at_cli", "cmd/agent_trend.py")
+    args = at.parse_args(["--dir", "/tmp/x", "--kind", "fleet_soak",
+                          "--min-runs", "1", "--attribute",
+                          "--import", "BENCH_r01.json",
+                          "--import", "BENCH_r02.json"])
+    assert args.dir == "/tmp/x" and args.kind == "fleet_soak"
+    assert args.min_runs == 1 and args.attribute
+    assert args.imports == ["BENCH_r01.json", "BENCH_r02.json"]
+    # Defaults track the ledger's baseline constants, not copies.
+    assert args.last == history.BASELINE_N
+    assert args.k == history.DEFAULT_K

@@ -440,6 +440,7 @@ class TestBenchHarness:
         lines = out.read_text().strip().splitlines()
         assert len(lines) == 8  # 2 sizes x 4 modes
         modes = set()
+        run_ids = set()
         for line in lines:
             rec = json.loads(line)
             assert rec["bench"] == "dcn_xfer"
@@ -448,6 +449,12 @@ class TestBenchHarness:
             assert rec["bytes"] in (4096, 16384)
             assert rec["mbps"] > 0 and rec["best_s"] > 0
             assert rec["chunk_bytes"] == 4096
+            # Every record is history-joinable: one run id for the
+            # whole invocation plus the repo VERSION stamp.
+            assert len(rec["run_id"]) == 16
+            run_ids.add(rec["run_id"])
+            assert rec["version"]
+        assert len(run_ids) == 1
         # The memcpy reference rides the SAME JSONL as the lanes — the
         # "how far from memcpy speed" gap is always on record.
         assert modes == set(mod.MODES)

@@ -993,6 +993,9 @@ class TestServingScenarios:
         report = json.loads(proc.stdout.strip().splitlines()[-1])
         assert report["workload"] == "serving"
         assert report["converged"] and report["slo"]["ok"]
+        # History-joinable report stamps (ISSUE 17 satellite).
+        assert len(report["run_id"]) == 16
+        assert report["version"]
 
     def test_fleet_sim_cli_serving_slo_breach_exits_3(self):
         """A converged serving run that misses an honest floor must
@@ -1019,3 +1022,7 @@ class TestServingScenarios:
         assert windows, "per-second QPS series missing"
         assert len(head) == 1
         assert head[0]["value"] > 0 and head[0]["errors"] == 0
+        # The windows and the headline share ONE run id (history
+        # joins the per-second series to the ledger record by it).
+        assert len(head[0]["run_id"]) == 16 and head[0]["version"]
+        assert {w["run_id"] for w in windows} == {head[0]["run_id"]}

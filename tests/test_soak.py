@@ -472,3 +472,95 @@ class TestSoakWorldScenarioPlumbing:
             assert w.seed == 5 and w.duration_s == 2.0
         finally:
             w.close()
+
+
+# ---------------------------------------------------------------------------
+# history-learned sentinel thresholds (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+class TestHistoryLearnedThresholds:
+    """Prior soak runs in the history ledger tighten the leak and SLO
+    budgets toward the fleet's demonstrated baseline; pinned
+    constants stay the fallback (thin history) AND the hard bound
+    (history can never relax a budget)."""
+
+    CFG = "soak:soak:n3"
+
+    def _seed(self, root, slopes):
+        from container_engine_accelerators_tpu.obs import history
+        led = history.RunLedger(str(root))
+        for s in slopes:
+            led.record("fleet_soak", self.CFG,
+                       {"leak_slope.fds": s},
+                       sentinels={"leak_slopes": {"fds": s}},
+                       slo={"measured": {"p99_leg_ms": 30.0 + s},
+                            "ok": True})
+        return led
+
+    def test_learned_leak_budget_flags_run_pinned_passes(
+            self, tmp_path):
+        """The acceptance fixture: demonstrated slopes ~0.05/window
+        learn a budget ~0.07; a planted 0.5/window creep — well under
+        the pinned 2.0 — breaches the learned sentinel and sails
+        through the pinned one."""
+        led = self._seed(tmp_path, [0.04, 0.045, 0.05, 0.055, 0.06])
+        leak, _ = soak.history_learned_limits(self.CFG, None,
+                                              ledger=led)
+        assert leak["fds"]["source"] == "learned"
+        assert leak["fds"]["limit"] \
+            < soak.DEFAULT_LEAK_LIMITS["fds"] / 10
+
+        def drive(sentinel):
+            for w in range(8):
+                sentinel.observe(w, "n0", {"fds": 100 + 0.5 * w},
+                                 gen=1)
+            return sentinel.report()
+
+        pinned_rep = drive(LeakSentinel())
+        assert pinned_rep["ok"]  # 0.5/window under the pinned 2.0
+        learned_rep = drive(LeakSentinel(learned=leak))
+        assert not learned_rep["ok"]
+        (b,) = learned_rep["breaches"]
+        assert b["metric"] == "fds"
+        assert b["limit_source"] == "learned"
+        assert learned_rep["learned_limits"]["fds"]["pinned"] \
+            == soak.DEFAULT_LEAK_LIMITS["fds"]
+
+    def test_thin_history_stays_pinned(self, tmp_path):
+        led = self._seed(tmp_path, [0.05, 0.06])  # < MIN_BASELINE_RUNS
+        leak, slo = soak.history_learned_limits(self.CFG, None,
+                                                ledger=led)
+        assert leak == {} and slo == {}
+        s = LeakSentinel(learned=leak)
+        assert s.limits == soak.DEFAULT_LEAK_LIMITS
+
+    def test_unconfigured_ledger_stays_pinned(self, monkeypatch):
+        monkeypatch.delenv("TPU_HISTORY_DIR", raising=False)
+        leak, slo = soak.history_learned_limits(self.CFG)
+        assert leak == {} and slo == {}
+
+    def test_learned_slo_ceiling_from_measured_history(self,
+                                                       tmp_path):
+        led = self._seed(tmp_path, [0.04, 0.05, 0.05, 0.06])
+        _, slo = soak.history_learned_limits(
+            self.CFG, {"p99_leg_ms": 1000}, ledger=led)
+        assert slo["p99_leg_ms"]["source"] == "learned"
+        # Demonstrated p99 ~30ms: the learned ceiling sits near it,
+        # nowhere near the generous pinned 1000ms.
+        assert slo["p99_leg_ms"]["limit"] < 100
+        assert slo["p99_leg_ms"]["ceiling"] == 1000
+
+    def test_soak_world_wires_learned_limits(self, tmp_path,
+                                             monkeypatch):
+        self._seed(tmp_path, [0.04, 0.045, 0.05, 0.055])
+        monkeypatch.setenv("TPU_HISTORY_DIR", str(tmp_path))
+        w = soak.SoakWorld({"nodes": 3})
+        try:
+            assert w.history_key == self.CFG
+            assert w._learned_leak["fds"]["source"] == "learned"
+            assert w.leak.limits["fds"] \
+                < soak.DEFAULT_LEAK_LIMITS["fds"]
+            assert w.leak.limit_sources["fds"]["source"] == "learned"
+        finally:
+            w.close()

@@ -525,3 +525,49 @@ class TestProfileReport:
         assert report == {"nodes": {},
                           "fleet": {"samples": 0, "dropped": 0,
                                     "subsystems": {}, "top": []}}
+
+
+class TestLearnedSloLimits:
+    """ISSUE 17: history-learned SLO limits overlay the pinned spec
+    tighten-only — a ceiling may come down toward the fleet's
+    demonstrated baseline, never up past the scenario's pinned
+    limit."""
+
+    def test_learned_ceiling_tightens_and_is_labeled(self):
+        histo.reset()
+        learned = {"p99_leg_ms": {"limit": 100.0, "source": "learned",
+                                  "n": 5}}
+        t = FleetTelemetry({}, _FakeLinks({}), {"p99_leg_ms": 1000},
+                           learned_slo=learned)
+        histo.observe("fleet.leg", 0.2)  # ~262ms: inside pinned,
+        section = t.evaluate({})         # outside learned
+        assert section["ok"] is False
+        (check,) = [c for c in section["checks"]
+                    if c["slo"] == "p99_leg_ms"]
+        assert check["limit"] == 100.0
+        assert check["limit_source"] == "learned"
+        assert check["pinned_limit"] == 1000.0
+        assert check["history_n"] == 5
+
+    def test_learned_never_relaxes_a_ceiling(self):
+        histo.reset()
+        learned = {"p99_leg_ms": {"limit": 5000.0,
+                                  "source": "learned", "n": 8}}
+        t = FleetTelemetry({}, _FakeLinks({}), {"p99_leg_ms": 1000},
+                           learned_slo=learned)
+        histo.observe("fleet.leg", 0.2)
+        (check,) = [c for c in t.evaluate({})["checks"]
+                    if c["slo"] == "p99_leg_ms"]
+        assert check["limit"] == 1000.0
+        assert "limit_source" not in check
+
+    def test_pinned_fallback_entries_are_ignored(self):
+        histo.reset()
+        learned = {"p99_leg_ms": {"limit": 1.0, "source": "pinned",
+                                  "n": 1}}
+        t = FleetTelemetry({}, _FakeLinks({}), {"p99_leg_ms": 1000},
+                           learned_slo=learned)
+        histo.observe("fleet.leg", 0.2)
+        (check,) = [c for c in t.evaluate({})["checks"]
+                    if c["slo"] == "p99_leg_ms"]
+        assert check["limit"] == 1000.0 and check["ok"]
