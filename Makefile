@@ -223,6 +223,35 @@ collectives:
 	          'busbw healthy/degraded/final', int(healthy), \
 	          int(degraded), int(legs[-1]['busbw_bps']))"
 
+# Searched-schedules + daemon-routed forwarding gate: the sketch
+# search suite (grammar, oracle verification, degraded-spine
+# avoidance, hazard freedom) and the forward-op chaos suite
+# (capability handshake, lost-answer replay dedup, link loss on the
+# forwarded hop, mid-schedule downgrade, daemon kill/recover), then
+# the CLI acceptance legs: (1) the pinned asymmetric rig (5 nodes /
+# 2 unequal racks, latency faults on the rack-major ring's wrap
+# edges) where the searched schedule's ROUTED measured busbw must
+# beat the best auto family's by >= 1.15x AND the routed proof must
+# hold (zero payload bytes through coordinator clients); (2) the
+# scale check — routed searched busbw must GROW from 2 to 4 racks in
+# the latency-dominated regime (per-rank bytes fixed, bus factor
+# rising); (3) the routed fleet scenario — exit 0 means converged
+# with the min_forward_bytes floor and the max_coordinator_leg_bytes
+# ceiling both held through a cross-rack degrade-and-heal.  Folded
+# into presubmit.
+.PHONY: searched
+searched:
+	$(PY) -m pytest tests/test_collective_search.py \
+	    tests/test_collective_forward.py -q -p no:randomly
+	$(PY) -m container_engine_accelerators_tpu.collectives.runner \
+	    --compare --searched --routed --nodes 5 --racks 2 \
+	    --margin 1.15 > /dev/null
+	$(PY) -m container_engine_accelerators_tpu.collectives.runner \
+	    --scale-check --rack-size 2 --xrack-latency-ms 50 \
+	    --bytes 262144 > /dev/null
+	$(PY) cmd/fleet_sim.py \
+	    --scenario scenarios/collective_routed.json > /dev/null
+
 # Invariant lint gate (analysis/lint.py rule registry via
 # cmd/agent_lint.py): exit 0 clean, 1 findings, 2 internal error.
 # Inline suppressions must name their rule (# lint: disable=<rule>).
@@ -306,6 +335,7 @@ race:
 	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
 	    tests/test_serving.py tests/test_profiler.py \
 	    tests/test_collective_engine.py tests/test_history.py \
+	    tests/test_collective_search.py tests/test_collective_forward.py \
 	    -q -m "not slow" -p no:randomly
 	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
 	    --check $(RACE_REPORT)
@@ -369,6 +399,7 @@ presubmit:
 	$(MAKE) critpath
 	$(MAKE) fleet-serve
 	$(MAKE) collectives
+	$(MAKE) searched
 	$(MAKE) tune
 	$(MAKE) prof
 	$(MAKE) soak

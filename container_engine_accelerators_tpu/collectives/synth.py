@@ -36,6 +36,11 @@ import logging
 import math
 from typing import Dict, List, Optional, Tuple
 
+try:  # vectorized reduction; the pure-python loop below is the spec
+    import numpy as _np
+except ImportError:  # pragma: no cover - numpy ships with the stack
+    _np = None
+
 from container_engine_accelerators_tpu.collectives.topo import CommGraph
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import trace
@@ -43,8 +48,14 @@ from container_engine_accelerators_tpu.obs import trace
 log = logging.getLogger(__name__)
 
 COLLECTIVES = ("all_reduce", "all_gather", "reduce_scatter")
-# Preference order breaks exact cost ties deterministically.
-ALGORITHMS = ("ring", "tree", "hierarchical")
+# Preference order breaks exact cost ties deterministically.  The
+# hand-written families participate in auto-selection; ``searched``
+# (collectives/search.py's sketch-guided synthesis) is pin-only — it
+# spends real synthesis CPU enumerating candidates, so a config must
+# ask for it (``algorithm: searched``) rather than every auto pass
+# paying the search.
+AUTO_ALGORITHMS = ("ring", "tree", "hierarchical")
+ALGORITHMS = AUTO_ALGORITHMS + ("searched",)
 
 
 def bus_factor(op: str, n: int) -> float:
@@ -183,14 +194,23 @@ def _tree(order: List[str], collective: str,
 
 def _hierarchical(graph: CommGraph, collective: str,
                   nbytes: int) -> List[List[TransferStep]]:
-    """Two-level all_reduce: intra-rack ring reduce-scatter over the
-    rack-size chunking, one cross-rack star exchange per shard owner,
-    intra-rack ring all-gather.  Requires >= 2 equal-size racks (the
-    counterpart pairing is positional) and only lowers all_reduce —
-    callers treat :class:`SynthesisError` as "not a candidate"."""
-    if collective != "all_reduce":
-        raise SynthesisError(
-            f"hierarchical lowers all_reduce only, not {collective}")
+    """Two-level lowerings.  Requires >= 2 equal-size racks (the
+    counterpart pairing is positional) — callers treat
+    :class:`SynthesisError` as "not a candidate".
+
+    - **all_reduce**: intra-rack ring reduce-scatter over the
+      rack-size chunking, one cross-rack star exchange per shard
+      owner, intra-rack ring all-gather;
+    - **all_gather**: one cross-rack counterpart exchange (each node
+      ships its own global chunk to its position-mates in every other
+      rack), then an intra-rack ring all-gather of the *coarse*
+      chunks (position ``j``'s pieces across all racks) — cross-rack
+      bytes per node are ``(R-1)·S/n`` instead of the flat ring's
+      every-boundary crossings;
+    - **reduce_scatter**: the mirror — intra-rack ring
+      reduce-scatter over the coarse chunks, then one cross-rack
+      counterpart exchange shipping each rack's partial of the
+      destination's own chunk (``reduce=True``)."""
     racks = list(graph.racks().values())
     if len(racks) < 2:
         raise SynthesisError("hierarchical needs >= 2 racks")
@@ -199,6 +219,10 @@ def _hierarchical(graph: CommGraph, collective: str,
         raise SynthesisError(
             "hierarchical needs equal-size racks, got "
             f"{[len(r) for r in racks]}")
+    if collective == "all_gather":
+        return _hier_all_gather(racks, nbytes)
+    if collective == "reduce_scatter":
+        return _hier_reduce_scatter(racks, nbytes)
     chunks = partition(nbytes, k)
     steps: List[List[TransferStep]] = []
     # Intra-rack reduce-scatter: every rack steps in lockstep, so the
@@ -252,6 +276,92 @@ def _hierarchical(graph: CommGraph, collective: str,
     return steps
 
 
+def _hier_all_gather(racks: List[List[str]],
+                     nbytes: int) -> List[List[TransferStep]]:
+    """Two-level all_gather over the n-way global chunking (rack-major
+    order, so rack ``r`` position ``j`` owns global chunk ``r·k+j``):
+    one ``xr`` counterpart-exchange group, then ``k-1`` lockstep
+    intra-rack ring steps gathering the coarse chunks (each coarse
+    chunk is ``R`` non-contiguous pieces, one leg per piece)."""
+    R, k = len(racks), len(racks[0])
+    chunks = partition(nbytes, R * k)
+    steps: List[List[TransferStep]] = []
+    xr = []
+    for r in range(R):
+        for j in range(k):
+            off, ln = chunks[r * k + j]
+            if ln == 0:
+                continue
+            for r2 in range(R):
+                if r2 == r:
+                    continue
+                xr.append(TransferStep(
+                    src=racks[r][j], dst=racks[r2][j],
+                    offset=off, nbytes=ln, reduce=False, phase="xr"))
+    if xr:
+        steps.append(xr)
+    for s in range(k - 1):
+        group = []
+        for r in range(R):
+            for i in range(k):
+                c = (i - s) % k
+                for r2 in range(R):
+                    off, ln = chunks[r2 * k + c]
+                    if ln == 0:
+                        continue
+                    group.append(TransferStep(
+                        src=racks[r][i], dst=racks[r][(i + 1) % k],
+                        offset=off, nbytes=ln, reduce=False,
+                        phase="ag"))
+        if group:
+            steps.append(group)
+    return steps
+
+
+def _hier_reduce_scatter(racks: List[List[str]],
+                         nbytes: int) -> List[List[TransferStep]]:
+    """Two-level reduce_scatter, the all_gather mirror: ``k-1``
+    lockstep intra-rack ring reduce-scatter steps over the coarse
+    chunks (after which position ``j`` owns its rack's partial of
+    every rack's ``j``-th global chunk), then one ``xr`` counterpart
+    group where each node ships the partial of its position-mate's
+    own chunk with ``reduce=True`` — every node ends owning its fully
+    reduced global chunk ``r·k+j``."""
+    R, k = len(racks), len(racks[0])
+    chunks = partition(nbytes, R * k)
+    steps: List[List[TransferStep]] = []
+    for s in range(k - 1):
+        group = []
+        for r in range(R):
+            for i in range(k):
+                c = (i - s - 1) % k
+                for r2 in range(R):
+                    off, ln = chunks[r2 * k + c]
+                    if ln == 0:
+                        continue
+                    group.append(TransferStep(
+                        src=racks[r][i], dst=racks[r][(i + 1) % k],
+                        offset=off, nbytes=ln, reduce=True,
+                        phase="rs"))
+        if group:
+            steps.append(group)
+    xr = []
+    for r in range(R):
+        for j in range(k):
+            for r2 in range(R):
+                if r2 == r:
+                    continue
+                off, ln = chunks[r2 * k + j]
+                if ln == 0:
+                    continue
+                xr.append(TransferStep(
+                    src=racks[r][j], dst=racks[r2][j],
+                    offset=off, nbytes=ln, reduce=True, phase="xr"))
+    if xr:
+        steps.append(xr)
+    return steps
+
+
 def estimate_cost_s(graph: CommGraph,
                     steps: List[List[TransferStep]]) -> float:
     """Cost of a lowered schedule under the graph: per group, every
@@ -280,22 +390,28 @@ def _lower(graph: CommGraph, algorithm: str, collective: str,
         return _tree(order, collective, nbytes)
     if algorithm == "hierarchical":
         return _hierarchical(graph, collective, nbytes)
+    if algorithm == "searched":
+        # Lazy import: search.py scores candidates with THIS module's
+        # cost model and verifies them against THIS module's oracle.
+        from container_engine_accelerators_tpu.collectives import search
+        return search.search_steps(graph, collective, nbytes)
     raise SynthesisError(f"unknown algorithm {algorithm!r}")
 
 
 def synthesize(graph: CommGraph, collective: str, nbytes: int,
                algorithm: Optional[str] = None) -> Schedule:
     """Lower ``collective`` over ``graph``; with ``algorithm=None``
-    every family that can lower this shape is costed and the cheapest
-    wins (ties break by the ALGORITHMS preference order).  A fleet
-    mid-partition prices every candidate at infinity — the cheapest
-    is still returned (legs will fail, the caller retries, and the
-    heal's signature change re-synthesizes)."""
+    every auto family that can lower this shape is costed and the
+    cheapest wins (ties break by the AUTO_ALGORITHMS preference
+    order; ``searched`` is pin-only).  A fleet mid-partition prices
+    every candidate at infinity — the cheapest is still returned
+    (legs will fail, the caller retries, and the heal's signature
+    change re-synthesizes)."""
     if collective not in COLLECTIVES:
         raise SynthesisError(f"unknown collective {collective!r}")
     if nbytes <= 0:
         raise SynthesisError("collective payload must be > 0 bytes")
-    candidates = [algorithm] if algorithm else list(ALGORITHMS)
+    candidates = [algorithm] if algorithm else list(AUTO_ALGORITHMS)
     best: Optional[Schedule] = None
     for rank, algo in enumerate(candidates):
         try:
@@ -370,7 +486,17 @@ class Synthesizer:
 def combine(dst: bytearray, offset: int, payload: bytes) -> None:
     """Elementwise byte-add mod 256 — the rig's reduction operator:
     cheap, commutative, associative, and a dropped or duplicated leg
-    changes the result (the verification actually verifies)."""
+    changes the result (the verification actually verifies).  uint8
+    addition wraps mod 256 natively, so the vectorized path is
+    bit-identical to the loop — it exists because oracle verification
+    of searched schedules runs at real payload sizes, and the routed
+    plane reduces inside daemon landing threads."""
+    n = len(payload)
+    if _np is not None and n >= 64:
+        view = _np.frombuffer(dst, dtype=_np.uint8, count=n,
+                              offset=offset)
+        view += _np.frombuffer(payload, dtype=_np.uint8, count=n)
+        return
     for i, b in enumerate(payload):
         j = offset + i
         dst[j] = (dst[j] + b) & 0xFF

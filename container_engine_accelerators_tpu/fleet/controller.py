@@ -635,9 +635,14 @@ class FleetController:
             per_node_ok[name] += n
         for name, n in entry.pop("per_node_failed").items():
             per_node_failed[name] += n
-        self.telemetry.collective_rounds.append(
-            {k: entry[k] for k in ("ok", "algorithm", "busbw_bps",
-                                   "resynth")})
+        tele = {k: entry[k] for k in ("ok", "algorithm", "busbw_bps",
+                                      "resynth")}
+        if "routed" in entry:
+            # Routed-mode lane accounting rides along so the
+            # min_forward_bytes / max_coordinator_leg_bytes SLOs can
+            # judge the pure-control-plane claim per run.
+            tele["routed"] = entry["routed"]
+        self.telemetry.collective_rounds.append(tele)
         return entry
 
     def _ring(self) -> List[tuple]:

@@ -148,6 +148,20 @@ SLO_KEYS = {
                             "bus bandwidth of the final completed "
                             "collective round (bytes/s) — the "
                             "post-heal recovery floor"),
+    # Routed-mode lane accounting (collective: {routed: true}): the
+    # forwarding-plane proof as SLOs.  The floor demands the daemons
+    # actually moved payload daemon->daemon; the ceiling (0 in the
+    # pinned scenarios) is the pure-control-plane claim — any leg
+    # payload crossing a coordinator client (a downgraded leg) is
+    # counted against it.
+    "min_forward_bytes": ("floor",
+                          "daemon-forwarded payload bytes over "
+                          "completed routed collective rounds"),
+    "max_coordinator_leg_bytes": ("ceiling",
+                                  "routed-leg payload bytes that "
+                                  "crossed coordinator clients "
+                                  "(downgraded legs; 0 = pure "
+                                  "control plane)"),
     # Exposed-communication ceiling (obs/critpath.py): DCN time not
     # hidden behind staging, over the run's pipelined transfers.  The
     # inputs (`dcn.exposed` / `dcn.comm` histogram sums) are recorded
@@ -796,9 +810,19 @@ class FleetTelemetry:
         floor on a workload that never ran must fail, not pass)."""
         done = [r.get("busbw_bps", 0.0)
                 for r in self.collective_rounds if r.get("ok")]
+        routed = [r["routed"] for r in self.collective_rounds
+                  if r.get("ok") and r.get("routed")]
         return {
             "min_busbw_bps": (sum(done) / len(done)) if done else 0.0,
             "min_final_busbw_bps": done[-1] if done else 0.0,
+            # No routed rounds: the floor honestly breaches (a
+            # forwarding proof on a workload that never forwarded must
+            # fail), the ceiling is vacuously inside 0.
+            "min_forward_bytes": float(sum(
+                r.get("forward_bytes", 0) for r in routed)),
+            "max_coordinator_leg_bytes": float(sum(
+                r.get("coordinator_payload_bytes", 0)
+                for r in routed)),
         }
 
     def _serving_measurements(self, elapsed_s: float) -> dict:

@@ -154,6 +154,17 @@ from container_engine_accelerators_tpu.obs import timeseries, trace
 from container_engine_accelerators_tpu.parallel import dcn_shm
 from container_engine_accelerators_tpu.utils import netio
 
+# The forward op's reduce landing byte-adds payloads mod 256 — the
+# same commutative combine collectives/synth.py simulates, duplicated
+# here (like the wire constants) so the daemon stays importable
+# without the collectives stack.  numpy when present: routed
+# all_reduce legs land O(payload) combines on the daemon's data
+# threads, and the byte loop would dominate the measured window.
+try:
+    import numpy as _np
+except ImportError:  # pragma: no cover - baked into the image
+    _np = None
+
 log = logging.getLogger(__name__)
 
 VERSION = "pyxferd/3"
@@ -172,6 +183,13 @@ CHUNK_STAGE_WAIT_S = 5.0
 # Per-call cap on the blocking wait op: the client re-issues slices, so
 # a daemon thread is never parked longer than this on one request.
 MAX_WAIT_SLICE_S = 30.0
+# Bounded per-hop retry for the forward op (daemon-routed schedule
+# legs): attempts are per forward REQUEST — the coordinator's own
+# engine-level retry re-posts the leg under the same seq, so the two
+# layers compose without double-landing (the dedup window is the
+# exactly-once guarantee either way).
+FORWARD_ATTEMPTS = 3
+FORWARD_RETRY_BACKOFF_S = 0.05
 
 # Link-shim latency cap, mirroring fleet.links.MAX_INJECT_LATENCY_S
 # (deliberately duplicated — the daemon must stay importable without
@@ -354,6 +372,22 @@ class _Flow:
 _recv_exact = netio.recv_exact
 
 
+def _combine_into(dst, offset: int, payload) -> None:
+    """``dst[offset+i] = (dst[offset+i] + payload[i]) % 256`` in place
+    — the forward op's reduce landing.  ``dst`` is the flow's staging
+    bytearray or a writable segment view; semantics mirror
+    ``collectives.synth.combine`` byte-for-byte (a cross-test pins
+    the two)."""
+    n = len(payload)
+    if _np is not None and n >= 64:
+        view = _np.frombuffer(dst, dtype=_np.uint8, count=n,
+                              offset=offset)
+        view += _np.frombuffer(payload, dtype=_np.uint8, count=n)
+        return
+    for i in range(n):
+        dst[offset + i] = (dst[offset + i] + payload[i]) & 0xFF
+
+
 def _set_nodelay(sock: socket.socket) -> None:
     """Chunked frames are header+payload pairs and DXR1 replies are
     header+data pairs: Nagle coalescing against delayed ACKs costs
@@ -521,7 +555,8 @@ class PyXferd:
                  data_host: str = "127.0.0.1",
                  shm: Optional[bool] = None,
                  host_id: Optional[str] = None,
-                 shm_direct: Optional[bool] = None):
+                 shm_direct: Optional[bool] = None,
+                 forward: Optional[bool] = None):
         self.uds_dir = uds_dir
         self.node = node
         self.net = net
@@ -543,6 +578,15 @@ class PyXferd:
         # surface the scenarios interpose on.
         self.shm_direct = (dcn_shm.shm_direct_enabled()
                            if shm_direct is None else bool(shm_direct))
+        # Daemon-routed forwarding (the collective engine's routed
+        # execution mode): willingness to serve ``forward`` ops —
+        # re-sending a staged flow range straight to a peer daemon.
+        # ``forward=False`` is the capability-less test handle: the
+        # op vanishes from the version handshake AND the dispatch
+        # table ("unknown op"), which is the client's mid-schedule
+        # downgrade signal.
+        self.forward_enabled = (True if forward is None
+                                else bool(forward))
         self.data_port = 0
         self.generation = 0
         self._flows: Dict[str, _Flow] = {}
@@ -815,9 +859,13 @@ class PyXferd:
                 del self._flows[name]
             self._publish_flow_gauges_locked()
             self._landed.notify_all()  # waiters re-check released flows
-            ring_ids = {f"ring:{n}" for n in released}
+            # Ring completer and forward-op streams are keyed by flow
+            # (pseudo conn ids), not by the owning control connection
+            # — release them with the flows they served.
+            flow_ids = ({f"ring:{n}" for n in released}
+                        | {f"fwd:{n}" for n in released})
             stale = [k for k in self._peer_conns
-                     if k[0] == conn_id or k[0] in ring_ids]
+                     if k[0] == conn_id or k[0] in flow_ids]
             conns = [self._peer_conns.pop(k) for k in stale]
             lanes = list(self._peer_lanes.values()) if released else []
         for pc in conns:
@@ -847,6 +895,11 @@ class PyXferd:
         if op == "version":
             resp = {"ok": True, "version": VERSION, "frame_version": 2,
                     "pipeline": 1}
+            if self.forward_enabled:
+                # Daemon-routed forwarding: coordinators only post
+                # forwarding programs to daemons that advertise it and
+                # downgrade legs on daemons that do not.
+                resp["forward"] = 1
             if self.shm_enabled:
                 # The zero-copy lane's capability triple: clients take
                 # it only on an exact host_id match (boot identity —
@@ -896,9 +949,10 @@ class PyXferd:
                 f.close_segment()
                 del self._flows[req["flow"]]
                 self._publish_flow_gauges_locked()
-                ring_id = f"ring:{req['flow']}"
+                flow_ids = (f"ring:{req['flow']}",
+                            f"fwd:{req['flow']}")
                 stale = [k for k in self._peer_conns
-                         if k[0] == ring_id]
+                         if k[0] in flow_ids]
                 conns = [self._peer_conns.pop(k) for k in stale]
                 lanes = list(self._peer_lanes.values())
             for pc in conns:
@@ -925,6 +979,12 @@ class PyXferd:
             return self._shm_read(req)
         if op == "shm_post":
             return self._shm_post(req)
+        if op == "forward" and self.forward_enabled:
+            # Gated on the capability flag so a forward-less daemon
+            # answers "unknown op" — byte-identical to a daemon that
+            # predates the op, which is what the client's downgrade
+            # path keys on.
+            return self._forward(req)
         return {"ok": False, "error": f"unknown op: {op}"}
 
     def _wait(self, req: dict) -> dict:
@@ -1149,6 +1209,158 @@ class PyXferd:
             # The striped sender uses this to retransmit chunks the
             # link ate without waiting for a timeout.
             resp["verdict"] = verdict
+        return resp
+
+    def _forward(self, req: dict) -> dict:
+        """One routed schedule leg: re-send staged bytes
+        ``[offset, offset+bytes)`` of ``flow`` straight to the peer
+        daemon at (host, port) as a forward frame — the coordinator
+        posts the program and collects this verdict; the payload never
+        crosses its clients.
+
+        The frame's seq is COORDINATOR-ASSIGNED (required, > 0): the
+        destination flow's dedup window is shared by every source
+        daemon forwarding into it, so only the schedule's author can
+        hand out non-colliding numbers.  A re-post of the same leg
+        re-sends the same seq and lands exactly once — the "dup"
+        verdict IS success (the bytes are already there), and the
+        chaos tests scrape it as the dedup evidence.  Retries here are
+        PER-HOP and bounded (link drops, a redialed peer stream);
+        terminal verdicts surface to the coordinator, whose
+        engine-level retry re-posts the leg or downgrades it."""
+        flow = req["flow"]
+        host = req.get("host", "127.0.0.1")
+        port = int(req["port"])
+        seq = int(req.get("seq") or 0)
+        offset = int(req.get("offset") or 0)
+        nbytes = int(req.get("bytes") or 0)
+        total = int(req.get("total") or 0)
+        red = 1 if req.get("reduce") else 0
+        attempts = max(1, int(req.get("attempts")
+                              or FORWARD_ATTEMPTS))
+        if seq <= 0 or offset < 0 or nbytes <= 0:
+            return {"ok": False,
+                    "error": "forward needs seq > 0, offset >= 0 "
+                             "and bytes > 0"}
+        stage_wait_s = min(
+            float(req.get("stage_wait_ms")
+                  or CHUNK_STAGE_WAIT_S * 1e3) / 1e3,
+            CHUNK_STAGE_WAIT_S)
+        # The source range may still be landing (an earlier leg of
+        # the same round targets this daemon): park on the landing CV
+        # like an offset send, then copy under the lock.
+        with self._landed:
+            staged = self._landed.wait_for(
+                lambda: (self._flows.get(flow) is None
+                         or self._flows[flow].range_staged(offset,
+                                                           nbytes)),
+                timeout=stage_wait_s)
+            f = self._flows.get(flow)
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            if not staged:
+                return {"ok": False,
+                        "error": f"range not staged for flow "
+                                 f"{flow!r} [{offset}:"
+                                 f"{offset + nbytes}]"}
+            payload = f.read_range(offset, nbytes)
+        meta = {"src": self.node, "fwd": 1, "off": offset,
+                "tot": total, "red": red}
+        ctx = trace.context()
+        if ctx is not None:
+            meta.update(ctx)
+        t0 = time.monotonic()
+        verdict = None
+        used = 0
+        last_err = None
+        with trace.span("xferd.forward", histogram="xferd.forward",
+                        flow=flow, node=self.node,
+                        dst=f"{host}:{port}", seq=seq,
+                        bytes=nbytes) as span:
+            for attempt in range(attempts):
+                used = attempt + 1
+                if attempt:
+                    counters.inc("xferd.forward.retries")
+                    time.sleep(FORWARD_RETRY_BACKOFF_S * attempt)
+                try:
+                    if self.net is not None:
+                        # Fleet mode: through the link table, the
+                        # landing verdict coming straight back.  Only
+                        # "dropped" is retryable — the retransmit
+                        # carries the SAME seq, so a frame that
+                        # actually landed cannot double-land.
+                        verdict = self.net.deliver(
+                            self.node, host, port, flow, payload,
+                            seq, meta)
+                        if verdict != "dropped":
+                            break
+                    else:
+                        # Proc mode: the link shim interposes per
+                        # attempt, then the frame rides a persistent
+                        # peer stream keyed by the SOURCE flow
+                        # (shared by every leg this daemon forwards
+                        # for it; _PeerConn redials after a break).
+                        shim, delay_s = self._shim_consult(host,
+                                                           port)
+                        if shim == "blocked":
+                            counters.inc("fleet.link.blocked")
+                            span.annotate(verdict="blocked")
+                            return {"ok": False,
+                                    "verdict": "blocked",
+                                    "error": f"forward failed: link "
+                                             f"to {host}:{port} "
+                                             f"partitioned "
+                                             f"(injected)"}
+                        if delay_s > 0:
+                            time.sleep(delay_s)
+                        if shim == "dropped":
+                            counters.inc("fleet.link.dropped")
+                            verdict = "dropped"
+                            continue  # retransmit under the same seq
+                        self._peer_conn(f"fwd:{flow}", host,
+                                        port).send_frame(
+                            host, port,
+                            [encode_frame_header(flow, len(payload),
+                                                 seq, meta),
+                             payload])
+                        verdict = "sent"
+                        break
+                except OSError as e:
+                    # Peer stream died (or the fabric reports the
+                    # link down): _PeerConn already reset itself, so
+                    # the next attempt redials.  LinkPartitioned is
+                    # an OSError too — one more look costs nothing
+                    # and heals a mid-schedule repartition race.
+                    last_err = e
+                    verdict = None
+            span.annotate(verdict=verdict or "error", attempts=used)
+        if verdict not in ("landed", "dup", "sent"):
+            # Terminal for THIS hop: the coordinator re-posts the leg
+            # (same seq — dedup keeps it exactly-once) or downgrades
+            # it to a coordinator-routed leg.
+            detail = verdict or last_err or "undeliverable"
+            return {"ok": False, "verdict": verdict,
+                    "attempts": used,
+                    "error": f"forward not landed: {detail}"}
+        micros = max(1.0, (time.monotonic() - t0) * 1e6)
+        # Forwarded legs are their own lane: never ``xferd.tx.bytes``
+        # (the socket-lane proof series) and never a coordinator
+        # client's dcn.tx/rx — which is exactly how the routed runner
+        # PROVES zero payload bytes crossed the coordinator.
+        counters.inc("xferd.forward.frames")
+        timeseries.record("dcn.lane.forward.bytes", nbytes)
+        timeseries.gauge_add("dcn.lane.forward.total_bytes", nbytes)
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is not None:
+                f.transferred += nbytes
+                self._total_transferred += nbytes
+                self._publish_flow_gauges_locked()
+        resp = {"ok": True, "bytes": nbytes,
+                "micros": round(micros, 1),
+                "gbps": round(nbytes * 8 / micros / 1e3, 3),
+                "lane": "forward", "verdict": verdict,
+                "attempts": used}
         return resp
 
     def _materialize(self, flow: str, offset: Optional[int],
@@ -2057,7 +2269,10 @@ class PyXferd:
         target = None
         gen = None
         off = meta.get("off")
-        if off is not None and seq is not None:
+        # Forward frames carry off/tot too, but they land into the
+        # flow's COMPLETED staging (possibly combining), never into an
+        # assembly — the copy path below is their only correct route.
+        if off is not None and seq is not None and not meta.get("fwd"):
             try:
                 off = int(off)
                 tot = int(meta.get("tot") or 0)
@@ -2118,84 +2333,109 @@ class PyXferd:
         bytes to the live transfer ("stale").
         """
         meta = meta or {}
-        with trace.attach(meta.get("trace"), meta.get("span")):
-            with trace.span("xferd.land", histogram="xferd.land",
-                            flow=flow, node=self.node, seq=seq,
-                            bytes=len(payload),
-                            src=meta.get("src", "")) as span:
+        # Waiters are woken AFTER the span context closes (the finally
+        # below, a second short lock hold): the span's JSONL record is
+        # written at context exit, so anything a wait-op client does
+        # after its wakeup — including scraping this daemon's trace
+        # file — happens-after the record exists.  Notifying inside
+        # the span (the old shape) let a woken reader race the flush,
+        # the cross-process trace test's flake.
+        notify = False
+        try:
+            with trace.attach(meta.get("trace"), meta.get("span")):
+                with trace.span("xferd.land", histogram="xferd.land",
+                                flow=flow, node=self.node, seq=seq,
+                                bytes=len(payload),
+                                src=meta.get("src", "")) as span:
+                    with self._lock:
+                        f = self._flows.get(flow)
+                        if f is None:
+                            self._unmatched += 1
+                            span.annotate(verdict="unmatched")
+                            return "unmatched"
+                        if preloaded_gen is not None \
+                                and (f.asm_gen != preloaded_gen
+                                     or f.asm_buf is None):
+                            # The assembly this chunk was received
+                            # into no longer exists (reset, new xid,
+                            # migration): drop BEFORE the seq is
+                            # marked seen, so the retransmit of these
+                            # bytes can still land.
+                            counters.inc("dcn.chunks.stale_drop")
+                            span.annotate(verdict="stale")
+                            return "stale"
+                        if (meta.get("off") is not None
+                                and not meta.get("fwd")
+                                and (meta.get("xid") or "")
+                                in f.retired_xids):
+                            # A straggler from a transfer this flow
+                            # moved past (a ring completer's late
+                            # send, a slow retransmit): dropping it —
+                            # seq unmarked — keeps the LIVE assembly
+                            # intact instead of letting the dead xid
+                            # reset it.
+                            counters.inc("dcn.chunks.stale_drop")
+                            span.annotate(verdict="stale")
+                            return "stale"
+                        if seq:  # seq 0 == staging, dedup-exempt
+                            if (seq in f.seen_seqs
+                                    or (f.max_seq - seq)
+                                    >= DEDUP_WINDOW):
+                                span.annotate(verdict="dup")
+                                counters.inc("dcn.frames.deduped")
+                                return "dup"
+                            f.seen_seqs.add(seq)
+                            f.max_seq = max(f.max_seq, seq)
+                            # Bound the window: forget fallen-out
+                            # seqs.
+                            if len(f.seen_seqs) > 2 * DEDUP_WINDOW:
+                                floor = f.max_seq - DEDUP_WINDOW
+                                f.seen_seqs = {s for s in f.seen_seqs
+                                               if s >= floor}
+                        verdict = self._land_locked(flow, f, payload,
+                                                    meta, seq,
+                                                    in_place,
+                                                    preloaded_gen)
+                        notify = True
+                    span.annotate(verdict=verdict)
+                    if verdict == "landed":
+                        # Goodput = bytes that landed USEFULLY: dups
+                        # and link-eaten frames never reach here.  A
+                        # frame is remote-origin when it rode the
+                        # fleet fabric or carries a sender's node
+                        # stamp; everything else is local staging,
+                        # tracked as its own series so the stage rate
+                        # never inflates goodput.
+                        remote = (link is not None
+                                  or bool(meta.get("src")))
+                        if remote:
+                            # Cumulative landed-frame count: the
+                            # scrapeable denominator for fleet
+                            # dedup/retransmit ratios when there is no
+                            # link table to read (the process-mode
+                            # aggregator's HTTP path).
+                            counters.inc("xferd.frames.landed")
+                            timeseries.record("xferd.rx.bytes",
+                                              len(payload))
+                            timeseries.record(f"goodput.flow.{flow}",
+                                              len(payload))
+                            if self.node:
+                                timeseries.record(
+                                    f"goodput.node.{self.node}",
+                                    len(payload))
+                            if link is not None:
+                                timeseries.record(
+                                    f"goodput.link."
+                                    f"{link[0]}->{link[1]}",
+                                    len(payload))
+                        else:
+                            timeseries.record("xferd.stage.bytes",
+                                              len(payload))
+                    return verdict
+        finally:
+            if notify:
                 with self._lock:
-                    f = self._flows.get(flow)
-                    if f is None:
-                        self._unmatched += 1
-                        span.annotate(verdict="unmatched")
-                        return "unmatched"
-                    if preloaded_gen is not None \
-                            and (f.asm_gen != preloaded_gen
-                                 or f.asm_buf is None):
-                        # The assembly this chunk was received into no
-                        # longer exists (reset, new xid, migration):
-                        # drop BEFORE the seq is marked seen, so the
-                        # retransmit of these bytes can still land.
-                        counters.inc("dcn.chunks.stale_drop")
-                        span.annotate(verdict="stale")
-                        return "stale"
-                    if (meta.get("off") is not None
-                            and (meta.get("xid") or "")
-                            in f.retired_xids):
-                        # A straggler from a transfer this flow moved
-                        # past (a ring completer's late send, a slow
-                        # retransmit): dropping it — seq unmarked —
-                        # keeps the LIVE assembly intact instead of
-                        # letting the dead xid reset it.
-                        counters.inc("dcn.chunks.stale_drop")
-                        span.annotate(verdict="stale")
-                        return "stale"
-                    if seq:  # seq 0 == staging chunk, dedup-exempt
-                        if (seq in f.seen_seqs
-                                or (f.max_seq - seq) >= DEDUP_WINDOW):
-                            span.annotate(verdict="dup")
-                            counters.inc("dcn.frames.deduped")
-                            return "dup"
-                        f.seen_seqs.add(seq)
-                        f.max_seq = max(f.max_seq, seq)
-                        # Bound the window: forget seqs that fell out.
-                        if len(f.seen_seqs) > 2 * DEDUP_WINDOW:
-                            floor = f.max_seq - DEDUP_WINDOW
-                            f.seen_seqs = {s for s in f.seen_seqs
-                                           if s >= floor}
-                    verdict = self._land_locked(flow, f, payload,
-                                                meta, seq, in_place,
-                                                preloaded_gen)
                     self._landed.notify_all()
-                span.annotate(verdict=verdict)
-                if verdict == "landed":
-                    # Goodput = bytes that landed USEFULLY: dups and
-                    # link-eaten frames never reach here.  A frame is
-                    # remote-origin when it rode the fleet fabric or
-                    # carries a sender's node stamp; everything else is
-                    # local staging, tracked as its own series so the
-                    # stage rate never inflates goodput.
-                    remote = link is not None or bool(meta.get("src"))
-                    if remote:
-                        # Cumulative landed-frame count: the scrapeable
-                        # denominator for fleet dedup/retransmit ratios
-                        # when there is no link table to read (the
-                        # process-mode aggregator's HTTP path).
-                        counters.inc("xferd.frames.landed")
-                        timeseries.record("xferd.rx.bytes", len(payload))
-                        timeseries.record(f"goodput.flow.{flow}",
-                                          len(payload))
-                        if self.node:
-                            timeseries.record(
-                                f"goodput.node.{self.node}", len(payload))
-                        if link is not None:
-                            timeseries.record(
-                                f"goodput.link.{link[0]}->{link[1]}",
-                                len(payload))
-                    else:
-                        timeseries.record("xferd.stage.bytes",
-                                          len(payload))
-                return verdict
 
     def _ensure_assembly_locked(self, f: _Flow, xid: str,
                                 tot: int):
@@ -2243,6 +2483,36 @@ class PyXferd:
         """Write one (deduped) frame into flow state; caller holds the
         lock."""
         off = meta.get("off")
+        if meta.get("fwd"):
+            # Forward frame (a routed schedule leg): lands INTO the
+            # flow's completed staging at its offset — combining when
+            # the leg reduces, overwriting when it gathers — never
+            # into an assembly.  The baseline frame (the coordinator's
+            # setup put) must already be staged: schedule legs write
+            # regions of a buffer whose geometry the schedule fixed
+            # up front, so a missing baseline is a protocol error the
+            # source daemon surfaces for the coordinator to re-post.
+            off = int(off or 0)
+            n = len(payload)
+            if (not f.frame_bytes or off < 0
+                    or off + n > len(f.staged)):
+                counters.inc("dcn.chunks.rejected")
+                log.error("rejecting forward frame with bad geometry:"
+                          " flow=%s off=%d len=%d staged=%d", flow,
+                          off, n, len(f.staged))
+                return "rejected"
+            if not isinstance(f.staged, (bytearray, memoryview)):
+                # First forward into this flow: staging becomes
+                # writable in place (segment-backed staging already
+                # is).
+                f.staged = bytearray(f.staged)
+            if meta.get("red"):
+                _combine_into(f.staged, off, payload)
+            else:
+                f.staged[off:off + n] = payload
+            f.rx_bytes += n
+            counters.inc("xferd.forward.landed")
+            return "landed"
         if off is None:
             # Whole-payload frame: replaces staging wholesale and
             # cancels any in-progress assembly (the serial fallback

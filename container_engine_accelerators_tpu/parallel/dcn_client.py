@@ -165,6 +165,13 @@ class DcnXferClient:
         selection."""
         return bool(self.capabilities().get("shm", 0))
 
+    def supports_forward(self) -> bool:
+        """The daemon serves the ``forward`` op (daemon-routed
+        schedule legs).  False for the native daemon and forward-less
+        daemons — the routed collective runner's signal to downgrade
+        that node's legs to coordinator-routed sends mid-schedule."""
+        return bool(self.capabilities().get("forward", 0))
+
     # -- shm lane ops (zero-copy same-host staging; fleet/xferd.py) ----------
 
     def shm_attach(self, flow: str, nbytes: int,
@@ -268,6 +275,68 @@ class DcnXferClient:
         resp = self._call(**req)
         timeseries.record("dcn.tx.bytes", resp.get("bytes", 0))
         return resp
+
+    def forward(self, flow: str, host: str, port: int, nbytes: int,
+                offset: int = 0, seq: int = 0, total: int = 0,
+                reduce: bool = False,
+                attempts: Optional[int] = None,
+                stage_wait_ms: Optional[int] = None) -> dict:
+        """Post one routed schedule leg: the daemon re-sends its
+        staged ``[offset, offset+nbytes)`` of ``flow`` straight to
+        the peer daemon at (host, port) — a daemon→daemon hop.  This
+        round trip is CONTROL-ONLY: zero payload bytes cross this
+        socket (no ``dcn.tx/rx.bytes`` movement; the daemon accounts
+        the hop under ``dcn.lane.forward.*``), which is the lane-level
+        proof the routed collective runner leans on.
+
+        ``seq`` is CALLER-ASSIGNED (required > 0, unlike ``send``):
+        the destination flow's dedup window is shared by every source
+        daemon forwarding into it, so only the schedule's author can
+        hand out non-colliding numbers — and a caller-level re-post
+        of a failed leg reuses the seq it burned, landing exactly
+        once.  Returns the daemon's response (bytes/micros/lane/
+        verdict/attempts); raises :class:`DcnXferError` when the hop
+        stayed undelivered after the daemon's bounded per-hop retry.
+        """
+        req = {"op": "forward", "flow": flow, "host": host,
+               "port": str(port), "bytes": int(nbytes),
+               "offset": int(offset), "seq": int(seq)}
+        if total:
+            req["total"] = int(total)
+        if reduce:
+            req["reduce"] = 1
+        if attempts is not None:
+            req["attempts"] = int(attempts)
+        if stage_wait_ms is not None:
+            req["stage_wait_ms"] = int(stage_wait_ms)
+        return self._call(**req)
+
+    def put_range(self, flow: str, data: bytes, offset: int, seq: int,
+                  host: str, port: int, reduce: bool = False,
+                  total: int = 0) -> None:
+        """Coordinator-routed fallback for one forward leg: frame
+        ``data`` exactly as a peer daemon's forward would — same
+        forward meta, same caller-assigned seq, so landing, reduce
+        combining and dedup on the destination are indistinguishable
+        from the daemon→daemon hop (a leg downgraded mid-schedule
+        composes with forwarded replays of itself) — and write it to
+        the DESTINATION daemon's data port.  Payload bytes DO cross
+        this client, which is the point of the downgrade accounting:
+        ``dcn.stage.bytes`` moves, the forward lane does not."""
+        name = flow.encode()
+        meta = {"fwd": 1, "off": int(offset), "tot": int(total),
+                "red": 1 if reduce else 0}
+        ctx = trace.context()
+        if ctx is not None:
+            meta.update(ctx)
+        meta_b = json.dumps(meta).encode()
+        hdr = (b"DXF2" + struct.pack("<I", len(name))
+               + struct.pack("<Q", len(data))
+               + struct.pack("<Q", int(seq))
+               + struct.pack("<I", len(meta_b)))
+        with socket.create_connection((host, port), timeout=30) as s:
+            netio.sendall_parts(s, (hdr, name, meta_b, data))
+        timeseries.record("dcn.stage.bytes", len(data))
 
     READ_CHUNK = 512 << 10  # daemon caps per-call reads (outbuf bound)
 
@@ -591,6 +660,23 @@ class ResilientDcnXferClient(DcnXferClient):
         self._staged[flow] = bytes(data)
         return result
 
+    def put_range(self, flow: str, data: bytes, offset: int, seq: int,
+                  host: str, port: int, reduce: bool = False,
+                  total: int = 0) -> None:
+        """Downgraded-leg staging under the data-plane budget.  No
+        port re-resolution on failure (the destination is a REMOTE
+        daemon — only the routed runner can re-resolve its port), and
+        no restage cache: a replay of the same leg carries the same
+        seq, so the destination's dedup window makes the retry safe
+        whether or not the first frame landed."""
+        def attempt():
+            return DcnXferClient.put_range(self, flow, data, offset,
+                                           seq, host, port, reduce,
+                                           total)
+
+        return self._with_budget(attempt, "data plane", latch=False,
+                                 op="put_range")
+
     # How long a restage waits for its own payload to finish landing
     # through the local data plane before re-reading/re-sending.
     RESTAGE_RX_TIMEOUT_S = 30.0
@@ -628,12 +714,49 @@ class ResilientDcnXferClient(DcnXferClient):
             self._send_seq[flow] -= 1
             return super().send(flow, host, port, nbytes, direct)
 
-    def _restage(self, flow: str, data: bytes) -> None:
-        counters.inc("dcn.send.restaged")
+    def _restage(self, flow: str, data: bytes,
+                 op: str = "send") -> None:
+        counters.inc(f"dcn.{op}.restaged")
         with trace.span("dcn.restage", histogram="dcn.restage",
-                        flow=flow, bytes=len(data), op="send"):
+                        flow=flow, bytes=len(data), op=op):
             self.put(flow, data)
             self._wait_rx(flow, len(data), self.RESTAGE_RX_TIMEOUT_S)
+
+    def forward(self, flow: str, host: str, port: int, nbytes: int,
+                offset: int = 0, seq: int = 0, total: int = 0,
+                reduce: bool = False,
+                attempts: Optional[int] = None,
+                stage_wait_ms: Optional[int] = None) -> dict:
+        """``forward`` that survives the daemon-side flow dying with
+        the control connection (a daemon releases a flow when the
+        conn that registered it breaks, and this client's reconnect
+        replays the registration EMPTY).  When this client staged the
+        flow itself, a "not staged"/"unknown flow" answer restages
+        from the local cache and re-issues the SAME caller-assigned
+        seq — if the lost attempt actually delivered before its
+        answer vanished, the destination's dedup window drops the
+        replay: exactly-once either way.  Peer contributions landed
+        into the flow mid-round have no local cache and cannot be
+        healed here; the routed runner's verification phase is the
+        backstop that fails such a round."""
+        try:
+            return super().forward(flow, host, port, nbytes,
+                                   offset=offset, seq=seq,
+                                   total=total, reduce=reduce,
+                                   attempts=attempts,
+                                   stage_wait_ms=stage_wait_ms)
+        except DcnXferError as e:
+            data = self._staged.get(flow)
+            msg = str(e)
+            if data is None or ("not staged" not in msg
+                                and "unknown flow" not in msg):
+                raise
+            self._restage(flow, data, op="forward")
+            return super().forward(flow, host, port, nbytes,
+                                   offset=offset, seq=seq,
+                                   total=total, reduce=reduce,
+                                   attempts=attempts,
+                                   stage_wait_ms=stage_wait_ms)
 
     def read(self, flow: str, nbytes: int, offset: int = 0) -> bytes:
         """`read` that survives a daemon restart eating the staged

@@ -59,6 +59,11 @@ def main() -> None:
                         with open(tmp, "w") as f:
                             f.write(str(daemon.data_port))
                         os.rename(tmp, port_file)
+                        # Deterministic span flush: the daemon records
+                        # its xferd.land span BEFORE waking rx waiters,
+                        # so when this wait returns the landing span is
+                        # already on this worker's JSONL — no settle
+                        # sleep, no timing dependence.
                         dcn.wait_flow_rx(c, FLOW, len(payload),
                                          timeout_s=60)
                         got = c.read(FLOW, len(payload))

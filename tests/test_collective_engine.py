@@ -217,14 +217,21 @@ class TestSynthesis:
         with pytest.raises(synth.SynthesisError):
             synth.synthesize(_graph(specs=lopsided), "all_reduce",
                              1000, algorithm="hierarchical")
+        # all_gather / reduce_scatter now HAVE two-level lowerings;
+        # the shape guards still apply to them.
         with pytest.raises(synth.SynthesisError):
-            synth.synthesize(_graph(4, racks=2), "all_gather", 1000,
-                             algorithm="hierarchical")
+            synth.synthesize(_graph(specs=lopsided), "all_gather",
+                             1000, algorithm="hierarchical")
+        sched = synth.synthesize(_graph(4, racks=2), "all_gather",
+                                 1000, algorithm="hierarchical")
+        assert sched.algorithm == "hierarchical"
 
     def test_auto_choice_skips_unlowerable_candidates(self):
         sched = synth.synthesize(_graph(4, racks=1), "all_reduce", 1000)
         assert sched.algorithm in ("ring", "tree")
-        sched = synth.synthesize(_graph(4, racks=2), "all_gather", 1000)
+        lopsided = build_specs(5, racks=2)  # unequal racks
+        sched = synth.synthesize(_graph(specs=lopsided), "all_gather",
+                                 1000)
         assert sched.algorithm in ("ring", "tree")
 
     def test_degraded_cross_rack_tier_selects_hierarchical(self):

@@ -1,0 +1,235 @@
+"""Sketch-guided schedule search tests (collectives/search).
+
+The search is the fourth, pin-only synthesis family: enumerate a
+sketch grammar (ring orders, rack-gateway choices, cross-rack style,
+chunk granularity) over the MEASURED comm graph, score with the same
+alpha-beta cost model the auto chooser uses, and only ever emit a
+candidate the in-memory oracle verified.  These tests pin the grammar
+shape, the verify-everything contract, the degraded-edge avoidance
+that is the whole point (the pinned asymmetric rig), pin-only-ness,
+the Synthesizer cache/resynth integration, and that every schedule —
+searched or family — satisfies the routed runner's hazard-free
+condition.
+"""
+
+import pytest
+
+from container_engine_accelerators_tpu.collectives import search, synth
+from container_engine_accelerators_tpu.collectives.runner import (
+    DEFAULT_SPINE_FAULTS,
+    CollectiveEngine,
+)
+from container_engine_accelerators_tpu.collectives.topo import CommGraph
+from container_engine_accelerators_tpu.fleet.links import LinkTable
+from container_engine_accelerators_tpu.fleet.topology import (
+    FleetTopology,
+    build_specs,
+)
+from container_engine_accelerators_tpu.metrics import counters
+from container_engine_accelerators_tpu.obs import timeseries
+
+
+def _graph(nodes=4, racks=2, faults=(), rates=None, specs=None):
+    topo = FleetTopology(specs or build_specs(nodes, racks=racks))
+    links = LinkTable(topo)
+    for f in faults:
+        assert links.apply(f), f"fault {f!r} armed nothing"
+    return CommGraph.build(topo, links=links,
+                           rates=rates or (lambda a, b: 0.0))
+
+
+def _spine_rig():
+    """The pinned asymmetric rig the --compare gate runs: 5 nodes on
+    2 unequal racks (r0={n0,n2,n4}, r1={n1,n3}) with latency faults
+    on the rack-major ring's wrap edges — the shape where every auto
+    family pays a degraded edge and the search must not."""
+    return _graph(5, racks=2, faults=DEFAULT_SPINE_FAULTS)
+
+
+def _degraded_pairs(graph):
+    return {(a, b) for a in graph.nodes() for b in graph.nodes()
+            if a != b and graph.edge(a, b).degraded}
+
+
+def _legs(steps):
+    return [(t.src, t.dst) for group in steps for t in group]
+
+
+# ---- sketch grammar --------------------------------------------------------
+
+
+class TestSketchGrammar:
+    def test_single_rack_enumerates_only_ring_sketches(self):
+        sk = search.sketches(_graph(4, racks=1), 4096)
+        assert sk, "grammar empty on a trivial fleet"
+        assert {s.kind for s in sk} == {"ring"}
+        for s in sk:
+            assert sorted(s.order) == ["n0", "n1", "n2", "n3"]
+
+    def test_multi_rack_adds_gateway_family(self):
+        sk = search.sketches(_graph(4, racks=2), 4096)
+        kinds = {s.kind for s in sk}
+        assert kinds == {"ring", "gateway"}
+        gws = [s for s in sk if s.kind == "gateway"]
+        assert {s.xr_style for s in gws} == {"direct", "ring"}
+        assert {s.intra_style for s in gws} <= {"star", "ring"}
+        # gateway sketches name exactly one member per rack
+        for s in gws:
+            assert len(s.gateways) == 2
+        # direct style varies exchange granularity; every label is
+        # unique (the trace event that records the winner relies on
+        # labels being identities)
+        assert len({s.label() for s in sk}) == len(sk)
+
+    def test_grammar_is_bounded(self):
+        # 8 nodes / 4 racks: the caps (GATEWAYS_PER_RACK,
+        # MAX_GATEWAY_COMBOS, bounded two-opt) keep enumeration tiny.
+        sk = search.sketches(_graph(8, racks=4), 65536)
+        assert 0 < len(sk) <= 128
+
+
+# ---- search: verified, cheaper, degraded-edge avoiding ---------------------
+
+
+class TestSearch:
+    @pytest.mark.parametrize("collective", synth.COLLECTIVES)
+    @pytest.mark.parametrize("shape", [(4, 1), (4, 2), (5, 2), (8, 4)])
+    def test_searched_schedule_verifies_on_every_shape(
+            self, collective, shape):
+        nodes, racks = shape
+        g = _graph(nodes, racks=racks)
+        sched = synth.synthesize(g, collective, 4096,
+                                 algorithm="searched")
+        assert sched.algorithm == "searched"
+        inputs = synth.make_inputs(collective, sched.order, 4096,
+                                   seed=3)
+        out = synth.simulate(sched, inputs)
+        want = synth.expected_outputs(collective, sched.order,
+                                      inputs, 4096)
+        for name, (off, ln, data) in want.items():
+            assert bytes(out[name][off:off + ln]) == data, name
+
+    def test_searched_avoids_the_degraded_spine(self):
+        g = _spine_rig()
+        degraded = _degraded_pairs(g)
+        assert degraded, "spine faults armed nothing"
+        sched = synth.synthesize(g, "all_reduce", 65536,
+                                 algorithm="searched")
+        used = set(_legs(sched.steps))
+        assert not (used & degraded), (
+            f"searched schedule pays degraded edges {used & degraded}")
+
+    def test_searched_models_cheaper_than_every_auto_family(self):
+        g = _spine_rig()
+        searched = synth.synthesize(g, "all_reduce", 65536,
+                                    algorithm="searched")
+        for algo in synth.AUTO_ALGORITHMS:
+            try:
+                fam = synth.synthesize(g, "all_reduce", 65536,
+                                       algorithm=algo)
+            except synth.SynthesisError:
+                continue  # hierarchical can't lower unequal racks
+            assert searched.est_cost_s < fam.est_cost_s, algo
+
+    def test_counters_and_margin_gauge_move(self):
+        before_cand = counters.get("collective.search.candidates")
+        before_ver = counters.get("collective.search.verified")
+        synth.synthesize(_spine_rig(), "all_reduce", 65536,
+                         algorithm="searched")
+        assert counters.get("collective.search.candidates") \
+            > before_cand
+        assert counters.get("collective.search.verified") > before_ver
+        # On the spine rig the best family pays the degraded edges,
+        # so the recorded modeled margin is decisively > 1.
+        assert timeseries.gauges()["collective.search.margin"] > 1.0
+
+    def test_fully_partitioned_fleet_ships_least_bad(self):
+        """A node cut off in BOTH directions leaves no finite
+        candidate — the search keeps the families' mid-partition
+        contract: the least-bad schedule still ships (legs will fail,
+        the heal's signature change re-synthesizes) rather than
+        wedging planning."""
+        g = _graph(3, racks=1,
+                   faults=["node:n0->node:n1:partition",
+                           "node:n1->node:n0:partition",
+                           "node:n0->node:n2:partition",
+                           "node:n2->node:n0:partition"])
+        sched = synth.synthesize(g, "all_reduce", 4096,
+                                 algorithm="searched")
+        assert sched.algorithm == "searched"
+        assert sched.est_cost_s == float("inf")
+
+    def test_partition_with_a_route_around_is_pruned(self):
+        """One directed partition on a multi-rack fleet: candidates
+        through it price infinite and are pruned; the winner is
+        finite and never crosses the cut."""
+        g = _graph(4, racks=2,
+                   faults=["node:n0->node:n1:partition"])
+        before = counters.get("collective.search.pruned")
+        sched = synth.synthesize(g, "all_reduce", 8192,
+                                 algorithm="searched")
+        assert sched.est_cost_s != float("inf")
+        assert ("n0", "n1") not in set(_legs(sched.steps))
+        assert counters.get("collective.search.pruned") > before
+
+
+# ---- pin-only + synthesizer integration ------------------------------------
+
+
+class TestPinOnly:
+    def test_searched_is_registered_but_never_auto(self):
+        assert "searched" in synth.ALGORITHMS
+        assert "searched" not in synth.AUTO_ALGORITHMS
+        # auto choice on the rig where searched would win still stays
+        # inside the auto families
+        sched = synth.synthesize(_spine_rig(), "all_reduce", 65536)
+        assert sched.algorithm in synth.AUTO_ALGORITHMS
+
+    def test_synthesizer_caches_and_resynthesizes_searched(self):
+        topo = FleetTopology(build_specs(4, racks=2))
+        links = LinkTable(topo)
+        build = lambda: CommGraph.build(  # noqa: E731
+            topo, links=links, rates=lambda a, b: 0.0)
+        s = synth.Synthesizer("all_reduce", 8192,
+                              algorithm="searched")
+        first = s.schedule_for(build())
+        assert first.algorithm == "searched"
+        assert s.schedule_for(build()) is first  # signature held
+        links.apply("rack:r0<->rack:r1:latency:25")
+        faulted = s.schedule_for(build())
+        assert faulted is not first
+        assert faulted.algorithm == "searched"
+        assert s.resynth_count == 1
+        # the replanned schedule routes around the fresh evidence
+        degraded = _degraded_pairs(build())
+        assert degraded
+        # cross-rack legs can't vanish (the collective must cross),
+        # but the faulted plan was scored against the degraded costs
+        assert faulted.est_cost_s > first.est_cost_s
+
+
+# ---- hazard freedom (the routed runner's precondition) ---------------------
+
+
+class TestHazardFreedom:
+    @pytest.mark.parametrize("collective", synth.COLLECTIVES)
+    @pytest.mark.parametrize("algorithm", synth.ALGORITHMS)
+    @pytest.mark.parametrize("shape", [(4, 1), (4, 2), (6, 2)])
+    def test_every_lowerable_schedule_is_hazard_free(
+            self, collective, algorithm, shape):
+        """Routed execution snapshots nothing: within one barrier
+        group no leg may read a region another leg writes, and
+        same-region writes must both reduce.  Every family and every
+        searched schedule satisfies this by construction — so routed
+        mode never needs the coordinator fallback for schedules we
+        synthesize ourselves."""
+        nodes, racks = shape
+        g = _graph(nodes, racks=racks)
+        try:
+            sched = synth.synthesize(g, collective, 4096,
+                                     algorithm=algorithm)
+        except synth.SynthesisError:
+            pytest.skip(f"{algorithm} does not lower "
+                        f"{collective}@{shape}")
+        assert CollectiveEngine._hazard_free(sched), (
+            f"{algorithm} {collective} {shape} emitted a hazard")

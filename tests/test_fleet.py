@@ -662,8 +662,11 @@ class TestCrossNodeTrace:
         dcn.wait_flow_rx(ca, "t", N, timeout_s=10)
         with trace.span("test.transfer") as root:
             ca.send("t", "127.0.0.1", b.data_port, N)
+        # No settle sleep: land_frame records the xferd.land span
+        # BEFORE waking rx waiters (the notify sits in a finally after
+        # the span closes), so a returned wait_flow_rx guarantees the
+        # span is in the buffer.
         dcn.wait_flow_rx(cb, "t", N, timeout_s=10)
-        time.sleep(0.05)  # let the land span finish recording
         spans = trace.tail()
         mine = [s for s in spans if s["trace"] == root.trace_id]
         names = {s["name"] for s in mine}
