@@ -150,6 +150,21 @@ dcnbench:
 	$(PY) cmd/dcn_bench.py --compare --shm-exposed-gate \
 	    --sizes 65536,1048576,4194304 --iters 3
 
+# Universal submission-ring gate: the ring-lane suite — one doorbell
+# per round, backpressure batching, completer refusal, producer
+# semantics, the kill switch, plus the proc-mode doorbell-lost and
+# SIGKILL-mid-ring chaos scenarios (under -m slow in the same file) —
+# then the bench acceptance leg: the ring-socket AND producer modes
+# must beat the legacy stage-then-send pipelined baseline on the
+# exposed-comm ratio (--ring-exposed-gate), or the overlap claim is
+# marketing.  Folded into presubmit.
+.PHONY: ring
+ring:
+	$(PY) -m pytest tests/test_dcn_ring.py -q -p no:randomly
+	$(PY) cmd/dcn_bench.py --ring-socket --producer \
+	    --ring-exposed-gate --sizes 262144,1048576 --iters 3 \
+	    > /dev/null
+
 # Self-tuning data plane gate: the closed-loop controller end to end —
 # the decision-table/registry/integration suite (slow scenario e2es
 # included), then the CLI acceptance legs: the proc-mode
@@ -331,7 +346,7 @@ race:
 	rm -f $(RACE_REPORT)
 	TPU_LOCKWATCH=1 TPU_LOCKWATCH_REPORT=$(RACE_REPORT) \
 	    $(PY) -m pytest tests/test_dcn_pipeline.py tests/test_dcn_shm.py \
-	    tests/test_fleet.py \
+	    tests/test_dcn_ring.py tests/test_fleet.py \
 	    tests/test_fleet_proc.py tests/test_chaos.py tests/test_obs.py \
 	    tests/test_serving.py tests/test_profiler.py \
 	    tests/test_collective_engine.py tests/test_history.py \
@@ -401,6 +416,7 @@ presubmit:
 	$(MAKE) collectives
 	$(MAKE) searched
 	$(MAKE) tune
+	$(MAKE) ring
 	$(MAKE) prof
 	$(MAKE) soak
 	$(MAKE) trend

@@ -17,6 +17,16 @@ planes:
   a staging buffer and back out, no daemons.  This is the ceiling the
   same-host lane is stepping toward; it shares the JSONL so the gap
   is always on record next to the lanes;
+- ``ring_socket`` (``--ring-socket``): the universal-ring SOCKET lane
+  — descriptors posted to the flow's submission ring, ONE doorbell,
+  the daemon's completer driving the sends while the client stages
+  chunks straight onto the data socket.  Same wire bytes as
+  ``pipelined``; the difference the exposed-comm series must show is
+  WHERE the completion wait sits (behind staging, not after it);
+- ``producer`` (``--producer``): the overlap-ready producer-fed ring
+  lane — chunks pulled from an iterator as the ring round runs, so
+  production cost rides inside the completion window instead of in
+  front of it (the ``exchange_shard(producer=...)`` path);
 - ``tuned`` (``--tuned``): the closed-loop plane — the socket
   pipelined lane with ``parallel/dcn_tune.py`` adapting chunk/stripe
   from its own telemetry across iterations.  With ``--compare`` the
@@ -64,6 +74,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from container_engine_accelerators_tpu.fleet.xferd import (  # noqa: E402
     PyXferd,
+)
+from container_engine_accelerators_tpu.metrics import (  # noqa: E402
+    counters,
 )
 from container_engine_accelerators_tpu.obs import (  # noqa: E402
     history,
@@ -133,6 +146,27 @@ def parse_args(argv=None):
                         "control time behind staging")
     p.add_argument("--shm-exposed-slack", type=float, default=0.15,
                    help="noise allowance for --shm-exposed-gate")
+    p.add_argument("--ring-socket", action="store_true",
+                   help="add the 'ring_socket' series: the universal "
+                        "submission-ring socket lane (descriptors + "
+                        "one doorbell, completer-driven sends)")
+    p.add_argument("--producer", action="store_true",
+                   help="add the 'producer' series: the producer-fed "
+                        "ring lane — chunks pulled from an iterator "
+                        "inside the completion window (implies "
+                        "--ring-socket cells are comparable)")
+    p.add_argument("--ring-exposed-gate", action="store_true",
+                   help="fail when the ring_socket lane's exposed-"
+                        "comm ratio does not drop below the legacy "
+                        "socket pipeline's at the largest size (and, "
+                        "with --producer, when the producer-fed "
+                        "series does not stay below the stage-then-"
+                        "send baseline too) — the ring's whole claim "
+                        "is moving the completion wait behind staging")
+    p.add_argument("--ring-exposed-slack", type=float, default=0.0,
+                   help="noise allowance for --ring-exposed-gate "
+                        "(default 0: strictly below the legacy "
+                        "pipeline)")
     p.add_argument("--exposed-slack", type=float, default=0.0,
                    help="noise allowance for the pipelined-vs-serial "
                         "exposed-comm gate (default 0: strictly "
@@ -185,15 +219,16 @@ class BenchRig:
 
     def __init__(self):
         self.workdir = tempfile.mkdtemp(prefix="dcn-bench-")
-        # shm=True pins the daemons' capability regardless of the
-        # TPU_DCN_SHM env: the sweep forces the lane per mode (the
-        # client cfg side), so the daemons must always OFFER it or a
-        # kill-switched environment would crash the shm mode instead
-        # of benching it.
+        # shm=True / ring=True pin the daemons' capabilities
+        # regardless of the TPU_DCN_SHM / TPU_DCN_SHM_RING env: the
+        # sweep forces the lane per mode (the client cfg side), so the
+        # daemons must always OFFER them or a kill-switched
+        # environment would crash the shm/ring modes instead of
+        # benching them.
         self.a = PyXferd(os.path.join(self.workdir, "a"),
-                         node="bench-a", shm=True).start()
+                         node="bench-a", shm=True, ring=True).start()
         self.b = PyXferd(os.path.join(self.workdir, "b"),
-                         node="bench-b", shm=True).start()
+                         node="bench-b", shm=True, ring=True).start()
         self.ca = ResilientDcnXferClient(os.path.join(self.workdir, "a"))
         self.cb = ResilientDcnXferClient(os.path.join(self.workdir, "b"))
         self._n = 0
@@ -271,6 +306,12 @@ class BenchRig:
         # iteration's frame (rx accounting only ever grows).
         state["rx"] += n
         exposed_ratio = None
+        # Ring ridership pin: the ring modes must actually ride the
+        # submission ring — a silent fallback to the classic per-chunk
+        # path would bench the wrong plane under the right label.
+        ring_mode = mode in ("ring_socket", "producer")
+        rounds0 = (counters.get("dcn.ring.socket.rounds")
+                   if ring_mode else 0)
         try:
             t0 = time.perf_counter()
             with trace.span("bench.xfer", mode=mode, bytes=n):
@@ -293,9 +334,22 @@ class BenchRig:
                     exposed_ratio = 1.0
                     got = self.cb.read(flow, n)
                 else:
-                    res = dcn_pipeline.send_pipelined(
-                        self.ca, flow, payload, "127.0.0.1",
-                        self.b.data_port, cfg, timeout_s=30)
+                    if mode == "producer":
+                        # Producer-fed ring round: chunks pulled from
+                        # the iterator as the round runs — the
+                        # exchange_shard(producer=...) shape, minus
+                        # the collective bookkeeping.
+                        def _chunks(src=payload, step=cfg.chunk_bytes):
+                            for off in range(0, len(src), step):
+                                yield src[off:off + step]
+                        res = dcn_pipeline.send_pipelined(
+                            self.ca, flow, None, "127.0.0.1",
+                            self.b.data_port, cfg, timeout_s=30,
+                            producer=_chunks(), nbytes=n)
+                    else:
+                        res = dcn_pipeline.send_pipelined(
+                            self.ca, flow, payload, "127.0.0.1",
+                            self.b.data_port, cfg, timeout_s=30)
                     # The live accounting's verdict for THIS transfer
                     # (send_pipelined just set the gauge).
                     exposed_ratio = timeseries.gauges().get(
@@ -313,6 +367,13 @@ class BenchRig:
                         raise RuntimeError(
                             f"mode {mode} ran on lane "
                             f"{res.get('lane')!r} — the bench must "
+                            "measure the lane it says"
+                        )
+                    if ring_mode and counters.get(
+                            "dcn.ring.socket.rounds") <= rounds0:
+                        raise RuntimeError(
+                            f"mode {mode} fell back off the "
+                            "submission ring — the bench must "
                             "measure the lane it says"
                         )
             elapsed = time.perf_counter() - t0
@@ -342,9 +403,17 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
     # ``direct: 0`` on every send op so its bytes genuinely cross
     # TCP, while the shm series lets the daemon take the
     # daemon↔daemon segment lane.
+    # ring=False pins the LEGACY per-chunk socket pipeline — the
+    # stage-then-send baseline the ring series is judged against.
+    # Without the pin the universal ring (default on) would quietly
+    # turn the "pipelined" column into a second ring series and the
+    # ring-vs-legacy comparison would measure nothing.
     cfg_socket = dcn_pipeline.PipelineConfig(
         chunk_bytes=cfg.chunk_bytes, stripes=cfg.stripes, shm=False,
-        tuned=False, shm_direct=False)
+        tuned=False, shm_direct=False, ring=False)
+    cfg_ring = dcn_pipeline.PipelineConfig(
+        chunk_bytes=cfg.chunk_bytes, stripes=cfg.stripes, shm=False,
+        tuned=False, shm_direct=False, ring=True)
     cfg_shm = dcn_pipeline.PipelineConfig(
         chunk_bytes=cfg.chunk_bytes, stripes=cfg.stripes, shm=True,
         tuned=False, shm_direct=True)
@@ -377,6 +446,8 @@ def run_sweep(sizes, iters, cfg, sink, table=sys.stderr,
             for mode in modes:
                 mode_cfg = (cfg_shm if mode == "shm"
                             else cfg_tuned if mode == "tuned"
+                            else cfg_ring if mode in ("ring_socket",
+                                                      "producer")
                             else cfg_socket)
                 state = (None if mode == "memcpy"
                          else rig.open_flow(mode, size))
@@ -628,7 +699,14 @@ def main(argv=None):
         return 2
     cfg = dcn_pipeline.PipelineConfig(chunk_bytes=args.chunk_bytes,
                                       stripes=args.stripes)
-    modes = MODES + ("tuned",) if args.tuned else MODES
+    modes = MODES
+    if args.ring_socket or args.ring_exposed_gate:
+        # The gate needs the ring series; asking for it implies it.
+        modes = modes + ("ring_socket",)
+    if args.producer:
+        modes = modes + ("producer",)
+    if args.tuned:
+        modes = modes + ("tuned",)
     # Fresh controller state per bench run: a prior run's learned grid
     # must not flatter (or sandbag) this one's tuned series.
     dcn_tune.reset()
@@ -710,6 +788,34 @@ def main(argv=None):
                   f"is not below serial's ({exp_serial}) at "
                   f"{largest} bytes", file=sys.stderr)
             rc = 1
+    if args.ring_exposed_gate:
+        # The universal-ring gate: moving the completion wait behind
+        # staging is the ring's whole point — the ring_socket lane's
+        # exposed-comm ratio must DROP below the legacy per-chunk
+        # pipeline's at the largest size, and the producer-fed series
+        # must stay below the stage-then-send baseline too.
+        exp_ring = exposed.get(("ring_socket", largest))
+        print(f"ring lanes @ {largest}: ring_socket exposed "
+              f"{exp_ring} vs legacy pipelined {exp_pipe}",
+              file=sys.stderr)
+        if exp_ring is None or exp_pipe is None \
+                or exp_ring >= exp_pipe + args.ring_exposed_slack:
+            print(f"FAIL: ring_socket exposed-comm ratio ({exp_ring}) "
+                  f"did not drop below the legacy pipeline's "
+                  f"({exp_pipe}) at {largest} bytes", file=sys.stderr)
+            rc = 1
+        if args.producer:
+            exp_prod = exposed.get(("producer", largest))
+            print(f"ring lanes @ {largest}: producer exposed "
+                  f"{exp_prod} vs legacy pipelined {exp_pipe}",
+                  file=sys.stderr)
+            if exp_prod is None or exp_pipe is None \
+                    or exp_prod >= exp_pipe + args.ring_exposed_slack:
+                print(f"FAIL: producer-fed exposed-comm ratio "
+                      f"({exp_prod}) did not stay below the "
+                      f"stage-then-send baseline ({exp_pipe}) at "
+                      f"{largest} bytes", file=sys.stderr)
+                rc = 1
     if args.compare and args.shm_exposed_gate:
         # The handoff gate: the descriptor-ring shm lane posts its
         # doorbell BEFORE staging, so its completion window rides

@@ -354,6 +354,15 @@ class ProcNode:
                              action=action, param=float(param),
                              host=host).get("applied", 0))
 
+    def ring_delay(self, seconds: float) -> float:
+        """Arm the worker daemon's slow-ring-completer grey fault:
+        every posted descriptor costs ``seconds`` before the completer
+        drives it — slow, not dead (the cursor keeps crawling).  0
+        disarms."""
+        return float(self._rpc("ring_delay",
+                               seconds=float(seconds)).get(
+                                   "delay_s", 0.0))
+
     def resources(self) -> Dict[str, int]:
         """The worker's resource census (fds / threads / shm segments
         / rss) for the soak leak sentinel.  Raises OSError on a dark
@@ -622,6 +631,9 @@ def _serve(node, out) -> None:
                     req.get("host", "127.0.0.1"), int(req["port"]),
                     req.get("action", ""),
                     float(req.get("param", 0.0)))
+            elif op == "ring_delay":
+                resp["delay_s"] = node.daemon.set_ring_delay(
+                    float(req.get("seconds", 0.0)))
             elif op == "resources":
                 resp["resources"] = _resource_snapshot(
                     getattr(node.daemon, "shm_dir", None))

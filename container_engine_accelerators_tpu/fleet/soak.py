@@ -98,11 +98,19 @@ log = logging.getLogger(__name__)
 # itself.
 GREY_LATENCY_S = 0.05
 
+# Slow-ring-completer grey fault, per posted descriptor: the ring
+# completer sleeps this long before driving each descriptor — a round
+# crawls (the cursor keeps advancing) without ever tripping the 5 s
+# stage-wait budget, which is exactly the slow-not-dead shape the
+# sentinels must catch without a transfer wedging.
+RING_DELAY_S = 0.08
+
 # The deterministic coverage prologue: window 1 SIGKILL (+respawn),
-# window 2 grey (+ungrey), window 3 link degrade (+heal) — every soak
-# run exercises all three fault families and their heals even at the
-# shortest CI duration; later windows draw from the seeded RNG.
-LAST_DETERMINISTIC_WINDOW = 3
+# window 2 grey (+ungrey), window 3 link degrade (+heal), window 4
+# slow ring completer (+unslow) — every soak run exercises all four
+# fault families and their heals even at the shortest CI duration;
+# later windows draw from the seeded RNG.
+LAST_DETERMINISTIC_WINDOW = 4
 
 # Tuner decisions that count as REACTIVE moves for the convergence
 # sentinel: the loss-response axis (and its recovery).  Exploration
@@ -184,6 +192,8 @@ class SoakSchedule:
             a, b = rng.sample(self.names, 2)
             return [{"link": f"node:{a}<->node:{b}:latency:20",
                      "for": 1}]
+        if window == 4:
+            return [{"slow_ring": rng.choice(self.names), "for": 1}]
         draws: List[dict] = []
         r = rng.random()
         if r < 0.15:
@@ -196,6 +206,12 @@ class SoakSchedule:
             action = rng.choice(["latency:20", "drop:2"])
             draws.append({"link": f"node:{a}<->node:{b}:{action}",
                           "for": rng.randint(1, 2)})
+        elif r < 0.60:
+            # The ring lane's grey fault: a slow completer on one
+            # node's universal ring — every descriptor costs a sleep,
+            # no descriptor is lost.
+            draws.append({"slow_ring": rng.choice(self.names),
+                          "for": 1})
         return draws
 
 
@@ -470,6 +486,8 @@ class SoakWorld(FleetController):
         self.max_tail_moves = int(merged.get("max_tail_moves", 1))
         self.grey_latency_s = float(
             merged.get("grey_latency_s", GREY_LATENCY_S))
+        self.ring_delay_s = float(
+            merged.get("ring_delay_s", RING_DELAY_S))
         self.schedule = SoakSchedule(
             self.seed, [s.name for s in self.topology.specs.values()])
         self.mono = MonotonicitySentinel()
@@ -533,7 +551,39 @@ class SoakWorld(FleetController):
     def _apply_fault(self, rnd: int, entry: dict) -> dict:
         if "grey" in entry or "ungrey" in entry:
             return self._apply_grey(rnd, entry)
+        if "slow_ring" in entry or "unslow_ring" in entry:
+            return self._apply_slow_ring(rnd, entry)
         return super()._apply_fault(rnd, entry)
+
+    def _apply_slow_ring(self, rnd: int, entry: dict) -> dict:
+        """Arm (or heal) the ring lane's grey fault: the node's ring
+        completer sleeps per posted descriptor — rounds crawl with a
+        visibly advancing cursor, no descriptor is dropped, no
+        stage-wait budget trips.  The sentinels (latency histograms,
+        exposed-comm ratio, SLO round deadlines) must catch the
+        degradation without any transfer wedging."""
+        healing = "unslow_ring" in entry
+        name = entry["unslow_ring"] if healing else entry["slow_ring"]
+        record = dict(entry)
+        record["round"] = rnd
+        record["applied"] = 0
+        node = self.nodes.get(name)
+        if node is None:
+            log.error("slow_ring fault names unknown node: %r", entry)
+            record["skipped"] = f"unknown node {name!r}"
+            return record
+        try:
+            node.ring_delay(0.0 if healing else self.ring_delay_s)
+            record["applied"] = 1
+        except (OSError, AttributeError) as e:
+            record["skipped"] = f"ring_delay {name}: {e}"
+        if not healing and record["applied"]:
+            counters.inc("soak.fault.slow_ring")
+            lifetime = int(entry.get("for", 0))
+            if lifetime > 0:
+                self._deferred.setdefault(rnd + lifetime, []).append(
+                    {"unslow_ring": name})
+        return record
 
     def _apply_grey(self, rnd: int, entry: dict) -> dict:
         """Arm (or heal) a grey failure: shim latency on every link
@@ -586,7 +636,7 @@ class SoakWorld(FleetController):
     def _is_heal(record: dict) -> bool:
         if record.get("skipped") and not record.get("applied"):
             return False
-        if "ungrey" in record:
+        if "ungrey" in record or "unslow_ring" in record:
             return True
         if record.get("action") == "restart":
             return True

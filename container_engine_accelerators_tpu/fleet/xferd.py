@@ -556,7 +556,8 @@ class PyXferd:
                  shm: Optional[bool] = None,
                  host_id: Optional[str] = None,
                  shm_direct: Optional[bool] = None,
-                 forward: Optional[bool] = None):
+                 forward: Optional[bool] = None,
+                 ring: Optional[bool] = None):
         self.uds_dir = uds_dir
         self.node = node
         self.net = net
@@ -587,6 +588,19 @@ class PyXferd:
         # downgrade signal.
         self.forward_enabled = (True if forward is None
                                 else bool(forward))
+        # Universal submission ring: willingness to serve descriptor
+        # rings on ANY lane (ring_attach + shm_post doorbells whose
+        # descriptors the completer drives through the normal
+        # lane-selection send path).  Independent of shm_enabled —
+        # a socket-only daemon still mmaps the ring file (descriptors
+        # and cursors, not payload) so the client's hot path stays
+        # lock-free.  ``ring=False`` is the capability-less handle.
+        self.ring_enabled = (dcn_shm.shm_ring_enabled() if ring is None
+                             else bool(ring))
+        # Grey-fault hook (soak "slow_ring"): per-descriptor delay the
+        # completer sleeps before driving each posted descriptor — a
+        # completer that is slow, not dead.
+        self._ring_delay_s = 0.0
         self.data_port = 0
         self.generation = 0
         self._flows: Dict[str, _Flow] = {}
@@ -645,7 +659,7 @@ class PyXferd:
         # Crash-lingering segment files belong to the dead incarnation;
         # wipe them the same way the socket path is unlinked.
         shutil.rmtree(self.shm_dir, ignore_errors=True)
-        if self.shm_enabled:
+        if self.shm_enabled or self.ring_enabled:
             os.makedirs(self.shm_dir, exist_ok=True)
         self._stopping.clear()
         self._crashing = False
@@ -822,6 +836,17 @@ class PyXferd:
                     action, host, port, self.node or "?")
         return 1
 
+    def set_ring_delay(self, seconds: float) -> float:
+        """Grey-fault handle (soak "slow_ring"): make the ring
+        completer sleep this long before driving EACH posted
+        descriptor — a completer that is slow, not dead.  Partial
+        progress keeps publishing into the cursor, so clients see a
+        crawling round rather than a wedged one.  0 disarms."""
+        self._ring_delay_s = min(max(float(seconds), 0.0), 2.0)
+        log.warning("ring completer delay %.3fs armed on node %s",
+                    self._ring_delay_s, self.node or "?")
+        return self._ring_delay_s
+
     def _shim_consult(self, host: str, port: int):
         """One frame's verdict from the shim: (action, delay_s) where
         action is None / "blocked" / "dropped".  The latency sleep
@@ -907,6 +932,12 @@ class PyXferd:
                 # advertised segment paths actually map.
                 resp.update(shm=1, shm_dir=self.shm_dir,
                             host_id=self.host_id)
+            if self.ring_enabled:
+                # Universal-ring capability: advertised independently
+                # of shm (a socket-lane daemon still serves descriptor
+                # rings).  host_id rides along because ring files are
+                # mmapped — same-MACHINE is the gate, as for shm.
+                resp.update(ring=1, host_id=self.host_id)
             return resp
         if op == "ping":
             return {"ok": True}
@@ -979,6 +1010,8 @@ class PyXferd:
             return self._shm_read(req)
         if op == "shm_post":
             return self._shm_post(req)
+        if op == "ring_attach":
+            return self._ring_attach(req)
         if op == "forward" and self.forward_enabled:
             # Gated on the capability flag so a forward-less daemon
             # answers "unknown op" — byte-identical to a daemon that
@@ -1653,9 +1686,9 @@ class PyXferd:
                 return {"ok": False, "error": f"shm attach failed: {e}"}
             resp = {"ok": True, "path": f.seg_path,
                     "bytes": f.seg_size, "frame_bytes": f.frame_bytes}
-            if req.get("ring"):
+            if req.get("ring") and self.ring_enabled:
                 try:
-                    self._ensure_ring_locked(f)
+                    self._ensure_ring_locked(flow, f)
                 except OSError as e:
                     # The segment is fine — only the handoff is not.
                     # The client runs per-chunk control ops instead.
@@ -1666,12 +1699,38 @@ class PyXferd:
                                 ring_slots=RING_SLOTS)
             return resp
 
-    def _ensure_ring_locked(self, f: _Flow) -> None:
-        """Create and map the flow's descriptor-ring file (next to
-        the segment; RING_SLOTS slots).  Caller holds the lock."""
+    def _ring_attach(self, req: dict) -> dict:
+        """Universal-ring attach: the descriptor ring WITHOUT a data
+        segment — the socket lane's entry point, where payload bytes
+        still ride the data plane but submission and completion ride
+        the mmapped ring.  Daemons that predate the op answer
+        "unknown op", the client's classic-path downgrade signal."""
+        if not self.ring_enabled:
+            return {"ok": False, "error": "ring disabled"}
+        flow = req["flow"]
+        with self._lock:
+            f = self._flows.get(flow)
+            if f is None:
+                return {"ok": False, "error": "unknown flow"}
+            try:
+                self._ensure_ring_locked(flow, f)
+            except OSError as e:
+                return {"ok": False, "error": f"ring attach failed: {e}"}
+            return {"ok": True, "ring_path": f.ring_path,
+                    "ring_slots": RING_SLOTS}
+
+    def _ensure_ring_locked(self, flow: str, f: _Flow) -> None:
+        """Create and map the flow's descriptor-ring file under
+        shm_dir (RING_SLOTS slots).  The path is derived from the
+        flow name, NOT the segment path — the universal ring exists
+        on lanes that never attach a segment.  Caller holds the
+        lock."""
         if f.ring_map is not None:
             return
-        path = f.seg_path + ".ring"
+        os.makedirs(self.shm_dir, exist_ok=True)
+        path = os.path.join(
+            self.shm_dir,
+            hashlib.sha1(flow.encode()).hexdigest()[:16] + ".ring")
         size = dcn_shm.ring_bytes(RING_SLOTS)
         fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
         try:
@@ -1690,31 +1749,59 @@ class PyXferd:
         invalidation) is the same ``land_frame`` every other staging
         path uses.  Commits are seq-less staging, dedup-exempt and
         idempotent by construction — a restage after a failed round
-        simply commits again."""
+        simply commits again.
+
+        Range mode (``offset`` + ``total``): declare just
+        ``[offset, offset+bytes)`` staged — the producer-fed overlap
+        path commits each chunk as it is produced, and the chunk
+        lands through the same in-place assembly bookkeeping the
+        daemon↔daemon DXC1 lane uses."""
         if not self.shm_enabled:
             return {"ok": False, "error": "shm lane disabled"}
         flow = req["flow"]
         nbytes = int(req.get("bytes") or 0)
         xid = req.get("xid") or ""
+        offset = req.get("offset")
         if nbytes <= 0:
             return {"ok": False, "error": "shm commit needs bytes > 0"}
+        if offset is not None:
+            offset = int(offset)
+            total = int(req.get("total") or 0)
+            if offset < 0 or total <= 0 or offset + nbytes > total:
+                return {"ok": False,
+                        "error": f"commit range out of bounds: "
+                                 f"[{offset}:{offset + nbytes}) "
+                                 f"of {total}"}
+            need = total
+        else:
+            need = nbytes
         with self._lock:
             f = self._flows.get(flow)
             if f is None:
                 return {"ok": False, "error": "unknown flow"}
-            if f.seg_map is None or f.seg_size < nbytes:
+            if f.seg_map is None or f.seg_size < need:
                 return {"ok": False,
                         "error": "no shm segment attached for "
-                                 f"{nbytes} bytes; shm_attach first"}
-            view = f.seg_view(nbytes)
-        verdict = self.land_frame(flow, view, None,
-                                  {"xid": xid} if xid else {},
-                                  in_place=True)
-        if verdict != "landed":
+                                 f"{need} bytes; shm_attach first"}
+            view = f.seg_view(need)
+        if offset is not None:
+            meta = {"off": offset, "tot": need}
+            if xid:
+                meta["xid"] = xid
+            verdict = self.land_frame(
+                flow, view[offset:offset + nbytes], None, meta,
+                in_place=True)
+            ok = verdict in ("landed", "dup")
+        else:
+            verdict = self.land_frame(flow, view, None,
+                                      {"xid": xid} if xid else {},
+                                      in_place=True)
+            ok = verdict == "landed"
+        if not ok:
             return {"ok": False,
                     "error": f"shm commit not landed: {verdict}"}
         counters.inc("dcn.shm.commits")
-        return {"ok": True, "bytes": nbytes}
+        return {"ok": True, "bytes": nbytes, "verdict": verdict}
 
     def _shm_read(self, req: dict) -> dict:
         """Make the flow's completed frame readable through its
@@ -1748,9 +1835,11 @@ class PyXferd:
         out of the daemon's own ring mapping, hands them to the
         completer thread, and returns immediately — completion is
         published INTO the ring (per-slot verdict codes + a cursor)
-        for the client to poll out of shared memory."""
-        if not self.shm_enabled:
-            return {"ok": False, "error": "shm lane disabled"}
+        for the client to poll out of shared memory.  Gated on the
+        UNIVERSAL ring capability, not shm: socket-lane rounds post
+        through the same doorbell."""
+        if not self.ring_enabled:
+            return {"ok": False, "error": "ring disabled"}
         flow = req["flow"]
         count = int(req.get("count") or 0)
         rnd = int(req.get("round") or 0)
@@ -1826,12 +1915,20 @@ class PyXferd:
                              or CHUNK_STAGE_WAIT_S * 1e3) / 1e3,
                        CHUNK_STAGE_WAIT_S)
         deadline = time.monotonic() + budget_s
+        # Grey-fault hook: a SLOW completer (soak "slow_ring") pays
+        # the delay per descriptor on the serial path — partial
+        # progress stays visible in the cursor, which is exactly what
+        # the sentinels must distinguish from a dead completer.  The
+        # batch fast path is skipped while armed (a busy completer
+        # does not get the one-copy shortcut).
+        delay_s = min(max(self._ring_delay_s, 0.0), 2.0)
         # Whole-round fast path: when the peer is co-hosted, the round
         # completes as ONE segment→segment copy plus ONE batched DXC1
         # — zero per-chunk round trips end to end, which is the
         # descriptor-handoff promise kept on the daemon→daemon leg
         # too.  Any trouble falls through to the per-descriptor path.
-        verdicts = self._ring_batch_direct(post, deadline)
+        verdicts = (None if delay_s
+                    else self._ring_batch_direct(post, deadline))
         if verdicts is not None:
             done = 0
             for i, verdict in enumerate(verdicts):
@@ -1850,6 +1947,8 @@ class PyXferd:
         for i, (off, ln, seq) in enumerate(post["descs"]):
             if self._stopping.is_set():
                 return
+            if delay_s:
+                time.sleep(delay_s)
             remaining_ms = max(1, int((deadline - time.monotonic())
                                       * 1e3))
             req = {"op": "send", "flow": flow, "host": post["host"],

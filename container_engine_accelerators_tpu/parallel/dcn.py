@@ -174,12 +174,14 @@ def exchange_shard(
     *,
     local_flow: str,
     peer_flow: str,
-    data: bytes,
+    data: Optional[bytes] = None,
     peer_host: str,
     peer_port: int,
     barrier: Optional[Callable[[], object]] = None,
     timeout_s: float = 60.0,
     pipelined: Optional[bool] = None,
+    producer=None,
+    nbytes: Optional[int] = None,
 ) -> bytes:
     """One cross-pod leg of a DCN collective, staged through dcnxferd.
 
@@ -213,6 +215,15 @@ def exchange_shard(
     lost its pipeline capability mid-leg degrades instead of failing.
     Empty shards short-circuit after the barrier: registration keeps
     the rendezvous honest, but no bytes are staged, sent, or read.
+
+    Producer mode (``producer`` + ``nbytes``, ``data=None``): the
+    shard is pulled from an iterable (or zero-arg callable returning
+    one) of byte chunks AS THE PIPELINED LEG STAGES — on a ring-
+    capable daemon, production overlaps the DCN leg instead of
+    preceding it, which is what pulls ``dcn.exposed_ratio`` below the
+    stage-then-send baseline.  Every consumed chunk is captured, so
+    the serial fallback (and a ring-less daemon) still sees the full
+    payload; the producer itself is consumed at most once.
     """
     from container_engine_accelerators_tpu.metrics import counters
     from container_engine_accelerators_tpu.obs import histo, timeseries, trace
@@ -221,7 +232,36 @@ def exchange_shard(
         DcnXferError,
     )
 
-    nbytes = len(data)
+    produced = []
+    producer_iter = None
+    src = None
+    if producer is not None:
+        if data is not None:
+            raise ValueError("pass data OR producer, not both")
+        if not nbytes or int(nbytes) <= 0:
+            raise ValueError("producer mode needs nbytes > 0")
+        nbytes = int(nbytes)
+        src = iter(producer() if callable(producer) else producer)
+
+        def _capture(it=src):
+            # Tee every consumed chunk: a fallback leg (serial path,
+            # ring-less daemon) can then materialize the full shard
+            # even though the producer is one-shot.
+            for piece in it:
+                produced.append(bytes(piece))
+                yield piece
+
+        producer_iter = _capture()
+    else:
+        nbytes = len(data)
+
+    def _materialize() -> bytes:
+        whole = b"".join(produced) + b"".join(bytes(p) for p in src)
+        if len(whole) != nbytes:
+            raise DcnXferError(
+                f"producer yielded {len(whole)} bytes for "
+                f"{local_flow!r}, expected {nbytes}")
+        return whole
     try:
         # One span per leg, one child span per phase: a slow exchange
         # decomposes into register / barrier / stage / send / land /
@@ -254,7 +294,8 @@ def exchange_shard(
                 try:
                     return _exchange_pipelined(
                         client, local_flow, peer_flow, data, peer_host,
-                        peer_port, cfg, timeout_s)
+                        peer_port, cfg, timeout_s,
+                        producer=producer_iter, nbytes=nbytes)
                 except (DcnXferError, OSError) as e:
                     if pipelined:  # explicitly forced: surface it
                         raise
@@ -264,6 +305,11 @@ def exchange_shard(
                         "falling back to the serial leg",
                         local_flow, e,
                     )
+            if data is None:
+                # Producer mode on the serial path: materialize the
+                # captured prefix plus the rest of the iterator —
+                # stage-then-send, the baseline shape.
+                data = _materialize()
             with trace.span("dcn.exchange.stage",
                             histogram="dcn.exchange.stage"):
                 client.put(local_flow, data)
@@ -314,7 +360,8 @@ def exchange_shard(
 
 
 def _exchange_pipelined(client, local_flow, peer_flow, data, peer_host,
-                        peer_port, cfg, timeout_s) -> bytes:
+                        peer_port, cfg, timeout_s, producer=None,
+                        nbytes=None) -> bytes:
     """The pipelined leg body: overlapped chunked stage+send of the
     local shard, then land-wait and read-back of the peer's (zero-copy
     shm when the daemon is same-host, DXR1 otherwise).  Flows are
@@ -325,7 +372,7 @@ def _exchange_pipelined(client, local_flow, peer_flow, data, peer_host,
         DcnXferError,
     )
 
-    nbytes = len(data)
+    nbytes = len(data) if data is not None else int(nbytes)
     with trace.span("dcn.exchange.pipeline",
                     histogram="dcn.exchange.pipeline",
                     local_flow=local_flow, bytes=nbytes):
@@ -341,7 +388,8 @@ def _exchange_pipelined(client, local_flow, peer_flow, data, peer_host,
                 pass
         dcn_pipeline.send_pipelined(client, local_flow, data,
                                     peer_host, peer_port, cfg,
-                                    timeout_s=timeout_s)
+                                    timeout_s=timeout_s,
+                                    producer=producer, nbytes=nbytes)
         with trace.span("dcn.exchange.land",
                         histogram="dcn.exchange.land"):
             wait_flow_rx(client, peer_flow, nbytes, timeout_s)

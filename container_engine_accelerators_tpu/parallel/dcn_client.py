@@ -172,6 +172,14 @@ class DcnXferClient:
         that node's legs to coordinator-routed sends mid-schedule."""
         return bool(self.capabilities().get("forward", 0))
 
+    def supports_ring(self) -> bool:
+        """The daemon OFFERS the universal submission ring (descriptor
+        posting + doorbell on ANY lane).  Whether this client can take
+        it also needs the same-host identity check — that lives in
+        ``parallel.dcn_pipeline.ring_same_host`` next to the lane
+        selection."""
+        return bool(self.capabilities().get("ring", 0))
+
     # -- shm lane ops (zero-copy same-host staging; fleet/xferd.py) ----------
 
     def shm_attach(self, flow: str, nbytes: int,
@@ -207,12 +215,29 @@ class DcnXferClient:
             req["stage_wait_ms"] = int(stage_wait_ms)
         return self._call(**req)
 
-    def shm_commit(self, flow: str, nbytes: int, xid: str = "") -> dict:
+    def ring_attach(self, flow: str) -> dict:
+        """Map the flow's descriptor ring WITHOUT a data segment —
+        the universal ring's socket-lane entry point.  Returns
+        ``{ring_path, ring_slots}``; a daemon that predates the op
+        (or has the ring disabled) errors, the caller's signal to
+        run the classic per-chunk path."""
+        return self._call(op="ring_attach", flow=flow)
+
+    def shm_commit(self, flow: str, nbytes: int, xid: str = "",
+                   offset: Optional[int] = None,
+                   total: Optional[int] = None) -> dict:
         """Declare ``[0, nbytes)`` of the attached segment a completed
         staged frame (in-place landing; dedup-exempt like any other
-        staging, idempotent by construction)."""
-        return self._call(op="shm_commit", flow=flow, bytes=int(nbytes),
-                          xid=xid)
+        staging, idempotent by construction).  With ``offset`` +
+        ``total``, declare just ``[offset, offset+nbytes)`` of a
+        ``total``-byte frame staged — the producer-fed overlap path's
+        per-chunk commit."""
+        req = {"op": "shm_commit", "flow": flow, "bytes": int(nbytes),
+               "xid": xid}
+        if offset is not None:
+            req["offset"] = int(offset)
+            req["total"] = int(total or 0)
+        return self._call(**req)
 
     def shm_read(self, flow: str, nbytes: int) -> dict:
         """Make the flow's completed frame visible in its segment and

@@ -215,6 +215,20 @@ def shm_same_host(client) -> bool:
             and caps.get("host_id") == dcn_shm.host_identity())
 
 
+def ring_same_host(client) -> bool:
+    """The daemon offers the UNIVERSAL submission ring AND lives on
+    this machine — the socket lane's descriptor-handoff gate.  The
+    ring file is mmapped (descriptors and cursors, not payload), so
+    the same host-identity rule as the shm lane applies: never the
+    socket address."""
+    try:
+        caps = client.capabilities()
+    except (DcnXferError, OSError, AttributeError):
+        return False
+    return (bool(caps.get("ring"))
+            and caps.get("host_id") == dcn_shm.host_identity())
+
+
 def _chunk_frame_header(flow: str, payload_len: int,
                         meta: dict) -> bytes:
     """v2 frame header for a seq-0 staging chunk (the payload follows
@@ -453,11 +467,29 @@ _RING_SPIN_FAST = 50
 _RING_SPIN_SLOW = 400
 
 
+def _score_ring_slots(batch, chunks, statuses, scored,
+                      result: _StripeResult) -> None:
+    """Score the first ``scored`` slots of one posted batch.  The
+    ring's publication order (slot status BEFORE cursor advance)
+    makes every slot below the cursor valid even when the round
+    timed out mid-completion — partial credit, so a SIGKILLed
+    completer costs only the genuinely unconfirmed chunks."""
+    for slot in range(scored):
+        idx = batch[slot]
+        verdict = dcn_shm.RING_VERDICTS.get(statuses[slot], "error")
+        if verdict in ("sent", "landed", "dup"):
+            # Same confirmed-chunk accounting as _send_chunk — the
+            # two handoff shapes must never diverge in the books.
+            counters.inc("dcn.pipeline.chunks")
+            timeseries.record("dcn.pipeline.tx.bytes", chunks[idx][1])
+        result.record(idx, verdict)
+
+
 def _ring_round(ctl, ring, flow: str, data, chunks, seqs, idxs,
                 xid: str, host: str, port: int, timeout_s: float,
                 result: _StripeResult, attach_resp: dict,
-                staged_already: bool, direct_pin: Optional[int]
-                ) -> Optional[bool]:
+                staged_already: bool, direct_pin: Optional[int],
+                stage=None) -> Optional[bool]:
     """One descriptor-ring round: post (off, len, seq) descriptors
     into the flow's ring, fire ONE ``shm_post`` doorbell, stage the
     payload while the daemon's completer parks on the descriptors'
@@ -470,86 +502,115 @@ def _ring_round(ctl, ring, flow: str, data, chunks, seqs, idxs,
     control time behind the memcpy — the GPU-initiated-networking
     shape (post work once, let the data plane complete it).
 
+    ``stage`` overrides the whole-payload memcpy+commit with a
+    caller-supplied per-batch callback ``stage(ctl, attach_resp,
+    batch_idxs)`` — the producer-fed overlap path stages each chunk
+    as it is produced, AFTER the doorbell, so production itself
+    hides the DCN leg.
+
+    Rounds larger than the ring post in ring-sized batches: the
+    poster BLOCKS until the previous batch's cursor caught up
+    (``dcn.ring.backpressure`` per extra doorbell) — descriptors are
+    never dropped.
+
     Returns True (round ran; scoreboard holds the verdicts — possibly
     with chunks left pending for the next round), False (the shm
     staging itself broke: caller downgrades to the socket lane), or
     None (the ring handoff is unusable while shm staging may still
     be fine: caller falls back to per-chunk control ops)."""
-    n = len(idxs)
     nbytes = len(data)
     try:
-        rnd = ring.post([(chunks[i][0], chunks[i][1], seqs[i])
-                         for i in idxs])
-    except (OSError, ValueError, struct.error):
+        slots = ring.slots
+    except (OSError, struct.error):
         return None
-    t0 = time.monotonic()
-    timeseries.gauge_add("dcn.chunks.inflight", n)
-    try:
-        # ONE span from doorbell to completion: this is the ring
-        # lane's whole DCN leg as the client sees it, so injected
-        # link latency (and any daemon-side stall) attributes HERE in
-        # a critical-path walk — the `dcn.chunk.send` analog.  The
-        # staging memcpy nests under it as a child, which is exactly
-        # the overlap story the exposed-comm accounting tells.
-        with trace.span("dcn.shm.post", histogram="dcn.shm.post",
-                        flow=flow, chunks=n, xid=xid):
-            try:
-                ctl.shm_post(flow, n, rnd, xid, nbytes, host, port,
-                             direct=direct_pin,
-                             stage_wait_ms=int(min(timeout_s, 5.0)
-                                               * 1e3))
-            except (DcnXferError, OSError) as e:
-                result.fail(e)
-                return None
-            if not staged_already:
+    deadline = time.monotonic() + timeout_s
+    staged = staged_already
+    for bstart in range(0, len(idxs), slots):
+        batch = idxs[bstart:bstart + slots]
+        n = len(batch)
+        if bstart:
+            # Only reachable after the previous batch's completion
+            # poll drained — the blocked-poster half of the
+            # backpressure contract.
+            counters.inc("dcn.ring.backpressure")
+        try:
+            rnd = ring.post([(chunks[i][0], chunks[i][1], seqs[i])
+                             for i in batch])
+        except (OSError, ValueError, struct.error):
+            return None if not bstart else True
+        t0 = time.monotonic()
+        timeseries.gauge_add("dcn.chunks.inflight", n)
+        timed_out = False
+        scored = 0
+        try:
+            # ONE span from doorbell to completion: this is the ring
+            # lane's whole DCN leg as the client sees it, so injected
+            # link latency (and any daemon-side stall) attributes HERE
+            # in a critical-path walk — the `dcn.chunk.send` analog.
+            # The staging memcpy nests under it as a child, which is
+            # exactly the overlap story the exposed-comm accounting
+            # tells.
+            with trace.span("dcn.shm.post", histogram="dcn.shm.post",
+                            flow=flow, chunks=n, xid=xid):
                 try:
-                    _shm_stage(ctl, flow, data, chunks, attach_resp,
-                               xid, result)
+                    ctl.shm_post(flow, n, rnd, xid, nbytes, host,
+                                 port, direct=direct_pin,
+                                 stage_wait_ms=int(min(timeout_s, 5.0)
+                                                   * 1e3))
                 except (DcnXferError, OSError) as e:
-                    # The posted descriptors' stage-waits expire on
-                    # the daemon side; nothing lands under their seqs.
                     result.fail(e)
-                    return False
-            deadline = time.monotonic() + timeout_s
-            spins = 0
-            while True:
+                    return None if not bstart else True
+                if not staged or stage is not None:
+                    try:
+                        if stage is not None:
+                            stage(ctl, attach_resp, batch)
+                        else:
+                            _shm_stage(ctl, flow, data, chunks,
+                                       attach_resp, xid, result)
+                            staged = True
+                    except (DcnXferError, OSError) as e:
+                        # The posted descriptors' stage-waits expire
+                        # on the daemon side; nothing lands under
+                        # their seqs.
+                        result.fail(e)
+                        return False
+                spins = 0
+                while True:
+                    try:
+                        crnd, done = ring.completion()
+                    except (ValueError, struct.error):
+                        return None if not bstart else True
+                    cur = done if crnd == rnd else 0
+                    if cur >= n:
+                        scored = n
+                        break
+                    if time.monotonic() >= deadline:
+                        timed_out = True
+                        scored = cur
+                        break
+                    spins += 1
+                    if spins > _RING_SPIN_SLOW:
+                        time.sleep(0.0005)
+                    elif spins > _RING_SPIN_FAST:
+                        time.sleep(0.00005)
+                    else:
+                        time.sleep(0)  # GIL yield: daemon may BE us
                 try:
-                    crnd, done = ring.completion()
+                    statuses = ring.statuses(n)
                 except (ValueError, struct.error):
-                    return None
-                if crnd == rnd and done >= n:
-                    break
-                if time.monotonic() >= deadline:
-                    # Unfinished handoff: unrecorded chunks stay
-                    # pending; the next retry round re-sends them
-                    # under the SAME seqs (the completer's late sends
-                    # dedup away).
-                    result.fail(DcnXferError(
-                        f"ring round for {flow!r} timed out at "
-                        f"{done}/{n}"))
-                    return True
-                spins += 1
-                if spins > _RING_SPIN_SLOW:
-                    time.sleep(0.0005)
-                elif spins > _RING_SPIN_FAST:
-                    time.sleep(0.00005)
-                else:
-                    time.sleep(0)  # GIL yield: the daemon may BE us
-            try:
-                statuses = ring.statuses(n)
-            except (ValueError, struct.error):
-                return None
-    finally:
-        timeseries.gauge_add("dcn.chunks.inflight", -n)
-        result.phase("comm", t0, time.monotonic())
-    for slot, idx in enumerate(idxs):
-        verdict = dcn_shm.RING_VERDICTS.get(statuses[slot], "error")
-        if verdict in ("sent", "landed", "dup"):
-            # Same confirmed-chunk accounting as _send_chunk — the
-            # two handoff shapes must never diverge in the books.
-            counters.inc("dcn.pipeline.chunks")
-            timeseries.record("dcn.pipeline.tx.bytes", chunks[idx][1])
-        result.record(idx, verdict)
+                    return None if not bstart else True
+        finally:
+            timeseries.gauge_add("dcn.chunks.inflight", -n)
+            result.phase("comm", t0, time.monotonic())
+        _score_ring_slots(batch, chunks, statuses, scored, result)
+        if timed_out:
+            # Unfinished handoff: unscored chunks stay pending; the
+            # next retry round re-sends them under the SAME seqs
+            # (the completer's late sends dedup away).
+            result.fail(DcnXferError(
+                f"ring round for {flow!r} timed out at "
+                f"{scored}/{n}"))
+            return True
     return True
 
 
@@ -558,7 +619,8 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                result: _StripeResult, ctx: Optional[dict],
                already_staged: bool = False,
                direct_pin: Optional[int] = None,
-               use_ring: bool = True) -> bool:
+               use_ring: bool = True,
+               stage=None, prepare=None) -> bool:
     """One zero-copy-lane round: descriptor-ring handoff when the
     daemon offers it (one doorbell per round, completion polled out
     of shared memory), per-chunk offset-sends on a dedicated
@@ -572,6 +634,12 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
     have reset that to 0 through flow replay), the memcpy and the
     re-commit are skipped and the round pays only for the chunks it
     re-sends.
+
+    ``stage``/``prepare`` are the producer-overlap hooks: ``stage``
+    replaces the whole-payload memcpy inside a ring round with a
+    per-batch producer-fed callback; ``prepare`` (materialize the
+    producer fully) runs before any NON-ring staging, whose
+    whole-payload memcpy needs every byte present.
 
     Returns False when the shm machinery itself is unusable (attach
     rejected, segment unmappable, daemon gone) — the caller's signal
@@ -603,15 +671,14 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                         dcn_shm.ring_bytes(
                             int(resp.get("ring_slots") or 0)))
                     ring = dcn_shm.RingView(ring_seg.view)
-                    if ring.slots < len(idxs):
-                        ring = None
                 except OSError:
                     ring = None
             if ring is not None:
                 ran = _ring_round(ctl, ring, flow, data, chunks,
                                   seqs, idxs, xid, host, port,
                                   timeout_s, result, resp,
-                                  staged_already, direct_pin)
+                                  staged_already, direct_pin,
+                                  stage=stage)
                 if ran is not None:
                     return ran
                 counters.inc("dcn.shm.ring.fallback")
@@ -619,6 +686,8 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
             # stage first, then serial offset-sends.
             if not staged_already:
                 try:
+                    if prepare is not None:
+                        prepare()
                     _shm_stage(ctl, flow, data, chunks, resp, xid,
                                result)
                 except (DcnXferError, OSError) as e:
@@ -636,6 +705,183 @@ def _shm_round(uds_dir: str, flow: str, data, chunks, seqs, idxs,
                     return True
             return True
     finally:
+        if ring_seg is not None:
+            ring_seg.close()
+        if ctl is not None:
+            try:
+                ctl.close()
+            except OSError:
+                pass
+
+
+def _ring_socket_round(uds_dir: str, data_port: int, flow: str, data,
+                       chunks, seqs, idxs, xid: str, host: str,
+                       port: int, timeout_s: float,
+                       result: _StripeResult, ctx: Optional[dict],
+                       direct_pin: Optional[int],
+                       fill_to=None) -> Optional[bool]:
+    """The socket lane's descriptor-ring round: ``ring_attach`` maps
+    the flow's ring WITHOUT a data segment, descriptors post + ONE
+    ``shm_post`` doorbell, then the batch's chunk frames stream to
+    the LOCAL daemon over one data socket while its completer drives
+    the descriptors through the normal send machinery — the client
+    never issues a per-chunk control op, and completion is polled
+    lock-free out of the mmapped cursor.  Payload bytes still ride
+    TCP; only submission/completion moved into shared memory, which
+    is where the socket lane's exposed-comm time lived.
+
+    ``fill_to`` is the producer hook: when set, each chunk is pulled
+    from the producer right before its staging frame — production
+    happens INSIDE the completion window, the overlap the T3 shape
+    wants.
+
+    Rounds larger than the ring post in ring-sized batches under
+    backpressure, like :func:`_ring_round`.  Returns None when the
+    ring handoff is unusable (no capability, attach refused, doorbell
+    lost before any batch completed) — the caller falls back to the
+    classic threaded round (``dcn.ring.fallback``) and re-sends the
+    SAME seqs, which the receiver's dedup window referees.  True
+    means the round ran; unconfirmed chunks stay pending."""
+    nbytes = len(data)
+    ctl = None
+    ring_seg = None
+    dsock = None
+    try:
+        with trace.attach(ctx.get("trace") if ctx else None,
+                          ctx.get("span") if ctx else None):
+            try:
+                ctl = DcnXferClient(uds_dir,
+                                    timeout_s=max(timeout_s, 10.0))
+                resp = ctl.ring_attach(flow)
+            except (DcnXferError, OSError) as e:
+                result.fail(e)
+                return None
+            if not resp.get("ring_path"):
+                return None
+            try:
+                ring_seg = dcn_shm.map_segment(
+                    resp["ring_path"],
+                    dcn_shm.ring_bytes(
+                        int(resp.get("ring_slots") or 0)))
+                ring = dcn_shm.RingView(ring_seg.view)
+                slots = ring.slots
+            except (OSError, ValueError, struct.error):
+                return None
+            try:
+                dsock = socket.create_connection(
+                    ("127.0.0.1", data_port), timeout=timeout_s)
+                _set_nodelay(dsock)
+            except OSError as e:
+                result.fail(e)
+                return None
+            src = memoryview(data)
+            deadline = time.monotonic() + timeout_s
+            for bstart in range(0, len(idxs), slots):
+                batch = idxs[bstart:bstart + slots]
+                n = len(batch)
+                if bstart:
+                    # Blocked-poster backpressure: reached only after
+                    # the previous batch's cursor drained.
+                    counters.inc("dcn.ring.backpressure")
+                try:
+                    rnd = ring.post(
+                        [(chunks[i][0], chunks[i][1], seqs[i])
+                         for i in batch])
+                except (OSError, ValueError, struct.error):
+                    return None if not bstart else True
+                t0 = time.monotonic()
+                timeseries.gauge_add("dcn.chunks.inflight", n)
+                timed_out = False
+                scored = 0
+                try:
+                    with trace.span("dcn.ring.post",
+                                    histogram="dcn.ring.post",
+                                    flow=flow, chunks=n, xid=xid):
+                        try:
+                            ctl.shm_post(
+                                flow, n, rnd, xid, nbytes, host, port,
+                                direct=direct_pin,
+                                stage_wait_ms=int(min(timeout_s, 5.0)
+                                                  * 1e3))
+                        except (DcnXferError, OSError) as e:
+                            result.fail(e)
+                            return None if not bstart else True
+                        # Stage the batch AFTER the doorbell: frames
+                        # stream while the completer parks on their
+                        # stage-waits, so staging (and production)
+                        # time hides inside the completion window.
+                        try:
+                            for i in batch:
+                                off, ln = chunks[i]
+                                ts0 = time.monotonic()
+                                try:
+                                    with trace.span(
+                                            "dcn.chunk.stage",
+                                            histogram="dcn.chunk.stage",
+                                            flow=flow, off=off,
+                                            bytes=ln):
+                                        if fill_to is not None:
+                                            fill_to(off + ln)
+                                        netio.sendall_parts(dsock, (
+                                            _chunk_frame_header(
+                                                flow, ln, {
+                                                    "off": off,
+                                                    "tot": nbytes,
+                                                    "xid": xid,
+                                                }),
+                                            src[off:off + ln],
+                                        ))
+                                finally:
+                                    result.phase(
+                                        "stage", ts0,
+                                        time.monotonic())
+                        except (DcnXferError, OSError) as e:
+                            # Staging died mid-batch: the unstaged
+                            # descriptors' stage-waits expire daemon-
+                            # side; poll out whatever completed.
+                            result.fail(e)
+                        spins = 0
+                        while True:
+                            try:
+                                crnd, done = ring.completion()
+                            except (ValueError, struct.error):
+                                return None if not bstart else True
+                            cur = done if crnd == rnd else 0
+                            if cur >= n:
+                                scored = n
+                                break
+                            if time.monotonic() >= deadline:
+                                timed_out = True
+                                scored = cur
+                                break
+                            spins += 1
+                            if spins > _RING_SPIN_SLOW:
+                                time.sleep(0.0005)
+                            elif spins > _RING_SPIN_FAST:
+                                time.sleep(0.00005)
+                            else:
+                                time.sleep(0)  # GIL yield
+                        try:
+                            statuses = ring.statuses(n)
+                        except (ValueError, struct.error):
+                            return None if not bstart else True
+                finally:
+                    timeseries.gauge_add("dcn.chunks.inflight", -n)
+                    result.phase("comm", t0, time.monotonic())
+                _score_ring_slots(batch, chunks, statuses, scored,
+                                  result)
+                if timed_out:
+                    result.fail(DcnXferError(
+                        f"ring round for {flow!r} timed out at "
+                        f"{scored}/{n}"))
+                    return True
+            return True
+    finally:
+        if dsock is not None:
+            try:
+                dsock.close()
+            except OSError:
+                pass
         if ring_seg is not None:
             ring_seg.close()
         if ctl is not None:
@@ -667,9 +913,83 @@ def _observe_exposed(span, comm_iv, stage_iv) -> Optional[float]:
     return ratio
 
 
-def send_pipelined(client, flow: str, data: bytes, host: str,
+def _producer_buffer(producer, nbytes: int):
+    """Materialize-on-demand buffer over a producer: a bytearray the
+    transfer sends from, plus ``fill_to(end)`` pulling the iterator
+    until ``[0, end)`` is filled.  The buffer doubles as the
+    retransmit source — retry rounds re-send the SAME bytes under the
+    SAME seqs out of it, so the exactly-once contract survives a
+    producer that can only be consumed once."""
+    it = iter(producer() if callable(producer) else producer)
+    buf = bytearray(nbytes)
+    state = {"filled": 0}
+
+    def fill_to(end: int) -> None:
+        end = min(int(end), nbytes)
+        while state["filled"] < end:
+            try:
+                piece = next(it)
+            except StopIteration:
+                raise DcnXferError(
+                    f"producer ended early at {state['filled']}/"
+                    f"{nbytes} bytes") from None
+            take = len(piece)
+            if state["filled"] + take > nbytes:
+                raise DcnXferError(
+                    f"producer overran {nbytes} bytes")
+            buf[state["filled"]:state["filled"] + take] = piece
+            state["filled"] += take
+
+    return buf, fill_to
+
+
+def _producer_shm_stage(fill_to, flow: str, data, chunks, xid: str,
+                        nbytes: int, result: _StripeResult):
+    """Per-batch shm staging for the producer-fed ring round: pull
+    each chunk from the producer, memcpy it into the segment, and
+    declare just that range staged with a range ``shm_commit`` — the
+    completer's stage-wait for that descriptor unblocks the moment
+    the chunk exists, never waiting on the whole shard."""
+    src = memoryview(data)
+
+    def stage(ctl, attach_resp, batch) -> None:
+        seg = dcn_shm.map_segment(
+            attach_resp.get("path", ""),
+            int(attach_resp.get("bytes") or 0))
+        staged_bytes = 0
+        try:
+            if seg.size < nbytes:
+                raise OSError("segment smaller than payload")
+            for i in batch:
+                off, ln = chunks[i]
+                t0 = time.monotonic()
+                try:
+                    with trace.span("dcn.chunk.stage",
+                                    histogram="dcn.chunk.stage",
+                                    flow=flow, off=off, bytes=ln):
+                        fill_to(off + ln)
+                        seg.view[off:off + ln] = src[off:off + ln]
+                        ctl.shm_commit(flow, ln, xid, offset=off,
+                                       total=nbytes)
+                finally:
+                    result.phase("stage", t0, time.monotonic())
+                staged_bytes += ln
+        finally:
+            seg.close()
+            if staged_bytes:
+                timeseries.record("dcn.shm.tx.bytes", staged_bytes)
+                timeseries.record("dcn.lane.shm.bytes", staged_bytes)
+                timeseries.gauge_add("dcn.lane.shm.total_bytes",
+                                     staged_bytes)
+
+    return stage
+
+
+def send_pipelined(client, flow: str, data, host: str,
                    port: int, cfg: Optional[PipelineConfig] = None,
-                   timeout_s: float = 60.0) -> dict:
+                   timeout_s: float = 60.0,
+                   producer=None, nbytes: Optional[int] = None
+                   ) -> dict:
     """Stage + send ``data`` on ``flow`` to the peer daemon at
     (host, port), chunked and striped, with chunk-granular retransmit.
 
@@ -688,8 +1008,25 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     (``dcn.shm.fallback``) — gets the threaded socket round.  Chunk
     seqs are fixed up front, so retransmits are exactly-once no matter
     which lane a round ran on.
+
+    Producer mode (``producer`` + ``nbytes``, ``data=None``): the
+    payload is pulled from an iterable of byte chunks AS THE FIRST
+    ROUND STAGES, after the round's ONE doorbell — production
+    overlaps the DCN leg instead of preceding it (the stage-then-send
+    baseline).  A ring-less first round materializes the producer
+    fully (``dcn.ring.fallback``) and runs the classic path; retry
+    rounds always send from the materialized buffer under the SAME
+    seqs.
     """
     cfg = cfg or PipelineConfig()
+    fill_to = None
+    if producer is not None:
+        if data is not None:
+            raise ValueError("pass data OR producer, not both")
+        if not nbytes or int(nbytes) <= 0:
+            raise ValueError("producer mode needs nbytes > 0")
+        data, fill_to = _producer_buffer(producer, int(nbytes))
+        counters.inc("dcn.ring.producer.transfers")
     nbytes = len(data)
     # Closed-loop grid control: the tuner (one per destination daemon)
     # turns the configured grid into this transfer's plan.  The chunk
@@ -748,6 +1085,7 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
     resent = 0  # chunk-sends beyond the first round (retransmits)
     lanes = set()  # lanes that actually ran a round
     shm_broken = False  # shm machinery failed once: stay on sockets
+    ring_broken = False  # socket-ring handoff failed once: classic
     # Exposed-communication accounting across ALL rounds: staging
     # intervals vs daemon-round-trip intervals, unioned per transfer —
     # retransmit rounds are honest cost, not excluded noise.
@@ -768,6 +1106,11 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
             if rnd:
                 counters.inc("dcn.pipeline.retry_rounds")
                 resent += len(pending)
+                if fill_to is not None:
+                    # Retry rounds send from the materialized buffer:
+                    # a first round that died mid-production must not
+                    # retransmit half-filled chunks.
+                    fill_to(nbytes)
                 # Heal before retrying: a resilient primary reconnects
                 # and replays the flow table here, so the fresh stripe
                 # connections below land on a daemon that knows `flow`
@@ -790,13 +1133,22 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
             # machinery has not failed this transfer, and the daemon
             # both offers shm and shares our boot identity.
             ran_shm = False
+            producer_round = fill_to is not None and rnd == 0
             if cfg.shm and not shm_broken and shm_same_host(client):
+                stage_cb = (_producer_shm_stage(fill_to, flow, data,
+                                                chunks, xid, nbytes,
+                                                result)
+                            if producer_round and cfg.ring else None)
+                prepare_cb = ((lambda: fill_to(nbytes))
+                              if producer_round else None)
                 ran_shm = _shm_round(uds_dir, flow, data, chunks,
                                      seqs, list(pending), xid, host,
                                      port, timeout_s, result, ctx,
                                      already_staged="shm" in lanes,
                                      direct_pin=direct_pin,
-                                     use_ring=cfg.ring)
+                                     use_ring=cfg.ring,
+                                     stage=stage_cb,
+                                     prepare=prepare_cb)
                 if ran_shm:
                     if "shm" not in lanes:
                         counters.inc("dcn.shm.transfers")
@@ -809,7 +1161,39 @@ def send_pipelined(client, flow: str, data: bytes, host: str,
                         "back to the socket lane", flow,
                         result.errors[-1] if result.errors else "?",
                     )
-            if not ran_shm:
+            ran_ring = False
+            if (not ran_shm and cfg.ring and not ring_broken
+                    and ring_same_host(client)):
+                # Descriptor-driven socket lane: same universal ring,
+                # payload over TCP — no per-chunk control op on the
+                # hot path.
+                ran = _ring_socket_round(
+                    uds_dir, client.data_port(), flow, data, chunks,
+                    seqs, list(pending), xid, host, port, timeout_s,
+                    result, ctx, direct_pin,
+                    fill_to=fill_to if producer_round else None)
+                if ran is None:
+                    # Completer/capability gone (daemon death, ring
+                    # refused): transparent downgrade to the classic
+                    # per-chunk path — the SAME seqs re-send below,
+                    # so late completer sends dedup away.
+                    ring_broken = True
+                    counters.inc("dcn.ring.fallback")
+                    log.warning(
+                        "socket-ring handoff of %r unavailable (%s); "
+                        "falling back to the classic socket round",
+                        flow,
+                        result.errors[-1] if result.errors else "?",
+                    )
+                else:
+                    ran_ring = True
+                    lanes.add("socket")
+                    counters.inc("dcn.ring.socket.rounds")
+            if not ran_shm and not ran_ring:
+                if fill_to is not None:
+                    # Ring-less classic round: the stage worker
+                    # memcpys from the buffer, so materialize first.
+                    fill_to(nbytes)
                 lanes.add("socket")
                 data_port = client.data_port()
                 # The round's "wait" phase: the coordinator parked on

@@ -529,6 +529,19 @@ class FlowTuner:
             return "grow_chunk"
         if lane != "shm" and full \
                 and self._clean_streak >= cfg.grow_clean_rounds:
+            if self._cpu_bound:
+                # The PR 16 profiler verdict, acted on: staging share
+                # is climbing while goodput is flat — the plane is
+                # CPU-bound, not link-bound, a regime AIMD's loss/
+                # goodput laws cannot see.  More stripes would add
+                # thread fan-out to a saturated CPU, so both stripe
+                # probes are held (not reverted — no move, no
+                # hysteresis reset) until the latch clears.  The
+                # latch this decision sees is the PREVIOUS
+                # observation's (cpu_bound is recomputed after the
+                # decision), one observation of lag by design.
+                counters.inc("dcn.tune.cpu_hold")
+                return None
             _, cur_stripes = self._plan_locked()
             ceiling = cfg.max_stripes
             if self._stripe_ceiling is not None:

@@ -275,6 +275,72 @@ class TestDecisionTable:
         assert cfg.max_stripes == dcn_tune.DEFAULT_MAX_STRIPES
 
 
+class TestCpuBoundHold:
+    """The profiler verdict acted on: while the cpu_bound latch is
+    set, stripe-growth probes are HELD (dcn.tune.cpu_hold), not
+    reverted — a hold is not a move, so hysteresis never resets and
+    growth resumes the instant the latch clears."""
+
+    @staticmethod
+    def _tuner_with_shares(shares, **cfg_kw):
+        seq = list(shares)
+
+        def share():
+            return seq.pop(0) if len(seq) > 1 else seq[0]
+
+        cfg_kw.setdefault("min_chunk_bytes", 4096)
+        t = dcn_tune.FlowTuner(
+            "t:cpu", dcn_tune.TuneConfig(**cfg_kw),
+            staging_share=share)
+        t.plan(BASE_CHUNK, 2)
+        return t
+
+    def test_hold_suppresses_growth_then_resumes(self):
+        # Staging share climbs 0.10 -> 0.20 with flat goodput: the
+        # latch sets on obs 2 (clean streak still below the growth
+        # law), obs 3 would grow but is HELD, and with share flat the
+        # latch clears so obs 4 grows — one observation of lag on
+        # each edge, exactly as designed.
+        t = self._tuner_with_shares(
+            [0.10, 0.20, 0.20], grow_clean_rounds=3, max_stripes=4)
+        h0 = counters.get("dcn.tune.cpu_hold")
+        assert clean(t, n=2) == [None, None]
+        assert timeseries.gauges()["dcn.tune.cpu_bound"] == 1.0
+        assert clean(t) == [None]  # growth-eligible, held instead
+        assert counters.get("dcn.tune.cpu_hold") == h0 + 1
+        assert timeseries.gauges()["dcn.tune.cpu_bound"] == 0.0
+        assert clean(t) == ["grow_stripe"]  # latch gone: growth back
+        assert t.stripes_now() == 3
+
+    def test_hold_is_not_a_move_no_hysteresis_reset(self):
+        # Share climbs every observation: the latch never clears and
+        # every growth-eligible observation is a hold.  If a hold
+        # reset _since_move the cooldown would swallow alternate
+        # observations and the hold count would halve — each extra
+        # clean round must produce its own dcn.tune.cpu_hold.
+        t = self._tuner_with_shares(
+            [0.10, 0.20, 0.30, 0.40, 0.50, 0.60],
+            grow_clean_rounds=3, cooldown_obs=1, max_stripes=4)
+        h0 = counters.get("dcn.tune.cpu_hold")
+        assert clean(t, n=6) == [None] * 6
+        assert counters.get("dcn.tune.cpu_hold") == h0 + 4
+        assert t.stripes_now() == 2  # never grew, never reverted
+
+    def test_goodput_scaling_defeats_the_latch(self):
+        # Share climbs but goodput climbs with it (beyond the slack):
+        # the host is spending more CPU AND moving more bytes — that
+        # is healthy scaling, not saturation, and growth proceeds.
+        t = self._tuner_with_shares(
+            [0.10, 0.20, 0.30, 0.40], grow_clean_rounds=3,
+            max_stripes=4)
+        h0 = counters.get("dcn.tune.cpu_hold")
+        out = [clean(t, goodput=1000.0 * (1.3 ** i))[0]
+               for i in range(4)]
+        assert "grow_stripe" in out
+        assert counters.get("dcn.tune.cpu_hold") == h0
+        assert timeseries.gauges()["dcn.tune.cpu_bound"] == 0.0
+
+
 class TestKillSwitch:
     def test_enabled_by_default(self):
         """The soak world (fleet/soak.py) is the standing evidence:

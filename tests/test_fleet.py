@@ -406,8 +406,13 @@ LANE_CFGS = {
     "shm": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
                                        shm=True, ring=False,
                                        tuned=False),
+    # ring=False on the socket row too: with the universal ring the
+    # socket lane is also descriptor-driven by default, which removes
+    # the per-chunk "send" ops these drops target.  The ring-driven
+    # socket lane's chaos story lives in tests/test_dcn_ring.py.
     "socket": dcn_pipeline.PipelineConfig(chunk_bytes=4096, stripes=2,
-                                          shm=False, tuned=False),
+                                          shm=False, ring=False,
+                                          tuned=False),
 }
 
 

@@ -67,8 +67,9 @@ class TestSoakSchedule:
         assert a != b
 
     def test_deterministic_coverage_prologue(self):
-        """Window 0 is clean; windows 1-3 guarantee one kill, one
-        grey, one link fault — every run's coverage floor."""
+        """Window 0 is clean; windows 1-4 guarantee one kill, one
+        grey, one link fault, one slow ring completer — every run's
+        coverage floor."""
         s = SoakSchedule(99, NAMES)
         assert s.faults_for(0) == []
         (kill,) = s.faults_for(1)
@@ -79,15 +80,20 @@ class TestSoakSchedule:
         (link,) = s.faults_for(3)
         assert link["link"].startswith("node:")
         assert ":latency:" in link["link"]
+        (slow,) = s.faults_for(4)
+        assert slow["slow_ring"] in NAMES and slow["for"] == 1
 
     def test_draws_are_well_formed(self):
         s = SoakSchedule(7, NAMES)
-        for w in range(4, 60):
+        for w in range(5, 60):
             for entry in s.faults_for(w):
                 assert ("link" in entry or "grey" in entry
+                        or "slow_ring" in entry
                         or entry.get("action") == "kill")
                 if "grey" in entry:
                     assert entry["grey"] in NAMES
+                if "slow_ring" in entry:
+                    assert entry["slow_ring"] in NAMES
 
     def test_single_node_never_draws_link_faults(self):
         s = SoakSchedule(5, ["only"])
