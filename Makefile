@@ -351,6 +351,7 @@ race:
 	    tests/test_serving.py tests/test_profiler.py \
 	    tests/test_collective_engine.py tests/test_history.py \
 	    tests/test_collective_search.py tests/test_collective_forward.py \
+	    tests/test_anomaly.py \
 	    -q -m "not slow" -p no:randomly
 	$(PY) -m container_engine_accelerators_tpu.analysis.lockwatch \
 	    --check $(RACE_REPORT)
@@ -370,6 +371,24 @@ soak:
 	$(PY) -m pytest tests/test_soak.py -q -p no:randomly
 	$(PY) cmd/fleet_soak.py \
 	    --scenario scenarios/soak_ci.json > /dev/null
+
+# Grey-failure detection gate: the detector suite (robust peer z-scores,
+# hysteresis ladder, kill switch, detection precision/recall math,
+# bucket-delta percentiles, the shm-grey fault, the agent_top panel,
+# the proc-mode confirm-then-clear e2e), then the closed-loop
+# acceptance leg: one seeded proc-mode soak (shm lane on, so all three
+# grey modalities — grey:, slow_ring, slow_shm — are drawn) judged
+# against its own schedule.  --anomaly-gate fails the run unless every
+# seeded grey window was flagged within the detection ceiling
+# (recall 1.0) with false positives on clean windows within the pinned
+# budget, and the max_grey_detection_windows SLO rides the same run.
+# Folded into presubmit.
+.PHONY: anomaly
+anomaly:
+	$(PY) -m pytest tests/test_anomaly.py -q -p no:randomly
+	$(PY) cmd/fleet_soak.py \
+	    --scenario scenarios/soak_anomaly.json \
+	    --anomaly-gate --anomaly-fp-budget 2 > /dev/null
 
 # Run-history gate: the ledger durability suite (torn final line,
 # rotation generation, two-process concurrent append, malformed
@@ -419,6 +438,7 @@ presubmit:
 	$(MAKE) ring
 	$(MAKE) prof
 	$(MAKE) soak
+	$(MAKE) anomaly
 	$(MAKE) trend
 
 # Full on-chip evidence suite (needs a reachable TPU; results append to

@@ -29,7 +29,13 @@ place:
   from the same server's ``/profile`` endpoint (the continuous
   profiler, obs/profiler.py), idle threads split out so a parked pool
   never drowns the busy share.  Absent when the endpoint is (an old
-  agent, or ``TPU_PROF=0``).
+  agent, or ``TPU_PROF=0``);
+- **suspicion**: the grey-failure detector's live verdicts
+  (obs/anomaly.py) — one score bar + verdict per node from the
+  scraped ``anomaly.score.<node>`` / ``anomaly.state.<node>`` gauges,
+  with the cumulative suspect/confirmed/cleared event counts under
+  it.  Present only when the scraped process runs the detector (the
+  fleet coordinator).
 
 Usage:
   python cmd/agent_top.py                       # live, 2s refresh
@@ -53,6 +59,7 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from container_engine_accelerators_tpu.obs import (  # noqa: E402
+    anomaly,
     history,
     profiler,
     promtext,
@@ -210,7 +217,7 @@ def digest(fams: dict, prof: dict = None) -> dict:
                         if phase_total else 0.0)
     phase_rows.sort(key=lambda r: -r["total_us"])
 
-    gauges, slos = [], {}
+    gauges, slos, anom_gauges = [], {}, {}
     for lb, v in fams["agent_gauge"]:
         name = lb.get("name", "?")
         if name.startswith("slo."):
@@ -218,6 +225,9 @@ def digest(fams: dict, prof: dict = None) -> dict:
             if field in ("ok", "value") and key:
                 slos.setdefault(key, {})[field] = v
                 continue
+        if name.startswith("anomaly."):
+            anom_gauges[name] = v
+            continue
         gauges.append((name, v))
     gauges.sort()
 
@@ -246,6 +256,27 @@ def digest(fams: dict, prof: dict = None) -> dict:
                 "won": event_by.get("serving.hedge.won", 0.0),
                 "wasted": event_by.get("serving.hedge.wasted", 0.0),
             },
+        }
+    # Suspicion panel: the grey-failure detector's per-node verdicts,
+    # straight off the scraped anomaly.score.<node> /
+    # anomaly.state.<node> gauges — present only when the scraped
+    # process runs the detector (the fleet coordinator publishes
+    # them; a plain node agent doesn't).
+    suspicion = None
+    score_rows = []
+    for name, v in sorted(anom_gauges.items()):
+        if not name.startswith("anomaly.score."):
+            continue
+        node = name[len("anomaly.score."):]
+        state = int(anom_gauges.get(f"anomaly.state.{node}", 0.0))
+        score_rows.append({"node": node, "score": v, "state": state})
+    if score_rows:
+        score_rows.sort(key=lambda r: (-r["score"], r["node"]))
+        suspicion = {
+            "rows": score_rows,
+            "suspect": event_by.get("anomaly.suspect", 0.0),
+            "confirmed": event_by.get("anomaly.confirmed", 0.0),
+            "cleared": event_by.get("anomaly.cleared", 0.0),
         }
     # Lane split (the memcpy-speed same-host plane): where the data
     # plane's BYTES go — daemon↔daemon segments, client↔daemon shm
@@ -307,6 +338,7 @@ def digest(fams: dict, prof: dict = None) -> dict:
             "latency": latency, "gauges": gauges, "slos": slos,
             "serving": serving, "phases": phase_rows, "tuner": tuner,
             "lanes": lanes, "hotspots": hotspots,
+            "suspicion": suspicion,
             "exposed_ratio": dict(gauges).get("dcn.exposed_ratio")}
 
 
@@ -412,6 +444,25 @@ def render(model: dict, source: str, top_n: int = 10) -> str:
         if exposed is not None:
             lines.append(f"{'exposed comm ratio':<28} "
                          f"{'':>7} {'':>10} {exposed * 100:>6.1f}%")
+
+    suspicion = model.get("suspicion")
+    if suspicion:
+        cap = anomaly.AnomalyConfig().score_cap
+        lines.append("")
+        lines.append(f"{'suspicion (grey-failure)':<16} "
+                     f"{'score':>7}  {'':<{int(cap) + 2}} verdict")
+        for r in suspicion["rows"][:top_n]:
+            fill = int(round(min(max(r["score"], 0.0), cap)))
+            bar = "#" * fill
+            verdict = anomaly.STATE_NAMES.get(r["state"], "?")
+            if r["state"] != anomaly.HEALTHY:
+                verdict = verdict.upper()
+            lines.append(f"{r['node']:<16} {r['score']:>7.2f}  "
+                         f"[{bar:<{int(cap)}}] {verdict}")
+        lines.append(f"{'(events)':<16} "
+                     f"suspect={suspicion['suspect']:.0f} "
+                     f"confirmed={suspicion['confirmed']:.0f} "
+                     f"cleared={suspicion['cleared']:.0f}")
 
     hotspots = model.get("hotspots")
     if hotspots:
@@ -572,6 +623,19 @@ def _demo_server():
     timeseries.gauge("serving.breaker.open_nodes", 1)
     timeseries.gauge("slo.min_qps.ok", 1)  # lint: disable=undocumented-metric
     timeseries.gauge("slo.min_qps.value", 38.0)  # lint: disable=undocumented-metric
+    # The suspicion panel's inputs: concrete demo instances of the
+    # documented anomaly.score.<node> / anomaly.state.<node> gauges
+    # (one healthy node, one suspect, one confirmed-grey) plus the
+    # verdict-transition counters.
+    timeseries.gauge("anomaly.score.n0", 0.3)  # lint: disable=undocumented-metric
+    timeseries.gauge("anomaly.state.n0", 0)  # lint: disable=undocumented-metric
+    timeseries.gauge("anomaly.score.n1", 2.1)  # lint: disable=undocumented-metric
+    timeseries.gauge("anomaly.state.n1", 1)  # lint: disable=undocumented-metric
+    timeseries.gauge("anomaly.score.n2", 7.4)  # lint: disable=undocumented-metric
+    timeseries.gauge("anomaly.state.n2", 2)  # lint: disable=undocumented-metric
+    counters.inc("anomaly.suspect", 2)
+    counters.inc("anomaly.confirmed", 1)
+    counters.inc("anomaly.cleared", 1)
     # The hotspot panel's input: seeded folded stacks in the process
     # profiler registry — the demo server's /profile serves them.
     profiler.ingest(

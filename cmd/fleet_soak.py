@@ -90,6 +90,18 @@ def parse_args(argv=None):
                         "slopes against the history ledger baseline "
                         "(TPU_HISTORY_DIR); a regression exits 1 "
                         "(sentinel/SLO breaches still exit 3 first)")
+    p.add_argument("--anomaly-gate", action="store_true",
+                   help="judge the grey-failure detector closed-loop "
+                        "against the seeded schedule: every seeded "
+                        "grey window must be flagged within K windows "
+                        "(recall 1.0) with false positives on clean "
+                        "windows <= the budget; a miss exits 1 "
+                        "(sentinel/SLO breaches still exit 3 first)")
+    p.add_argument("--anomaly-fp-budget", type=int, default=2,
+                   metavar="N",
+                   help="--anomaly-gate false-positive budget: flags "
+                        "on windows with no scheduled fault in "
+                        "flight (default 2)")
     return p.parse_args(argv)
 
 
@@ -103,6 +115,26 @@ def _print_report(report, file=sys.stderr):
     print(f"chaos: kills={soak.get('kills')} greys={soak.get('greys')} "
           f"heals={soak.get('heals')} "
           f"heal_windows={soak.get('heal_windows')}", file=file)
+    anom = report.get("anomaly") or {}
+    if anom.get("verdicts"):
+        flagged = {e: v for e, v in anom["verdicts"].items()
+                   if v["state"] != "healthy"
+                   or anom.get("flagged_windows", {}).get(e)}
+        print(f"anomaly: enabled={anom.get('enabled')} "
+              f"confirmations={len(anom.get('confirmations') or [])} "
+              f"flagged={sorted(flagged) or 'none'}", file=file)
+    det = anom.get("detection")
+    if det:
+        print(f"anomaly detection: recall={det['recall']} "
+              f"({len(det['detections']) - len(det['missed'])}"
+              f"/{det['truth']} within k={det['k']}) "
+              f"worst_latency={det['detect_windows_max']:g}w "
+              f"false_positives={det['false_positive_count']} "
+              f"clean_windows={det['clean_windows']}", file=file)
+        for m in det.get("missed", [])[:8]:
+            print(f"  missed: {m}", file=file)
+        for fp in det.get("false_positives", [])[:8]:
+            print(f"  false positive: {fp}", file=file)
     nodes = report["nodes"]
     width = max([len(n) for n in nodes] + [4])
     print(f"\n{'node':<{width}} {'rack':>6} {'healthy':>8} {'gen':>4} "
@@ -182,12 +214,49 @@ def main(argv=None):
     report["version"] = history.repo_version()
     report["schema_version"] = REPORT_SCHEMA_VERSION
     trend_rc = _record_and_trend(report, args, run_id)
+    anomaly_rc = _anomaly_gate(report, args)
     _print_report(report)
     print(json.dumps(report))
     if args.trace_file:
         trace.configure(None)  # flush/close the sink
     rc = exit_code_for(report)
-    return rc if rc else trend_rc
+    return rc if rc else (trend_rc or anomaly_rc)
+
+
+def _anomaly_gate(report, args) -> int:
+    """The --anomaly-gate verdict: the closed-loop detection judgment
+    against the seeded schedule must show recall 1.0 (every seeded
+    grey window flagged within K windows of onset) and at most
+    --anomaly-fp-budget false positives on clean windows.  A run that
+    produced no detection section at all (detector disabled, or no
+    grey truth seeded) fails the gate too — a gate that can be
+    silently vacuous is no gate."""
+    if not args.anomaly_gate:
+        return 0
+    det = (report.get("anomaly") or {}).get("detection")
+    if not det or not det.get("truth"):
+        print("anomaly gate: no seeded grey truth was judged "
+              "(detector disabled, or the schedule drew no grey "
+              "fault) — FAIL", file=sys.stderr)
+        return 1
+    failures = []
+    if det["recall"] < 1.0:
+        failures.append(f"recall {det['recall']} < 1.0 "
+                        f"(missed: {det['missed']})")
+    if det["false_positive_count"] > args.anomaly_fp_budget:
+        failures.append(
+            f"{det['false_positive_count']} false positive(s) > "
+            f"budget {args.anomaly_fp_budget}: "
+            f"{det['false_positives']}")
+    if failures:
+        print("anomaly gate: FAIL — " + "; ".join(failures),
+              file=sys.stderr)
+        return 1
+    print(f"anomaly gate: ok — recall 1.0 over {det['truth']} seeded "
+          f"grey window(s), {det['false_positive_count']} false "
+          f"positive(s) within budget {args.anomaly_fp_budget}",
+          file=sys.stderr)
+    return 0
 
 
 def _record_and_trend(report, args, run_id) -> int:
@@ -207,6 +276,12 @@ def _record_and_trend(report, args, run_id) -> int:
         .get("max_slopes") or {}
     for metric, slope in slopes.items():
         metrics[f"leak_slope.{metric}"] = float(slope)
+    det = (report.get("anomaly") or {}).get("detection")
+    if det and det.get("truth"):
+        metrics["anomaly.detect_windows_max"] = \
+            float(det["detect_windows_max"])
+        metrics["anomaly.false_positives"] = \
+            float(det["false_positive_count"])
     try:
         prior = ledger.records(kind="fleet_soak", cfg_key=cfg_key)
     except history.LedgerError as e:

@@ -800,6 +800,11 @@ class FleetController:
             # coordinator's own sampler) — the companion question to
             # critical_path's "where did the wall time go".
             "profile": self.telemetry.profile_report(),
+            # Grey-failure verdicts (obs/anomaly.py): live peer-
+            # relative suspicion per node, plus the closed-loop
+            # precision/recall judgment when the soak world fed its
+            # seeded schedule as ground truth.
+            "anomaly": self.telemetry.anomaly_report(),
             "telemetry": {"rounds": self.telemetry.history},
             "slo": self.telemetry.evaluate(links_report),
             "converged": (survivors_converged and all_up_healthy

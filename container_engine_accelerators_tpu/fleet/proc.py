@@ -363,6 +363,15 @@ class ProcNode:
                                seconds=float(seconds)).get(
                                    "delay_s", 0.0))
 
+    def shm_delay(self, seconds: float) -> float:
+        """Arm the worker daemon's slow-shm-commit grey fault: every
+        shm commit pays ``seconds`` before landing — a throttled
+        staging memcpy, slow, not dead (commits still land and
+        account).  0 disarms."""
+        return float(self._rpc("shm_delay",
+                               seconds=float(seconds)).get(
+                                   "delay_s", 0.0))
+
     def resources(self) -> Dict[str, int]:
         """The worker's resource census (fds / threads / shm segments
         / rss) for the soak leak sentinel.  Raises OSError on a dark
@@ -633,6 +642,9 @@ def _serve(node, out) -> None:
                     float(req.get("param", 0.0)))
             elif op == "ring_delay":
                 resp["delay_s"] = node.daemon.set_ring_delay(
+                    float(req.get("seconds", 0.0)))
+            elif op == "shm_delay":
+                resp["delay_s"] = node.daemon.set_shm_delay(
                     float(req.get("seconds", 0.0)))
             elif op == "resources":
                 resp["resources"] = _resource_snapshot(

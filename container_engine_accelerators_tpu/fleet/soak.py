@@ -105,12 +105,29 @@ GREY_LATENCY_S = 0.05
 # sentinels must catch without a transfer wedging.
 RING_DELAY_S = 0.08
 
+# Slow-shm-commit grey fault, per staged frame: every shm commit pays
+# this before landing — a throttled staging memcpy on the zero-copy
+# lane.  Commits still land and account, so only the xferd.shm.commit
+# latency histogram (and the anomaly detector reading it) sees it.
+SHM_DELAY_S = 0.06
+
 # The deterministic coverage prologue: window 1 SIGKILL (+respawn),
 # window 2 grey (+ungrey), window 3 link degrade (+heal), window 4
-# slow ring completer (+unslow) — every soak run exercises all four
-# fault families and their heals even at the shortest CI duration;
-# later windows draw from the seeded RNG.
+# slow ring completer (+unslow), and — on shm-lane scenarios — window
+# 5 slow shm commit (+unslow) — every soak run exercises every fault
+# family and its heal even at the shortest CI duration; later windows
+# draw from the seeded RNG.
 LAST_DETERMINISTIC_WINDOW = 4
+
+# Post-fault settle allowance, in windows, the closed-loop detection
+# judge grants after every scheduled fault's lifetime before a flag on
+# that window counts as a false positive: the anomaly EWMA decays over
+# several windows by design (hysteresis is the anti-flap contract —
+# from the score cap it takes ~4 windows to fall under clear_z plus
+# clear_windows more to step down), and the goodput rate windows smear
+# the evidence one further — decay after chaos is the detector
+# working, not a false alarm.
+ANOMALY_SETTLE_WINDOWS = 5
 
 # Tuner decisions that count as REACTIVE moves for the convergence
 # sentinel: the loss-response axis (and its recovery).  Exploration
@@ -168,9 +185,20 @@ class SoakSchedule:
     windows, so any window's draw can be recomputed in isolation and
     the whole schedule replays from the seed alone."""
 
-    def __init__(self, seed: int, node_names: List[str]):
+    def __init__(self, seed: int, node_names: List[str],
+                 shm: bool = False):
         self.seed = int(seed)
         self.names = list(node_names)
+        # shm-lane scenarios (scenario "shm": true) extend the grammar
+        # with the slow_shm grey fault: a throttled per-frame commit
+        # on the staging lane.  Gated on the flag because a socket-
+        # only scenario never commits — the fault would be a no-op and
+        # the detection judge would count an undetectable truth.
+        self.shm = bool(shm)
+        # The last window of the deterministic coverage prologue —
+        # shm scenarios add the window-5 slow_shm leg.
+        self.last_deterministic = (5 if self.shm
+                                   else LAST_DETERMINISTIC_WINDOW)
 
     def _rng(self, window: int) -> random.Random:
         return random.Random(f"{self.seed}:{window}")
@@ -194,6 +222,8 @@ class SoakSchedule:
                      "for": 1}]
         if window == 4:
             return [{"slow_ring": rng.choice(self.names), "for": 1}]
+        if window == 5 and self.shm:
+            return [{"slow_shm": rng.choice(self.names), "for": 1}]
         draws: List[dict] = []
         r = rng.random()
         if r < 0.15:
@@ -211,6 +241,13 @@ class SoakSchedule:
             # node's universal ring — every descriptor costs a sleep,
             # no descriptor is lost.
             draws.append({"slow_ring": rng.choice(self.names),
+                          "for": 1})
+        elif r < 0.65 and self.shm:
+            # The staging lane's grey fault: a throttled shm commit —
+            # drawn from the band the non-shm grammar leaves clean, so
+            # flipping shm on never perturbs an existing seed's other
+            # draws.
+            draws.append({"slow_shm": rng.choice(self.names),
                           "for": 1})
         return draws
 
@@ -488,8 +525,11 @@ class SoakWorld(FleetController):
             merged.get("grey_latency_s", GREY_LATENCY_S))
         self.ring_delay_s = float(
             merged.get("ring_delay_s", RING_DELAY_S))
+        self.shm_delay_s = float(
+            merged.get("shm_delay_s", SHM_DELAY_S))
         self.schedule = SoakSchedule(
-            self.seed, [s.name for s in self.topology.specs.values()])
+            self.seed, [s.name for s in self.topology.specs.values()],
+            shm=bool(merged.get("shm")))
         self.mono = MonotonicitySentinel()
         # History-learned thresholds: prior soak runs of this SAME
         # config (ledger under TPU_HISTORY_DIR) tighten the leak
@@ -553,7 +593,39 @@ class SoakWorld(FleetController):
             return self._apply_grey(rnd, entry)
         if "slow_ring" in entry or "unslow_ring" in entry:
             return self._apply_slow_ring(rnd, entry)
+        if "slow_shm" in entry or "unslow_shm" in entry:
+            return self._apply_slow_shm(rnd, entry)
         return super()._apply_fault(rnd, entry)
+
+    def _apply_slow_shm(self, rnd: int, entry: dict) -> dict:
+        """Arm (or heal) the staging lane's grey fault: every shm
+        commit on the node pays a per-frame throttle before landing —
+        a slow memcpy, not a slow completer.  Commits still land and
+        account, so nothing but the xferd.shm.commit latency histogram
+        (the anomaly detector's attribution stream) carries the
+        evidence."""
+        healing = "unslow_shm" in entry
+        name = entry["unslow_shm"] if healing else entry["slow_shm"]
+        record = dict(entry)
+        record["round"] = rnd
+        record["applied"] = 0
+        node = self.nodes.get(name)
+        if node is None:
+            log.error("slow_shm fault names unknown node: %r", entry)
+            record["skipped"] = f"unknown node {name!r}"
+            return record
+        try:
+            node.shm_delay(0.0 if healing else self.shm_delay_s)
+            record["applied"] = 1
+        except (OSError, AttributeError) as e:
+            record["skipped"] = f"shm_delay {name}: {e}"
+        if not healing and record["applied"]:
+            counters.inc("soak.fault.slow_shm")
+            lifetime = int(entry.get("for", 0))
+            if lifetime > 0:
+                self._deferred.setdefault(rnd + lifetime, []).append(
+                    {"unslow_shm": name})
+        return record
 
     def _apply_slow_ring(self, rnd: int, entry: dict) -> dict:
         """Arm (or heal) the ring lane's grey fault: the node's ring
@@ -636,7 +708,8 @@ class SoakWorld(FleetController):
     def _is_heal(record: dict) -> bool:
         if record.get("skipped") and not record.get("applied"):
             return False
-        if "ungrey" in record or "unslow_ring" in record:
+        if "ungrey" in record or "unslow_ring" in record \
+                or "unslow_shm" in record:
             return True
         if record.get("action") == "restart":
             return True
@@ -672,7 +745,9 @@ class SoakWorld(FleetController):
                 # exempt so even the shortest run keeps its coverage
                 # guarantee (its heals land by window 4, well before
                 # any sane cooldown).
-                injecting = (w <= LAST_DETERMINISTIC_WINDOW
+                injecting = (w <= getattr(self.schedule,
+                                          "last_deterministic",
+                                          LAST_DETERMINISTIC_WINDOW)
                              or (deadline - time.monotonic())
                              > self.cooldown_s)
                 if injecting:
@@ -688,6 +763,7 @@ class SoakWorld(FleetController):
                             self._kills += 1
                         if "grey" in rec and rec.get("applied"):
                             self._greys += 1
+                        self._record_truth(w, rec)
                 legs = self._window_workloads(w, per_node_ok,
                                               per_node_failed)
                 for node in self.nodes.values():
@@ -786,6 +862,26 @@ class SoakWorld(FleetController):
         return legs
 
     # -- sentinel feeds ------------------------------------------------------
+
+    def _record_truth(self, w: int, rec: dict) -> None:
+        """Feed the anomaly detector's closed-loop judge DURING the
+        run (telemetry.evaluate runs inside _report, before the soak
+        section exists): every APPLIED grey-family fault becomes a
+        ground-truth entry the detection recall is judged over, and
+        every scheduled fault of ANY kind marks its window footprint
+        (lifetime + the hysteresis settle allowance) so decay after
+        chaos never counts as a false positive."""
+        if not rec.get("applied"):
+            return
+        lifetime = max(1, int(rec.get("for", 1)))
+        for wx in range(w, w + lifetime + ANOMALY_SETTLE_WINDOWS + 1):
+            self.telemetry.anomaly_chaos.add(wx)
+        for kind in ("grey", "slow_ring", "slow_shm"):
+            if kind in rec:
+                self.telemetry.anomaly_truth.append(
+                    {"node": rec[kind], "window": w,
+                     "lifetime": lifetime, "kind": kind})
+                return
 
     def _sample_resources(self, w: int) -> None:
         """One resource census per live node per window — the leak

@@ -75,10 +75,11 @@ import logging
 import time
 import urllib.error
 import urllib.request
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from container_engine_accelerators_tpu.metrics import counters
 from container_engine_accelerators_tpu.obs import (
+    anomaly,
     histo,
     profiler,
     promtext,
@@ -172,7 +173,28 @@ SLO_KEYS = {
     "max_exposed_comm_ratio": ("ceiling",
                                "exposed DCN time / total DCN time "
                                "(pipelined transfers, this run)"),
+    # Grey-failure detection latency (obs/anomaly.py): worst
+    # windows-from-onset over the run's seeded grey faults, judged
+    # closed-loop against the soak schedule's ground truth.  A run
+    # with no seeded grey truth measures 0.0 (vacuous); a seeded grey
+    # window the detector never flagged measures the whole run length
+    # — honestly past any sane ceiling.
+    "max_grey_detection_windows": ("ceiling",
+                                   "worst windows-to-flag over the "
+                                   "seeded grey faults (0 = none "
+                                   "seeded; a miss measures the run "
+                                   "length)"),
 }
+
+# Windows an idle node's last histogram p99 stands in as peer
+# baseline before aging out (see _anom_hold_fill).
+ANOMALY_HOLD_WINDOWS = 3
+
+# The per-node attribution histograms the anomaly detector compares
+# across peers, scraped as cumulative agent_latency{op,bucket} families
+# and deltaed per window: the ring completer's per-descriptor drive and
+# the shm lane's per-frame commit — one op per grey-fault modality.
+ANOMALY_HISTO_OPS = ("xferd.ring.drive", "xferd.shm.commit")
 
 # The latency histogram the p99 ceiling reads; one fleet-sim leg with
 # its retries included (fleet/controller.py stamps it).
@@ -229,6 +251,18 @@ class NodeScrape:
             if all(lab.get(k) == want for k, want in labels.items()):
                 return v
         return default
+
+    def buckets(self, family: str, **labels: str) -> Dict[str, float]:
+        """Every sample of ``family`` matching ``labels``, keyed by
+        its ``bucket`` label — the ``agent_latency{op,bucket}``
+        cumulative-histogram reader (empty dict when the op never
+        observed anything on this node)."""
+        out: Dict[str, float] = {}
+        for lab, v in self._families.get(family, []):
+            if all(lab.get(k) == want for k, want in labels.items()) \
+                    and lab.get("bucket") is not None:
+                out[lab["bucket"]] = v
+        return out
 
 
 def parse_prometheus_text(body: str) -> NodeScrape:
@@ -392,6 +426,48 @@ class FleetTelemetry:
         # coordinator-side in both modes, so the busbw SLOs never
         # need the scrape path.
         self.collective_rounds: List[dict] = []
+        # Grey-failure detection (obs/anomaly.py): peer-relative
+        # robust z-scores per window folded into hysteretic per-node
+        # verdicts.  Evidence per round: per-node goodput, scrape RTT,
+        # profiler busy-share deltas, per-window p99s of the
+        # attribution histograms (ANOMALY_HISTO_OPS), and fleet.leg
+        # span latency per source node.  TPU_ANOMALY=0 makes all of
+        # it inert.  One warmup window: the boot round's cold-start
+        # transients (first-connection legs, half-warmed histograms)
+        # have no peer baseline worth judging against.
+        self.anomaly = anomaly.AnomalyDetector(
+            anomaly.AnomalyConfig(warmup_windows=1))
+        # Ground truth, fed by the soak world DURING the run (the
+        # report is assembled before the soak section exists): seeded
+        # grey-family faults as TruthWindow dicts, plus the FULL
+        # schedule's window footprint — false positives only count on
+        # windows with no scheduled fault of any kind in flight.
+        self.anomaly_truth: List[dict] = []
+        self.anomaly_chaos: set = set()
+        # Per-(node, op) cumulative-bucket baselines for the windowed
+        # histogram deltas, reset on worker generation change (a
+        # respawned worker's buckets restart at zero).
+        self._anom_buckets: Dict[Tuple[str, str], Dict[str, float]] = {}
+        self._anom_bucket_gen: Dict[str, Optional[int]] = {}
+        # Last-seen merged profiler totals per node, for the
+        # per-window busy-share delta (the merge is already
+        # restart-aware, so these totals are monotone).
+        self._anom_prof_last: Dict[str, Tuple[float, float]] = {}
+        # The window under assembly: {metric_op: {node: value}},
+        # rebuilt each sample_round by the scrape path.
+        self._anom_window: Dict[str, Dict[str, float]] = {}
+        # Last-observation-carried-forward state for the sparse
+        # histogram streams: {(node, stream): (p99, windows_held)}.
+        self._anom_histo_hold: Dict[
+            Tuple[str, str], Tuple[float, int]] = {}
+        # Last (generation, cumulative transferred) per node, for the
+        # WINDOWED goodput evidence.  The workers' own goodput gauge
+        # is a lifetime average, and a lifetime average poisons the
+        # peer comparison after a respawn: the fresh process's reset
+        # counters read as roughly half its peers' goodput for the
+        # remainder of the run — a systematic false conviction.
+        self._anom_goodput_last: Dict[
+            str, Tuple[Optional[int], float]] = {}
 
     # -- per-round scrape ----------------------------------------------------
 
@@ -401,6 +477,7 @@ class FleetTelemetry:
         schema is identical in both modes; scrape mode adds HTTP as
         the transport and ``stale`` as the degradation verdict."""
         per_node = {}
+        self._anom_window = {}
         for name, node in self.nodes.items():
             if self.scrape:
                 per_node[name] = self._scrape_entry(name, node)
@@ -435,8 +512,183 @@ class FleetTelemetry:
             if any(lanes.values()):
                 sample["lanes_total_bytes"] = lanes
         self.history.append(sample)
+        before = len(self._spans)
         self._drain_local_spans()
+        if self.anomaly.enabled:
+            # This round's coordinator spans (the drain may trim from
+            # the front at the cap; then the whole tail stands in —
+            # blurrier evidence, never an index error).
+            fresh = (self._spans[before:]
+                     if len(self._spans) >= before
+                     else list(self._spans))
+            self._anomaly_observe(rnd, per_node, fresh)
         return sample
+
+    # -- grey-failure evidence (obs/anomaly.py) ------------------------------
+
+    def _anomaly_observe(self, rnd: int, per_node: Dict[str, dict],
+                         fresh_spans: List[dict]) -> None:
+        """Fold one window of peer-comparable evidence into the
+        detector.  Every stream is optional — a window where a stream
+        carries no signal (idle lane, degenerate dispersion, too few
+        peers) contributes nothing, and a stale/down node holds its
+        verdict instead of scoring."""
+        absent = {n for n, e in per_node.items()
+                  if e.get("stale") or e.get("down")}
+        evidence = [anomaly.Evidence(
+            "goodput_win_bytes", self._anom_goodput(per_node),
+            direction="low", abs_floor=4096.0, rel_floor=0.5)]
+        rtts = {n: float(e["scrape_rtt_s"])
+                for n, e in per_node.items() if "scrape_rtt_s" in e}
+        if rtts:
+            evidence.append(anomaly.Evidence(
+                "scrape_rtt_s", rtts, direction="high",
+                abs_floor=0.02))
+        # Worst fleet.leg latency per SOURCE node this round — the
+        # legs live coordinator-side in both fleet modes, so this
+        # stream needs no scrape.
+        legs: Dict[str, float] = {}
+        for sp in fresh_spans:
+            if sp.get("name") != LEG_OP:
+                continue
+            src = (sp.get("attrs") or {}).get("src")
+            if src in per_node:
+                legs[src] = max(legs.get(src, 0.0),
+                                float(sp.get("dur_us") or 0.0))
+        if legs:
+            # Worst-leg latency is heavy-tailed even on a healthy
+            # fleet (burst alignment, connection reuse), and a slow
+            # DESTINATION drags its sources' legs too — corroborating
+            # evidence, so the wide rel_floor keeps it from convicting
+            # alone the way the node-local histograms may.
+            evidence.append(anomaly.Evidence(
+                "leg_dur_us", legs, direction="high",
+                abs_floor=4096.0, rel_floor=0.5))
+        for op, vals in self._anom_window.items():
+            floor = 0.15 if op == "busy_share" else 4096.0
+            if op != "busy_share":
+                vals = self._anom_hold_fill(op, vals, per_node,
+                                            absent)
+            evidence.append(anomaly.Evidence(
+                op, vals, direction="high", abs_floor=floor))
+        self.anomaly.observe(rnd, evidence, absent=absent)
+
+    def _anom_hold_fill(self, op: str, vals: Dict[str, float],
+                        per_node: Dict[str, dict],
+                        absent: Set[str]) -> Dict[str, float]:
+        """Last-observation-carried-forward for the sparse histogram
+        streams: a node that performed no ops this window contributes
+        its last measured p99 (for up to ANOMALY_HOLD_WINDOWS) as the
+        peer baseline.  Without it, one quiet node silences the whole
+        stream under min_peers — exactly when a peer's throttle
+        spikes and the conviction matters most."""
+        out = dict(vals)
+        for n in per_node:
+            if n in out:
+                self._anom_histo_hold[(n, op)] = (out[n], 0)
+                continue
+            if n in absent:
+                continue
+            held = self._anom_histo_hold.get((n, op))
+            if held and held[1] < ANOMALY_HOLD_WINDOWS:
+                out[n] = held[0]
+                self._anom_histo_hold[(n, op)] = (held[0],
+                                                  held[1] + 1)
+        return out
+
+    def _anom_goodput(self, per_node: Dict[str, dict]
+                      ) -> Dict[str, float]:
+        """Windowed goodput per node: the delta of each node's
+        cumulative transferred total since the last window, keyed by
+        worker incarnation.  A respawned node is judged on what its
+        NEW process moved this window — its reset lifetime average
+        would read grey for the rest of the run.  Sharper, too: a
+        grey window's stall shows whole in its own delta instead of
+        diluted into the run-long mean."""
+        out: Dict[str, float] = {}
+        for n, e in per_node.items():
+            tot = e.get("transferred")
+            if tot is None:
+                # Down/stale entries carry no total (and sit in the
+                # absent set); anything else falls back to the gauge.
+                out[n] = float(e.get("goodput_bps") or 0.0)
+                continue
+            gen = getattr(getattr(self.nodes.get(n), "daemon", None),
+                          "generation", None)
+            last_gen, last_tot = self._anom_goodput_last.get(
+                n, (gen, 0.0))
+            if gen != last_gen:
+                last_tot = 0.0
+            self._anom_goodput_last[n] = (gen, float(tot))
+            out[n] = max(0.0, float(tot) - last_tot)
+        return out
+
+    def _anom_fold_node(self, name: str, s: NodeScrape,
+                        gen: Optional[int]) -> None:
+        """One scraped node's contribution to the window under
+        assembly: per-window p99s of the attribution histograms
+        (cumulative le buckets deltaed against the last scrape,
+        baselines reset on respawn) and the profiler busy-share delta
+        (the merged profile totals are already restart-aware)."""
+        if gen is not None and self._anom_bucket_gen.get(name) != gen:
+            for op in ANOMALY_HISTO_OPS:
+                self._anom_buckets.pop((name, op), None)
+                self._anom_histo_hold.pop(
+                    (name, f"{op}.p99_us"), None)
+            self._anom_bucket_gen[name] = gen
+            self._anom_prof_last.pop(name, None)
+        for op in ANOMALY_HISTO_OPS:
+            cur = s.buckets("agent_latency", op=op)
+            base = self._anom_buckets.get((name, op), {})
+            self._anom_buckets[(name, op)] = cur
+            if not cur:
+                continue
+            p99 = anomaly.bucket_delta_p99_us(cur, base)
+            if p99 is not None:
+                self._anom_window.setdefault(
+                    f"{op}.p99_us", {})[name] = p99
+        st = self._prof.get(name)
+        if st:
+            samples = float(st["samples"])
+            idle = float(st["subsystems"].get("idle", 0.0))
+            last_s, last_i = self._anom_prof_last.get(name,
+                                                     (0.0, 0.0))
+            self._anom_prof_last[name] = (samples, idle)
+            ds, di = samples - last_s, idle - last_i
+            if ds > 0:
+                busy = max(0.0, ds - max(0.0, di)) / ds
+                self._anom_window.setdefault("busy_share",
+                                             {})[name] = busy
+
+    def anomaly_report(self) -> dict:
+        """The report's ``anomaly`` section: the detector's verdicts
+        plus — when the soak world fed seeded ground truth — the
+        closed-loop precision/recall judgment."""
+        rep = self.anomaly.report()
+        if self.anomaly_truth:
+            rep["detection"] = self.detection_summary()
+        return rep
+
+    def detection_summary(self,
+                          k: int = anomaly.DETECT_WINDOWS_K) -> dict:
+        truth = [anomaly.TruthWindow(**t) for t in self.anomaly_truth]
+        return anomaly.detection_report(
+            truth, self.anomaly.flagged,
+            self.anomaly.windows_observed, k=k,
+            chaos_windows=self.anomaly_chaos)
+
+    def _grey_detection_windows(self) -> float:
+        """The max_grey_detection_windows SLO input: 0.0 with no
+        seeded truth (vacuous), the worst windows-to-flag otherwise —
+        and a MISS measures the whole run length, honestly outside
+        any sane ceiling."""
+        if not self.anomaly_truth:
+            return 0.0
+        det = self.detection_summary()
+        if det["missed"]:
+            return float(max(self.anomaly.windows_observed,
+                             det["k"] + 1))
+        return det["detect_windows_max"]
 
     # -- span collection (the critical_path section's evidence) --------------
 
@@ -680,8 +932,10 @@ class FleetTelemetry:
         last: Optional[ScrapeError] = None
         for _attempt in range(2):  # one retry, same budget each
             try:
+                rtt_t0 = time.monotonic()
                 s = scrape_metric_server(node.metrics_port,
                                          self.scrape_timeout_s)
+                scrape_rtt_s = time.monotonic() - rtt_t0
                 break
             except ScrapeError as e:
                 last = e
@@ -707,6 +961,10 @@ class FleetTelemetry:
                 s.value("agent_goodput", scope="node", name=name), 1),
             "down": False,
             "stale": False,
+            # Scrape round-trip time doubles as grey-failure evidence:
+            # a worker whose GIL a CPU burn is holding answers its
+            # /metrics GET late, and only THAT worker does.
+            "scrape_rtt_s": round(scrape_rtt_s, 4),
             "spans_stale": not self._scrape_node_spans(name, node),
             "profile_stale": not self._scrape_node_profile(name, node),
             "active_flows": int(s.value("agent_gauge",
@@ -714,6 +972,8 @@ class FleetTelemetry:
             "transferred": int(s.value("agent_gauge",
                                        name="xferd.total_transferred")),
         }
+        if self.anomaly.enabled:
+            self._anom_fold_node(name, s, gen)
         # Per-node lane evidence (the memcpy-speed same-host plane):
         # a worker whose shm_direct total grows while its socket
         # total stays flat is provably skipping the peer TCP stream.
@@ -852,6 +1112,8 @@ class FleetTelemetry:
             "max_dedup_ratio": dups / max(1, frames),
             "max_exposed_comm_ratio": self._exposed_comm_ratio(),
             "min_final_goodput_bps": self._final_round_goodput(),
+            "max_grey_detection_windows":
+                self._grey_detection_windows(),
             **self._collective_measurements(),
             **self._serving_measurements(elapsed_s),
         }
@@ -890,6 +1152,8 @@ class FleetTelemetry:
             "max_dedup_ratio": ratio,
             "max_exposed_comm_ratio": self._exposed_comm_ratio(),
             "min_final_goodput_bps": self._final_round_goodput(),
+            "max_grey_detection_windows":
+                self._grey_detection_windows(),
             "stale_entries_skipped": stale_entries,
             **self._collective_measurements(),
             **self._serving_measurements(elapsed_s),

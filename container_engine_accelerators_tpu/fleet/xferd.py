@@ -150,7 +150,7 @@ from typing import Dict, Optional, Tuple
 
 from container_engine_accelerators_tpu.analysis import lockwatch
 from container_engine_accelerators_tpu.metrics import counters
-from container_engine_accelerators_tpu.obs import timeseries, trace
+from container_engine_accelerators_tpu.obs import histo, timeseries, trace
 from container_engine_accelerators_tpu.parallel import dcn_shm
 from container_engine_accelerators_tpu.utils import netio
 
@@ -601,6 +601,10 @@ class PyXferd:
         # completer sleeps before driving each posted descriptor — a
         # completer that is slow, not dead.
         self._ring_delay_s = 0.0
+        # Grey-fault hook (soak "slow_shm"): per-frame delay the shm
+        # commit path pays before landing — a throttled staging
+        # memcpy, the shm lane's slow-not-dead sibling.
+        self._shm_delay_s = 0.0
         self.data_port = 0
         self.generation = 0
         self._flows: Dict[str, _Flow] = {}
@@ -846,6 +850,18 @@ class PyXferd:
         log.warning("ring completer delay %.3fs armed on node %s",
                     self._ring_delay_s, self.node or "?")
         return self._ring_delay_s
+
+    def set_shm_delay(self, seconds: float) -> float:
+        """Grey-fault handle (soak "slow_shm"): make every shm commit
+        pay this delay before landing — a throttled per-frame staging
+        memcpy on the zero-copy lane, slow, not dead.  Commits still
+        land and account normally, so no health check fires; only the
+        ``xferd.shm.commit`` latency histogram carries the evidence.
+        0 disarms."""
+        self._shm_delay_s = min(max(float(seconds), 0.0), 2.0)
+        log.warning("shm commit delay %.3fs armed on node %s",
+                    self._shm_delay_s, self.node or "?")
+        return self._shm_delay_s
 
     def _shim_consult(self, host: str, port: int):
         """One frame's verdict from the shim: (action, delay_s) where
@@ -1784,6 +1800,15 @@ class PyXferd:
                         "error": "no shm segment attached for "
                                  f"{need} bytes; shm_attach first"}
             view = f.seg_view(need)
+        # The per-node attribution histogram the grey-failure detector
+        # compares across peers (obs/anomaly.py) — the commit INCLUDING
+        # any armed slow_shm throttle, so a throttled node's windowed
+        # p99 separates from its peers' while every health check stays
+        # green.
+        commit_t0 = time.monotonic()
+        delay_s = min(max(self._shm_delay_s, 0.0), 2.0)
+        if delay_s:
+            time.sleep(delay_s)
         if offset is not None:
             meta = {"off": offset, "tot": need}
             if xid:
@@ -1797,6 +1822,8 @@ class PyXferd:
                                       {"xid": xid} if xid else {},
                                       in_place=True)
             ok = verdict == "landed"
+        histo.observe("xferd.shm.commit",
+                      time.monotonic() - commit_t0)
         if not ok:
             return {"ok": False,
                     "error": f"shm commit not landed: {verdict}"}
@@ -1947,6 +1974,11 @@ class PyXferd:
         for i, (off, ln, seq) in enumerate(post["descs"]):
             if self._stopping.is_set():
                 return
+            # Per-descriptor drive latency, slow_ring throttle
+            # included: the ring plane's attribution histogram for the
+            # grey-failure detector — a crawling completer's p99
+            # separates from its peers' while the cursor stays green.
+            drive_t0 = time.monotonic()
             if delay_s:
                 time.sleep(delay_s)
             remaining_ms = max(1, int((deadline - time.monotonic())
@@ -1964,6 +1996,8 @@ class PyXferd:
                 log.exception("ring send failed (flow %r chunk %d)",
                               flow, i)
                 resp = {"ok": False}
+            histo.observe("xferd.ring.drive",
+                          time.monotonic() - drive_t0)
             if resp.get("ok"):
                 status = dcn_shm.RING_STATUS_BY_VERDICT.get(
                     resp.get("verdict", "sent"), dcn_shm.RING_ERROR)

@@ -89,7 +89,8 @@ ATTR_FLAT_PTS = 2.0
 # registry is consulted suffix-blind on dotted names.
 _LOWER_IS_BETTER = (
     "p99", "p50", "_ms", "ratio", "errors", "shed", "slope",
-    "exposed", "elapsed", "lost", "overhead",
+    "exposed", "elapsed", "lost", "overhead", "detect_windows",
+    "false_positives",
 )
 _HIGHER_IS_BETTER = (
     "mbps", "qps", "goodput", "busbw", "pct_of_memcpy",
